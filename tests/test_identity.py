@@ -1,0 +1,73 @@
+"""Identity registry tests (scenarios modeled on pkg/identity tests)."""
+
+import numpy as np
+
+from cilium_tpu.identity import (
+    ID_HOST,
+    ID_WORLD,
+    IdentityRegistry,
+    LOCAL_IDENTITY_BASE,
+    MIN_USER_IDENTITY,
+    RESERVED_IDENTITIES,
+    lookup_reserved,
+)
+from cilium_tpu.labels import parse_label_array
+
+
+def test_reserved_identities_present():
+    reg = IdentityRegistry()
+    assert reg.get(ID_HOST).labels.sorted_key() == "reserved:host"
+    assert reg.get(ID_WORLD).labels.sorted_key() == "reserved:world"
+    assert lookup_reserved("health") == 4
+    assert len(reg) == len(RESERVED_IDENTITIES)
+
+
+def test_allocate_is_idempotent_per_labelset():
+    reg = IdentityRegistry()
+    lbls = parse_label_array(["k8s:app=web", "k8s:env=prod"])
+    a = reg.allocate(lbls)
+    b = reg.allocate(parse_label_array(["k8s:env=prod", "k8s:app=web"]))
+    assert a.id == b.id >= MIN_USER_IDENTITY
+    other = reg.allocate(parse_label_array(["k8s:app=db"]))
+    assert other.id != a.id
+
+
+def test_local_identity_range():
+    reg = IdentityRegistry()
+    ident = reg.allocate(parse_label_array(["cidr:10.0.0.0/8"]), local=True)
+    assert ident.id >= LOCAL_IDENTITY_BASE
+    assert ident.is_local
+
+
+def test_release_refcounting():
+    reg = IdentityRegistry()
+    lbls = parse_label_array(["k8s:app=web"])
+    a = reg.allocate(lbls)
+    reg.allocate(lbls)  # second ref
+    assert not reg.release(a)  # still referenced
+    assert reg.release(a)  # freed now
+    assert reg.get(a.id) is None
+    # rows are tombstoned, never reshuffled
+    row = reg.row(a.id)
+    assert row is not None
+
+
+def test_dense_view_padding_and_bits():
+    reg = IdentityRegistry(row_bucket=8)
+    web = reg.allocate(parse_label_array(["k8s:app=web"]))
+    bitmaps, ids, live = reg.dense_view()
+    assert bitmaps.shape[0] % 8 == 0
+    assert bitmaps.dtype == np.uint32
+    row = reg.row(web.id)
+    assert ids[row] == web.id
+    assert live[row]
+    assert bitmaps[row].any()
+    # dead rows are zero
+    assert not bitmaps[~live].any()
+
+
+def test_version_bumps_on_change():
+    reg = IdentityRegistry()
+    v0 = reg.version
+    reg.allocate(parse_label_array(["k8s:a=1"]))
+    assert reg.version > v0

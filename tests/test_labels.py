@@ -1,0 +1,109 @@
+"""Label model tests (scenarios modeled on pkg/labels/*_test.go)."""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.labels import (
+    Label,
+    LabelArray,
+    LabelFilter,
+    LabelVocab,
+    cidr_labels,
+    parse_label,
+    parse_label_array,
+)
+
+
+def test_parse_label_sources():
+    assert parse_label("k8s:app=web") == Label("k8s", "app", "web")
+    assert parse_label("app=web") == Label("unspec", "app", "web")
+    assert parse_label("foo") == Label("unspec", "foo", "")
+    assert parse_label("any:foo") == Label("any", "foo", "")
+    assert parse_label("reserved:host") == Label("reserved", "host", "")
+    # '=' before ':' means the colon is part of the value, not a source
+    assert parse_label("key=a:b").key == "key"
+
+
+def test_label_string_roundtrip():
+    for s in ("k8s:app=web", "reserved:host", "container:name"):
+        assert str(parse_label(s)) == s
+
+
+def test_wildcard_source_matching():
+    any_app = parse_label("any:app=web")
+    assert any_app.matches(parse_label("k8s:app=web"))
+    assert any_app.matches(parse_label("container:app=web"))
+    assert not any_app.matches(parse_label("k8s:app=db"))
+    k8s_app = parse_label("k8s:app=web")
+    assert not k8s_app.matches(parse_label("container:app=web"))
+
+
+def test_label_array_canonical():
+    a = parse_label_array(["k8s:b=2", "k8s:a=1"])
+    b = parse_label_array(["k8s:a=1", "k8s:b=2", "k8s:a=1"])
+    assert a == b
+    assert hash(a) == hash(b)
+    assert a.sorted_key() == "k8s:a=1;k8s:b=2"
+
+
+def test_label_array_has():
+    arr = parse_label_array(["k8s:app=web", "container:env=prod"])
+    assert arr.has(parse_label("any:app=web"))
+    assert arr.has(parse_label("k8s:app=web"))
+    assert not arr.has(parse_label("container:app=web"))
+
+
+def test_cidr_labels_cover_all_prefixes():
+    ls = cidr_labels("10.1.2.0/24")
+    keys = [l.key for l in ls]
+    assert len(ls) == 25
+    assert keys[0] == "0.0.0.0/0"
+    assert "10.0.0.0/8" in keys
+    assert keys[-1] == "10.1.2.0/24"
+    assert all(l.source == "cidr" for l in ls)
+
+
+def test_cidr_labels_v6_dashes():
+    ls = cidr_labels("2001:db8::/32")
+    assert all(":" not in l.key for l in ls)
+    assert ls[-1].key == "2001-db8--/32"
+
+
+def test_vocab_identity_vs_selector_bits():
+    vocab = LabelVocab()
+    ident = parse_label_array(["k8s:app=web"])
+    id_bits = vocab.identity_bits(ident)
+    # selector on the wildcard-source variant must be a subset
+    sel_bit = vocab.kv_bit(parse_label("any:app=web"))
+    assert sel_bit in id_bits
+    exists_bit = vocab.exists_bit("any", "app")
+    assert exists_bit in id_bits
+    # a different value is NOT in the identity's bits
+    other = vocab.kv_bit(parse_label("any:app=db"))
+    assert other not in id_bits
+
+
+def test_vocab_packing():
+    vocab = LabelVocab()
+    bits = [0, 31, 32, 64]
+    packed = vocab.pack(bits, num_words=3)
+    assert packed.dtype == np.uint32
+    assert packed[0] == (1 | (1 << 31))
+    assert packed[1] == 1
+    assert packed[2] == 1
+
+
+def test_label_filter_defaults():
+    f = LabelFilter()
+    assert f.allows(parse_label("k8s:app=web"))
+    assert not f.allows(parse_label("k8s:io.kubernetes.pod.namespace=x"))
+    assert f.allows(parse_label("reserved:host"))
+
+
+def test_label_filter_parse():
+    f = LabelFilter.parse(["k8s:app", "-k8s:internal"])
+    assert f.allows(parse_label("k8s:app=web"))
+    assert not f.allows(parse_label("k8s:internal=x"))
+    # with an include list present, unlisted labels are excluded
+    assert not f.allows(parse_label("k8s:other=x"))
+    assert f.allows(parse_label("reserved:host"))

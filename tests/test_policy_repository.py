@@ -1,0 +1,377 @@
+"""Policy repository verdict tests.
+
+Scenarios ported conceptually from pkg/policy/repository_test.go
+(TestCanReachIngress/Egress, TestPolicyTrace shape, L4 coverage) and
+pkg/policy/rule_test.go — same situations, new API.
+"""
+
+import pytest
+
+from cilium_tpu.labels import parse_label_array
+from cilium_tpu.policy import Decision, PortContext, Repository, SearchContext, Trace
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    HTTPRule,
+    IngressRule,
+    L7Rules,
+    PortProtocol,
+    PortRule,
+    rule,
+    rules_from_json,
+    rules_to_json,
+)
+
+
+def ctx(src, dst, ports=()):
+    return SearchContext(
+        src=parse_label_array(src),
+        dst=parse_label_array(dst),
+        dports=tuple(PortContext(p, proto) for p, proto in ports),
+    )
+
+
+def ingress_from(*selector_labels, to_ports=()):
+    return IngressRule(
+        from_endpoints=(EndpointSelector.make(list(selector_labels)),),
+        to_ports=tuple(to_ports),
+    )
+
+
+class TestCanReachIngress:
+    """repository_test.go:114 TestCanReachIngress."""
+
+    def setup_method(self, _):
+        self.repo = Repository()
+
+    def test_empty_repo(self):
+        assert self.repo.can_reach_ingress(ctx(["foo"], ["bar"])) == Decision.UNDECIDED
+        assert self.repo.allows_ingress(ctx(["foo"], ["bar"])) == Decision.DENIED
+
+    def load(self):
+        self.repo.add_list(
+            [
+                rule(["bar"], ingress=[ingress_from("foo")], labels=["tag1"]),
+                rule(
+                    ["groupA"],
+                    ingress=[IngressRule(from_requires=(EndpointSelector.make(["groupA"]),))],
+                    labels=["tag1"],
+                ),
+                rule(["bar2"], ingress=[ingress_from("foo")], labels=["tag1"]),
+            ]
+        )
+
+    def test_basic_allow(self):
+        self.load()
+        assert self.repo.allows_ingress(ctx(["foo"], ["bar"])) == Decision.ALLOWED
+        assert self.repo.allows_ingress(ctx(["foo"], ["bar2"])) == Decision.ALLOWED
+
+    def test_requires_satisfied(self):
+        self.load()
+        assert (
+            self.repo.allows_ingress(ctx(["foo", "groupA"], ["bar", "groupA"]))
+            == Decision.ALLOWED
+        )
+
+    def test_requires_unsatisfied_denies(self):
+        self.load()
+        assert (
+            self.repo.allows_ingress(ctx(["foo", "groupB"], ["bar", "groupA"]))
+            == Decision.DENIED
+        )
+
+    def test_unrelated_group_ok(self):
+        self.load()
+        assert (
+            self.repo.allows_ingress(ctx(["foo", "groupB"], ["bar", "groupB"]))
+            == Decision.ALLOWED
+        )
+
+    def test_no_rule_denies(self):
+        self.load()
+        assert self.repo.allows_ingress(ctx(["foo"], ["bar3"])) == Decision.DENIED
+
+
+class TestCanReachEgress:
+    """repository_test.go:208 TestCanReachEgress (mirrored direction)."""
+
+    def setup_method(self, _):
+        self.repo = Repository()
+        from cilium_tpu.policy.api import EgressRule
+
+        self.repo.add_list(
+            [
+                rule(
+                    ["foo"],
+                    egress=[EgressRule(to_endpoints=(EndpointSelector.make(["bar"]),))],
+                    labels=["tag1"],
+                ),
+                rule(
+                    ["groupA"],
+                    egress=[EgressRule(to_requires=(EndpointSelector.make(["groupA"]),))],
+                    labels=["tag1"],
+                ),
+            ]
+        )
+
+    def test_allow(self):
+        assert self.repo.allows_egress(ctx(["foo"], ["bar"])) == Decision.ALLOWED
+
+    def test_requires_denies(self):
+        assert (
+            self.repo.allows_egress(ctx(["foo", "groupA"], ["bar", "groupB"]))
+            == Decision.DENIED
+        )
+
+    def test_no_rule_denies(self):
+        assert self.repo.allows_egress(ctx(["baz"], ["bar"])) == Decision.DENIED
+
+
+class TestL4Policy:
+    def make_repo(self):
+        repo = Repository()
+        repo.add_list(
+            [
+                rule(
+                    ["bar"],
+                    ingress=[
+                        ingress_from(
+                            "foo",
+                            to_ports=[PortRule(ports=(PortProtocol(80, "TCP"),))],
+                        )
+                    ],
+                )
+            ]
+        )
+        return repo
+
+    def test_l3_defers_to_l4(self):
+        repo = self.make_repo()
+        # Without a port context, an L4-restricted allow never concludes.
+        assert repo.can_reach_ingress(ctx(["foo"], ["bar"])) == Decision.UNDECIDED
+        assert repo.allows_ingress(ctx(["foo"], ["bar"])) == Decision.DENIED
+
+    def test_l4_allows_right_port(self):
+        repo = self.make_repo()
+        assert (
+            repo.allows_ingress(ctx(["foo"], ["bar"], [(80, "TCP")])) == Decision.ALLOWED
+        )
+
+    def test_l4_denies_wrong_port(self):
+        repo = self.make_repo()
+        assert (
+            repo.allows_ingress(ctx(["foo"], ["bar"], [(81, "TCP")])) == Decision.DENIED
+        )
+
+    def test_l4_denies_wrong_peer(self):
+        repo = self.make_repo()
+        assert (
+            repo.allows_ingress(ctx(["baz"], ["bar"], [(80, "TCP")])) == Decision.DENIED
+        )
+
+    def test_any_proto_expands(self):
+        repo = Repository()
+        repo.add_list(
+            [
+                rule(
+                    ["bar"],
+                    ingress=[
+                        ingress_from(
+                            "foo", to_ports=[PortRule(ports=(PortProtocol(53, "ANY"),))]
+                        )
+                    ],
+                )
+            ]
+        )
+        assert repo.allows_ingress(ctx(["foo"], ["bar"], [(53, "UDP")])) == Decision.ALLOWED
+        assert repo.allows_ingress(ctx(["foo"], ["bar"], [(53, "TCP")])) == Decision.ALLOWED
+        assert repo.allows_ingress(ctx(["foo"], ["bar"], [(53, "ANY")])) == Decision.ALLOWED
+
+    def test_from_requires_folds_into_l4(self):
+        """TestL3DependentL4IngressFromRequires (repository_test.go:565):
+        FromRequires constrains L4 peers too."""
+        repo = Repository()
+        repo.add_list(
+            [
+                rule(
+                    ["bar"],
+                    ingress=[
+                        ingress_from(
+                            "foo", to_ports=[PortRule(ports=(PortProtocol(80, "TCP"),))]
+                        ),
+                        IngressRule(from_requires=(EndpointSelector.make(["groupA"]),)),
+                    ],
+                )
+            ]
+        )
+        assert (
+            repo.allows_ingress(ctx(["foo", "groupA"], ["bar"], [(80, "TCP")]))
+            == Decision.ALLOWED
+        )
+        assert (
+            repo.allows_ingress(ctx(["foo"], ["bar"], [(80, "TCP")])) == Decision.DENIED
+        )
+
+    def test_resolve_l4_filter_shape(self):
+        repo = self.make_repo()
+        l4 = repo.resolve_l4_policy(parse_label_array(["bar"]))
+        f = l4.ingress.get(80, "TCP")
+        assert f is not None
+        assert not f.allows_all_at_l3
+        assert not f.is_redirect
+
+    def test_l7_rules_mark_redirect(self):
+        repo = Repository()
+        repo.add_list(
+            [
+                rule(
+                    ["bar"],
+                    ingress=[
+                        ingress_from(
+                            "foo",
+                            to_ports=[
+                                PortRule(
+                                    ports=(PortProtocol(80, "TCP"),),
+                                    rules=L7Rules(http=(HTTPRule(method="GET", path="/public"),)),
+                                )
+                            ],
+                        )
+                    ],
+                )
+            ]
+        )
+        l4 = repo.resolve_l4_policy(parse_label_array(["bar"]))
+        f = l4.ingress.get(80, "TCP")
+        assert f.is_redirect and f.l7_parser == "http"
+        assert l4.has_redirect()
+
+    def test_wildcard_l3_wildcards_l7(self):
+        """TestWildcardL3RulesIngress (repository_test.go:306): an
+        L3-only allow from the same peer wildcards L7 restrictions."""
+        repo = Repository()
+        repo.add_list(
+            [
+                rule(["bar"], ingress=[ingress_from("foo")]),
+                rule(
+                    ["bar"],
+                    ingress=[
+                        ingress_from(
+                            "foo",
+                            to_ports=[
+                                PortRule(
+                                    ports=(PortProtocol(80, "TCP"),),
+                                    rules=L7Rules(http=(HTTPRule(path="/api"),)),
+                                )
+                            ],
+                        )
+                    ],
+                ),
+            ]
+        )
+        l4 = repo.resolve_l4_policy(parse_label_array(["bar"]))
+        f = l4.ingress.get(80, "TCP")
+        # the L7 rules for foo became wildcard (empty HTTPRule)
+        sel = EndpointSelector.make(["foo"])
+        assert any(
+            r == HTTPRule() for s, rules in f.l7_rules_per_ep.items() for r in rules.http
+        )
+
+
+class TestCIDR:
+    def test_cidr_selector_allows(self):
+        from cilium_tpu.labels import cidr_labels, LabelArray
+
+        repo = Repository()
+        repo.add_list(
+            [rule(["bar"], ingress=[IngressRule(from_cidr=("10.0.0.0/8",))])]
+        )
+        # a CIDR identity for 10.1.2.3/32 carries all covering-prefix labels
+        src = LabelArray(cidr_labels("10.1.2.3/32"))
+        assert (
+            repo.allows_ingress(SearchContext(src=src, dst=parse_label_array(["bar"])))
+            == Decision.ALLOWED
+        )
+        outside = LabelArray(cidr_labels("192.168.0.1/32"))
+        assert (
+            repo.allows_ingress(SearchContext(src=outside, dst=parse_label_array(["bar"])))
+            == Decision.DENIED
+        )
+
+    def test_cidr_except_carves_out(self):
+        from cilium_tpu.policy import compute_resultant_cidr_set
+        from cilium_tpu.policy.api import CIDRRule
+
+        out = compute_resultant_cidr_set(
+            [CIDRRule(cidr="10.0.0.0/8", except_cidrs=("10.96.0.0/12",))]
+        )
+        assert "10.96.0.0/12" not in out
+        assert all("10." in c for c in out)
+        import ipaddress
+
+        total = sum(ipaddress.ip_network(c).num_addresses for c in out)
+        assert total == 2**24 - 2**20
+
+    def test_resolve_cidr_policy(self):
+        repo = Repository()
+        from cilium_tpu.policy.api import EgressRule
+
+        repo.add_list(
+            [
+                rule(
+                    ["foo"],
+                    egress=[EgressRule(to_cidr=("192.168.0.0/16",))],
+                )
+            ]
+        )
+        cp = repo.resolve_cidr_policy(parse_label_array(["foo"]))
+        assert cp.egress.prefixes() == ["192.168.0.0/16"]
+        assert (4, 16) in cp.egress.prefix_lengths()
+
+
+class TestRepositoryLifecycle:
+    def test_revision_and_delete(self):
+        repo = Repository()
+        r0 = repo.revision
+        repo.add_list([rule(["a"], labels=["k8s:name=p1"])])
+        assert repo.revision > r0
+        rev, deleted = repo.delete_by_labels(parse_label_array(["k8s:name=p1"]))
+        assert deleted == 1
+        assert len(repo) == 0
+
+    def test_trace_output(self):
+        repo = Repository()
+        repo.add_list([rule(["bar"], ingress=[ingress_from("foo")], description="r1")])
+        c = SearchContext(
+            src=parse_label_array(["foo"]),
+            dst=parse_label_array(["bar"]),
+            trace=Trace.ENABLED,
+        )
+        assert repo.allows_ingress(c) == Decision.ALLOWED
+        log = c.log()
+        assert "selected" in log
+        assert "Found all required labels" in log
+        assert "verdict" in log.lower()
+
+    def test_json_roundtrip(self):
+        text = """
+        [{
+          "endpointSelector": {"matchLabels": {"app": "web"}},
+          "ingress": [{
+            "fromEndpoints": [{"matchLabels": {"role": "frontend"}}],
+            "toPorts": [{"ports": [{"port": "80", "protocol": "TCP"}],
+                         "rules": {"http": [{"method": "GET", "path": "/public.*"}]}}]
+          }],
+          "labels": ["k8s:name=web-policy"]
+        }]
+        """
+        rules = rules_from_json(text)
+        assert len(rules) == 1
+        again = rules_from_json(rules_to_json(rules))
+        assert again == rules
+
+    def test_sanitize_rejects_bad_regex(self):
+        with pytest.raises(ValueError):
+            rules_from_json(
+                '[{"endpointSelector": {}, "ingress": [{"toPorts": '
+                '[{"ports": [{"port": "80", "protocol": "TCP"}], '
+                '"rules": {"http": [{"path": "[unclosed"}]}}]}]}]'
+            )
