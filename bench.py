@@ -1,0 +1,152 @@
+"""Headline benchmark: policy verdicts/sec at 10k rules (BASELINE.md).
+
+Pipeline measured end to end the way the framework runs in production:
+1. compile a 10k-rule repository + identity set into device tensors
+   (the control-plane step, replacing the O(ids×rules) Go loop),
+2. materialize per-endpoint policymap lookup tables on device,
+3. stream large flow batches through the 3-gather lookup kernel
+   (the bpf/lib/policy.h equivalent) and measure verdicts/sec.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline is value / 100e6 (the ≥100M verdicts/s target on v5e-1).
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "")
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+import jax.numpy as jnp
+import numpy as np
+
+from cilium_tpu.engine import PolicyEngine
+from cilium_tpu.identity import IdentityRegistry
+from cilium_tpu.labels import parse_label_array
+from cilium_tpu.ops.lookup import lookup_batch
+from cilium_tpu.ops.materialize import materialize_endpoints
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    rule,
+)
+from cilium_tpu.policy.repository import Repository
+
+N_RULES = int(os.environ.get("BENCH_RULES", 10_000))
+N_IDENTITIES = int(os.environ.get("BENCH_IDENTITIES", 2_048))
+N_ENDPOINTS = int(os.environ.get("BENCH_ENDPOINTS", 64))
+BATCH = int(os.environ.get("BENCH_BATCH", 1 << 20))
+ITERS = int(os.environ.get("BENCH_ITERS", 20))
+
+
+def build_world(rng: random.Random):
+    n_apps = 512
+    repo = Repository()
+    rules = []
+    for i in range(N_RULES):
+        app = rng.randrange(n_apps)
+        subject = [f"k8s:app=a{app}"]
+        peer = EndpointSelector.make([f"k8s:app=a{rng.randrange(n_apps)}"])
+        if rng.random() < 0.3:
+            port = rng.choice([80, 443, 8080, 53, 5432])
+            proto = "UDP" if port == 53 else "TCP"
+            ing = IngressRule(
+                from_endpoints=(peer,),
+                to_ports=(PortRule(ports=(PortProtocol(port, proto),)),),
+            )
+        else:
+            ing = IngressRule(from_endpoints=(peer,))
+        rules.append(rule(subject, ingress=[ing]))
+    repo.add_list(rules)
+
+    reg = IdentityRegistry()
+    idents = []
+    for i in range(N_IDENTITIES):
+        app = rng.randrange(n_apps)
+        labels = [f"k8s:app=a{app}", f"k8s:zone=z{rng.randrange(8)}"]
+        if rng.random() < 0.5:
+            labels.append(f"k8s:env={'prod' if rng.random() < 0.5 else 'dev'}")
+        idents.append(reg.allocate(parse_label_array(labels)))
+    return repo, reg, idents
+
+
+def main() -> None:
+    rng = random.Random(42)
+    t0 = time.time()
+    repo, reg, idents = build_world(rng)
+    t_build = time.time() - t0
+
+    engine = PolicyEngine(repo, reg)
+    t0 = time.time()
+    compiled = engine.refresh()
+    jax.block_until_ready(engine.device_policy.sel_match)
+    t_compile = time.time() - t0
+
+    ep_ids = [idents[i].id for i in range(N_ENDPOINTS)]
+    t0 = time.time()
+    tables, _snaps = materialize_endpoints(
+        compiled, engine.device_policy, ep_ids, ingress=True
+    )
+    jax.block_until_ready(tables.ep_l3)
+    t_mat = time.time() - t0
+
+    # Flow batch (fixed device arrays; realistic mixed ports).
+    nrng = np.random.default_rng(7)
+    n_rows = compiled.id_bits.shape[0]
+    live_rows = np.array([compiled.id_to_row[i.id] for i in idents], np.int32)
+    ep_idx = jnp.asarray(nrng.integers(0, N_ENDPOINTS, BATCH, dtype=np.int32))
+    src = jnp.asarray(nrng.choice(live_rows, BATCH).astype(np.int32))
+    dport = jnp.asarray(
+        nrng.choice(np.array([80, 443, 8080, 53, 22, 0], np.int32), BATCH)
+    )
+    proto = jnp.asarray(np.where(np.asarray(dport) == 53, 17, 6).astype(np.int32))
+
+    dec, red = lookup_batch(tables, ep_idx, src, dport, proto)
+    jax.block_until_ready(dec)
+
+    t0 = time.time()
+    for _ in range(ITERS):
+        dec, red = lookup_batch(tables, ep_idx, src, dport, proto)
+    jax.block_until_ready(dec)
+    elapsed = time.time() - t0
+    verdicts_per_sec = ITERS * BATCH / elapsed
+
+    allow_frac = float(jnp.mean((dec == 1).astype(jnp.float32)))
+    result = {
+        "metric": f"policymap verdicts/sec at {N_RULES} rules",
+        "value": round(verdicts_per_sec),
+        "unit": "verdicts/s",
+        "vs_baseline": round(verdicts_per_sec / 100e6, 4),
+    }
+    print(json.dumps(result))
+    print(
+        json.dumps(
+            {
+                "detail": {
+                    "device": str(jax.devices()[0]),
+                    "build_s": round(t_build, 2),
+                    "compile_s": round(t_compile, 2),
+                    "materialize_s": round(t_mat, 2),
+                    "lookup_elapsed_s": round(elapsed, 3),
+                    "allow_fraction": round(allow_frac, 4),
+                    "identities": N_IDENTITIES,
+                    "endpoints": N_ENDPOINTS,
+                    "batch": BATCH,
+                }
+            }
+        ),
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,283 @@
+"""Lower the policy repository into order-independent dense tables.
+
+The reference evaluates verdicts by walking rules in order
+(pkg/policy/repository.go:80-105); the walk is order-independent in
+outcome (a DENIED from any selected rule dominates; otherwise any
+ALLOWED wins; else UNDECIDED), which is what makes a data-parallel
+tensor formulation possible. Per direction we emit:
+
+- **deny pairs** (subj_sel, req_sel): one per (rule, FromRequires
+  selector). Flow is L3-DENIED iff any pair has subject selected and
+  requirement unmatched by the peer (rule.go:323-345). The same
+  predicate's negation is ``req_ok``, the "all collected requirements
+  hold" term that repository.go:249-261 folds into explicit L4 peer
+  selectors.
+- **allow pairs** (subj_sel, peer_sel): one per (rule, peer selector)
+  for directional rules without ToPorts — the pure-L3 allows, including
+  entity- and CIDR-derived selectors (ingress.go GetSourceEndpointSelectors).
+- **L4 entries** (subj_sel, peer_sel, port, proto, explicit, group):
+  flattened L4Filter contributions (l4.go CreateL4IngressFilter + the
+  merge in rule.go mergeL4IngressPort collapse to an OR over entries).
+  ``explicit`` marks FromEndpoints-derived selectors, which must also
+  satisfy ``req_ok`` (the requirements fold); entity/CIDR selectors and
+  the no-peer wildcard are exempt. ``group`` identifies the directional
+  rule for the peer pre-check (rule.go:133-138: a rule whose peers all
+  fail to match the concrete peer contributes no filters).
+- **group peer table** (group, peer_sel, explicit) + ``group_no_peers``:
+  evaluates that pre-check per flow.
+- **L7-presence entries** (subj_sel, port, group): one per L7-bearing
+  (rule, port). A flow's allow is a proxy redirect iff some L7 entry's
+  subject is selected, the port matches, and its group passes the
+  pre-check — i.e. the merged L4Filter at that port has an l7_parser
+  (l4.go:82 sets parsers only on TCP). This also subsumes
+  wildcardL3L4Rules (repository.go:128-168) on the *decision* path: an
+  extension of an L7 filter's endpoint list by a broader allow never
+  changes a decision (the pre-check that admits the filter already
+  implies a matching L4 entry); it only wildcards which L7 rules apply,
+  which the proxy layer derives separately.
+
+Port matching is literal (a ToPorts port 0 only covers a port-0 query)
+to match L4PolicyMap.covers_context's exact "port/proto" keying.
+Protocols are IANA numbers (u8proto.py), the policymap nexthdr
+encoding (bpf/lib/common.h:180).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..identity import IdentityRegistry
+from ..labels import LabelVocab
+from ..policy.api import EndpointSelector, Rule
+from ..policy.cidr import cidr_selectors
+from ..policy.repository import (
+    Repository,
+    _egress_peer_selectors,
+    _ingress_peer_selectors,
+)
+from .. import u8proto
+from .selectors import SelectorTable, WILDCARD_SELECTOR_ID
+
+PROTO_TCP_N = u8proto.TCP
+PROTO_UDP_N = u8proto.UDP
+
+_PROTO_NUM = {"TCP": PROTO_TCP_N, "UDP": PROTO_UDP_N}
+
+
+def _expand_protos(proto: str) -> Tuple[int, ...]:
+    if proto == "ANY":
+        return (PROTO_TCP_N, PROTO_UDP_N)
+    return (_PROTO_NUM[proto],)
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    """Next power-of-two ≥ max(n, minimum) — shape-bucketed padding so
+    incremental recompiles hit XLA's compile cache."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+def _pad_i32(values: Sequence[int], size: int) -> np.ndarray:
+    out = np.zeros(size, dtype=np.int32)
+    out[: len(values)] = values
+    return out
+
+
+def _pad_bool(values: Sequence[bool], size: int) -> np.ndarray:
+    out = np.zeros(size, dtype=bool)
+    out[: len(values)] = values
+    return out
+
+
+@dataclasses.dataclass
+class DirectionProgram:
+    """Dense tables for one traffic direction (all numpy, padded)."""
+
+    # deny pairs
+    deny_subj: np.ndarray
+    deny_req: np.ndarray
+    deny_valid: np.ndarray
+    # L3 allow pairs
+    allow_subj: np.ndarray
+    allow_peer: np.ndarray
+    allow_valid: np.ndarray
+    # L4 entries
+    e_subj: np.ndarray
+    e_peer: np.ndarray
+    e_port: np.ndarray
+    e_proto: np.ndarray
+    e_explicit: np.ndarray
+    e_group: np.ndarray
+    e_valid: np.ndarray
+    # group pre-check
+    group_no_peers: np.ndarray  # [G] bool
+    gp_group: np.ndarray
+    gp_sel: np.ndarray
+    gp_explicit: np.ndarray
+    gp_valid: np.ndarray
+    # L7-parser presence (always TCP, l4.go:82)
+    l7_subj: np.ndarray
+    l7_port: np.ndarray
+    l7_group: np.ndarray
+    l7_valid: np.ndarray
+
+
+@dataclasses.dataclass
+class CompiledPolicy:
+    """Host-side compiled policy: identity bitmaps + selector conjuncts
+    + per-direction tables. ``revision``/``identity_version`` record the
+    inputs this was compiled from (the endpoint regeneration protocol's
+    revision gate, pkg/endpoint/policy.go:506)."""
+
+    revision: int
+    identity_version: int
+    vocab_version: int
+    num_words: int
+    num_selectors: int
+    # identities (dense rows)
+    id_bits: np.ndarray  # [N, W] uint32
+    row_ids: np.ndarray  # [N] int32 numeric identity per row
+    row_live: np.ndarray  # [N] bool
+    id_to_row: Dict[int, int]
+    # selector conjuncts
+    conj_req: np.ndarray  # [S, CPS, W] uint32
+    conj_forbid: np.ndarray
+    conj_valid: np.ndarray  # [S, CPS] bool
+    req_count: np.ndarray  # [S, CPS] int32
+    ingress: DirectionProgram = None  # type: ignore[assignment]
+    egress: DirectionProgram = None  # type: ignore[assignment]
+
+    def rows_for(self, identity_ids: Sequence[int]) -> np.ndarray:
+        return np.array([self.id_to_row[i] for i in identity_ids], dtype=np.int32)
+
+
+def _extract_direction(
+    rules: Sequence[Rule], table: SelectorTable, ingress: bool
+) -> DirectionProgram:
+    deny: List[Tuple[int, int]] = []
+    allow: List[Tuple[int, int]] = []
+    entries: List[Tuple[int, int, int, int, bool, int]] = []
+    group_no_peers: List[bool] = []
+    gp: List[Tuple[int, int, bool]] = []
+    # L7-bearing (subj_sel, port, group) — parser presence (always TCP)
+    l7_ports: List[Tuple[int, int, int]] = []
+
+    for r in rules:
+        subj = table.intern(r.endpoint_selector)
+        directional = r.ingress if ingress else r.egress
+        for dr in directional:
+            requires = dr.from_requires if ingress else dr.to_requires
+            for q in requires:
+                deny.append((subj, table.intern(q)))
+            peer_sels = (
+                _ingress_peer_selectors(dr) if ingress else _egress_peer_selectors(dr)
+            )
+            if not dr.to_ports:
+                for s in peer_sels:
+                    allow.append((subj, table.intern(s)))
+                continue
+
+            # Directional rule with ToPorts → one pre-check group.
+            explicit_raw = dr.from_endpoints if ingress else dr.to_endpoints
+            entity_sels = dr.peer_selectors()[len(explicit_raw):]
+            c_sels = (
+                cidr_selectors(dr.from_cidr, dr.from_cidr_set)
+                if ingress
+                else cidr_selectors(dr.to_cidr, dr.to_cidr_set)
+            )
+            peers: List[Tuple[int, bool]] = (
+                [(table.intern(s), True) for s in explicit_raw]
+                + [(table.intern(s), False) for s in entity_sels]
+                + [(table.intern(s), False) for s in c_sels]
+            )
+            group = len(group_no_peers)
+            group_no_peers.append(not peers)
+            for sid, expl in peers:
+                gp.append((group, sid, expl))
+
+            for pr in dr.to_ports:
+                has_l7 = bool(pr.rules)
+                for pp in pr.ports:
+                    for proto in _expand_protos(pp.proto):
+                        if has_l7 and proto == PROTO_TCP_N:
+                            l7_ports.append((subj, pp.port, group))
+                        if not peers:
+                            entries.append(
+                                (subj, WILDCARD_SELECTOR_ID, pp.port, proto, False, group)
+                            )
+                        else:
+                            for sid, expl in peers:
+                                entries.append((subj, sid, pp.port, proto, expl, group))
+
+    nd, na, ne = _bucket(len(deny)), _bucket(len(allow)), _bucket(len(entries))
+    ng, ngp, nl7 = _bucket(len(group_no_peers)), _bucket(len(gp)), _bucket(len(l7_ports))
+    return DirectionProgram(
+        deny_subj=_pad_i32([d[0] for d in deny], nd),
+        deny_req=_pad_i32([d[1] for d in deny], nd),
+        deny_valid=_pad_bool([True] * len(deny), nd),
+        allow_subj=_pad_i32([a[0] for a in allow], na),
+        allow_peer=_pad_i32([a[1] for a in allow], na),
+        allow_valid=_pad_bool([True] * len(allow), na),
+        e_subj=_pad_i32([e[0] for e in entries], ne),
+        e_peer=_pad_i32([e[1] for e in entries], ne),
+        e_port=_pad_i32([e[2] for e in entries], ne),
+        e_proto=_pad_i32([e[3] for e in entries], ne),
+        e_explicit=_pad_bool([e[4] for e in entries], ne),
+        e_group=_pad_i32([e[5] for e in entries], ne),
+        e_valid=_pad_bool([True] * len(entries), ne),
+        group_no_peers=_pad_bool(group_no_peers, ng),
+        gp_group=_pad_i32([g[0] for g in gp], ngp),
+        gp_sel=_pad_i32([g[1] for g in gp], ngp),
+        gp_explicit=_pad_bool([g[2] for g in gp], ngp),
+        gp_valid=_pad_bool([True] * len(gp), ngp),
+        l7_subj=_pad_i32([l[0] for l in l7_ports], nl7),
+        l7_port=_pad_i32([l[1] for l in l7_ports], nl7),
+        l7_group=_pad_i32([l[2] for l in l7_ports], nl7),
+        l7_valid=_pad_bool([True] * len(l7_ports), nl7),
+    )
+
+
+def compile_policy(repo: Repository, registry: IdentityRegistry) -> CompiledPolicy:
+    """Lower repository + identities to dense tables.
+
+    Order matters: selectors intern their vocab bits first, then the
+    identity dense view interns identity bits (growing the vocab), and
+    only then are conjuncts packed against the final word count — so
+    identity bitmaps and selector masks share one bit space.
+    """
+    table = SelectorTable()
+    with repo._lock:
+        rules = list(repo.rules)
+        revision = repo.revision
+    ingress = _extract_direction(rules, table, ingress=True)
+    egress = _extract_direction(rules, table, ingress=False)
+
+    vocab = registry.vocab
+    lowered = table.lower_bits(vocab)
+    id_bits, row_ids, row_live = registry.dense_view()
+    num_words = id_bits.shape[1]
+    conj_req, conj_forbid, conj_valid, req_count = table.pack(lowered, vocab, num_words)
+
+    id_to_row = {int(i): r for r, i in enumerate(row_ids) if row_live[r]}
+    return CompiledPolicy(
+        revision=revision,
+        identity_version=registry.version,
+        vocab_version=vocab.version,
+        num_words=num_words,
+        num_selectors=len(table),
+        id_bits=id_bits,
+        row_ids=row_ids,
+        row_live=row_live,
+        id_to_row=id_to_row,
+        conj_req=conj_req,
+        conj_forbid=conj_forbid,
+        conj_valid=conj_valid,
+        req_count=req_count,
+        ingress=ingress,
+        egress=egress,
+    )

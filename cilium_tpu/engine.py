@@ -1,0 +1,163 @@
+"""PolicyEngine: the device-backed policy resolver.
+
+The TPU-native counterpart of the reference's per-endpoint regeneration
+entry points (pkg/endpoint/policy.go regeneratePolicy →
+repository.AllowsIngress*): owns a Repository + IdentityRegistry,
+compiles them into device tensors, refreshes when revisions move, and
+answers batched verdict queries.
+
+The refresh is the "datapath compile" of this framework — instead of
+clang→llc per endpoint (pkg/datapath/loader/compile.go), it re-packs
+numpy tables and lets jit shape-bucketing reuse compiled XLA programs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import u8proto
+from .compiler import CompiledPolicy, compile_policy
+from .identity import IdentityRegistry
+from .identity.model import MAX_USER_IDENTITY
+from .ops.bitmap import compute_selector_matches
+from .ops.verdict import DevicePolicy, DeviceTables, Verdict, verdict_batch
+from .policy.repository import Repository
+
+PROTO_TCP = u8proto.TCP
+PROTO_UDP = u8proto.UDP
+
+
+class PolicyEngine:
+    def __init__(self, repo: Repository, registry: IdentityRegistry) -> None:
+        self.repo = repo
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._compiled: Optional[CompiledPolicy] = None
+        self._device: Optional[DevicePolicy] = None
+        # Dense row table for the compact ranges (reserved + user,
+        # < 65536) and a dict for sparse local/CIDR identities
+        # (≥ LOCAL_IDENTITY_BASE = 1<<24) — a dense table over the full
+        # numeric space would be ~64MB per refresh.
+        self._low_rows: Optional[np.ndarray] = None
+        self._high_rows: dict = {}
+
+    # ------------------------------------------------------------------
+    def _stale(self) -> bool:
+        c = self._compiled
+        return (
+            c is None
+            or c.revision != self.repo.revision
+            or c.identity_version != self.registry.version
+        )
+
+    def refresh(self, force: bool = False) -> CompiledPolicy:
+        """Recompile if repository or identity state moved (the revision
+        gate of pkg/endpoint/policy.go:506)."""
+        with self._lock:
+            if not force and not self._stale():
+                return self._compiled  # type: ignore[return-value]
+            compiled = compile_policy(self.repo, self.registry)
+            sel_match = compute_selector_matches(
+                jnp.asarray(compiled.id_bits),
+                jnp.asarray(compiled.conj_req),
+                jnp.asarray(compiled.conj_forbid),
+                jnp.asarray(compiled.conj_valid),
+                jnp.asarray(compiled.req_count),
+            )
+            self._device = DevicePolicy(
+                id_bits=jnp.asarray(compiled.id_bits),
+                sel_match=sel_match,
+                ingress=DeviceTables.from_host(compiled.ingress),
+                egress=DeviceTables.from_host(compiled.egress),
+            )
+            low = np.full(MAX_USER_IDENTITY + 1, -1, np.int32)
+            high: dict = {}
+            for ident, row in compiled.id_to_row.items():
+                if ident < low.size:
+                    low[ident] = row
+                else:
+                    high[ident] = row
+            self._low_rows = low
+            self._high_rows = high
+            self._compiled = compiled
+            return compiled
+
+    @property
+    def device_policy(self) -> DevicePolicy:
+        self.refresh()
+        assert self._device is not None
+        return self._device
+
+    def _rows_snapshot(
+        self, low: np.ndarray, high: dict, identity_ids: Sequence[int]
+    ) -> np.ndarray:
+        ids = np.asarray(identity_ids, dtype=np.int64)
+        rows = np.empty(ids.shape, np.int32)
+        in_low = ids < low.size
+        if (ids < 0).any():
+            raise KeyError("negative identity in batch")
+        rows[in_low] = low[ids[in_low]]
+        for i in np.nonzero(~in_low)[0]:
+            rows[i] = high.get(int(ids[i]), -1)
+        if (rows < 0).any():
+            raise KeyError("unknown identity in batch")
+        return rows
+
+    def rows(self, identity_ids: Sequence[int]) -> np.ndarray:
+        self.refresh()
+        assert self._low_rows is not None
+        return self._rows_snapshot(self._low_rows, self._high_rows, identity_ids)
+
+    # ------------------------------------------------------------------
+    def verdicts(
+        self,
+        subj_ids: Sequence[int],
+        peer_ids: Sequence[int],
+        dports: Sequence[int],
+        protos: Sequence[int],
+        *,
+        ingress: bool = True,
+        has_l4: Optional[Sequence[bool]] = None,
+    ) -> Verdict:
+        """Batched verdicts by identity number. ``subj`` is the endpoint
+        whose policy applies (dst for ingress, src for egress)."""
+        # Snapshot device + row tables under one lock acquisition so a
+        # concurrent repo/registry mutation can't mix row indices from a
+        # newer compilation into older device tables.
+        self.refresh()
+        with self._lock:
+            device = self._device
+            low, high = self._low_rows, self._high_rows
+        assert device is not None and low is not None
+        n = len(subj_ids)
+        hl4 = np.ones(n, dtype=bool) if has_l4 is None else np.asarray(has_l4, bool)
+        return verdict_batch(
+            device,
+            jnp.asarray(self._rows_snapshot(low, high, subj_ids)),
+            jnp.asarray(self._rows_snapshot(low, high, peer_ids)),
+            jnp.asarray(np.asarray(dports, np.int32)),
+            jnp.asarray(np.asarray(protos, np.int32)),
+            jnp.asarray(hl4),
+            ingress=ingress,
+        )
+
+    def verdict_one(
+        self,
+        subj_id: int,
+        peer_id: int,
+        dport: int = 0,
+        proto: int = PROTO_TCP,
+        *,
+        ingress: bool = True,
+        l4: bool = True,
+    ) -> Tuple[int, int]:
+        """Single query → (decision, l3_decision); the `cilium policy
+        trace` fast path."""
+        v = self.verdicts(
+            [subj_id], [peer_id], [dport], [proto], ingress=ingress, has_l4=[l4]
+        )
+        return int(v.decision[0]), int(v.l3[0])

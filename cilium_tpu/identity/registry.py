@@ -6,9 +6,11 @@ kvstore-backed global allocator plugs in via
 cilium_tpu.kvstore.allocator, and this registry is the local cache).
 
 TPU-first: identities are sparse integers but device tensors are dense,
-so the registry assigns every identity a stable *row*, maintains the
-packed label-bitmap matrix [rows, words] incrementally, and bumps a
+so the registry assigns every identity a stable *row* and bumps a
 ``version`` on any change so compiled policy tensors know to refresh.
+``dense_view()`` repacks the full [rows, words] bitmap matrix on each
+call (O(identities × labels) host work) — callers gate it behind the
+version check, and incremental row updates are a planned optimization.
 Rows are padded to ``row_bucket`` so recompiles hit shape-bucketed XLA
 caches instead of a fresh trace per identity.
 """

@@ -1,0 +1,25 @@
+"""Device-side kernels (JAX/XLA; Pallas where profiling warrants).
+
+These replace the reference's kernel datapath verdict helpers
+(bpf/lib/policy.h) and the userspace resolution loop
+(pkg/endpoint/policy.go:317-389) with batched tensor programs.
+"""
+
+from .bitmap import compute_selector_matches, pack_bool_bits
+from .lookup import PolicymapTables, lookup_batch
+from .materialize import EndpointPolicySnapshot, PolicyKey, materialize_endpoints
+from .verdict import DeviceTables, DevicePolicy, Verdict, verdict_batch
+
+__all__ = [
+    "compute_selector_matches",
+    "pack_bool_bits",
+    "PolicymapTables",
+    "lookup_batch",
+    "EndpointPolicySnapshot",
+    "PolicyKey",
+    "materialize_endpoints",
+    "DeviceTables",
+    "DevicePolicy",
+    "Verdict",
+    "verdict_batch",
+]

@@ -1,0 +1,93 @@
+"""Selector↔identity matching as MXU matmuls.
+
+The core primitive of the whole framework: given identity label bitmaps
+``id_bits [N, W]`` (uint32 words) and selector conjunct masks
+``conj_req/conj_forbid [S, CPS, W]``, compute the boolean match matrix
+
+    sel_match[n, s] = any_c valid[s,c]
+                      & popcount(id & req[s,c])    == req_count[s,c]
+                      & popcount(id & forbid[s,c]) == 0
+
+This replaces the reference's per-identity, per-rule label walk
+(pkg/endpoint/policy.go:346-389 calling LabelArray matching per pair)
+with two int8×int8→int32 matmuls over the unpacked bit axis — the
+O(N_ids × selectors × labels) work lands on the systolic array instead
+of a Go loop.
+
+The result is bit-packed over the selector axis ([N, ceil(S/32)]
+uint32) so downstream verdict kernels pay one 4-byte gather per
+(flow, selector-id) test.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _unpack_bits_u32(words: jnp.ndarray) -> jnp.ndarray:
+    """[..., W] uint32 → [..., W*32] int8 (bit 0 of word 0 first)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(*words.shape[:-1], words.shape[-1] * 32).astype(jnp.int8)
+
+
+def pack_bool_bits(flags: jnp.ndarray) -> jnp.ndarray:
+    """[..., S] bool → [..., ceil(S/32)] uint32 (pads with zeros)."""
+    s = flags.shape[-1]
+    s_words = (s + 31) // 32
+    pad = s_words * 32 - s
+    if pad:
+        flags = jnp.concatenate(
+            [flags, jnp.zeros((*flags.shape[:-1], pad), dtype=flags.dtype)], axis=-1
+        )
+    grouped = flags.reshape(*flags.shape[:-1], s_words, 32).astype(jnp.uint32)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    return (grouped * weights).sum(axis=-1, dtype=jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("row_chunk",))
+def compute_selector_matches(
+    id_bits: jnp.ndarray,  # [N, W] uint32
+    conj_req: jnp.ndarray,  # [S, CPS, W] uint32
+    conj_forbid: jnp.ndarray,  # [S, CPS, W] uint32
+    conj_valid: jnp.ndarray,  # [S, CPS] bool
+    req_count: jnp.ndarray,  # [S, CPS] int32
+    row_chunk: int = 2048,
+) -> jnp.ndarray:
+    """→ packed sel_match [N, ceil(S/32)] uint32.
+
+    Chunked over identity rows with lax.map so the [chunk, S*CPS] int32
+    matmul output stays within a bounded HBM footprint at 64k identities.
+    """
+    n, w = id_bits.shape
+    s, cps, _ = conj_req.shape
+    l = w * 32
+
+    req_t = _unpack_bits_u32(conj_req.reshape(s * cps, w)).T  # [L, S*CPS] int8
+    forbid_t = _unpack_bits_u32(conj_forbid.reshape(s * cps, w)).T
+    req_n = req_count.reshape(1, s * cps)
+    valid = conj_valid.reshape(1, s * cps)
+
+    pad_rows = (-n) % row_chunk
+    padded = jnp.pad(id_bits, ((0, pad_rows), (0, 0)))
+    chunks = padded.reshape(-1, row_chunk, w)
+
+    def one_chunk(chunk_words: jnp.ndarray) -> jnp.ndarray:
+        bits = _unpack_bits_u32(chunk_words)  # [chunk, L] int8
+        hit_req = jax.lax.dot_general(
+            bits, req_t, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        hit_forbid = jax.lax.dot_general(
+            bits, forbid_t, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        ok = valid & (hit_req == req_n) & (hit_forbid == 0)  # [chunk, S*CPS]
+        sel = ok.reshape(row_chunk, s, cps).any(axis=-1)
+        return pack_bool_bits(sel)
+
+    packed = jax.lax.map(one_chunk, chunks)  # [n_chunks, chunk, S_words]
+    return packed.reshape(-1, packed.shape[-1])[:n]

@@ -1,0 +1,191 @@
+"""Policymap materialization: full verdict engine → realized lookup state.
+
+The TPU replacement for the reference's hottest control-plane loop,
+computeDesiredL3PolicyMapEntries (pkg/endpoint/policy.go:317-389): for
+every local endpoint, evaluate the full policy for *every known
+identity* (and every L4 slot) and emit the dense lookup tables of
+ops/lookup.py plus host-visible policymap entries (pkg/maps/policymap
+key format) for the datapath front-end.
+
+The whole sweep — endpoints × identities × (L3 + each L4 slot) — is
+flattened into ONE batched device call, so a full regeneration costs a
+single dispatch regardless of endpoint count (the reference pays a
+per-endpoint per-identity Go loop; we pay one kernel launch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler.program import CompiledPolicy
+from .bitmap import pack_bool_bits
+from .lookup import PolicymapTables
+from .verdict import ALLOW, DevicePolicy, verdict_batch
+
+TRAFFIC_INGRESS = 0
+TRAFFIC_EGRESS = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicyKey:
+    """pkg/maps/policymap PolicyKey (policymap.go:64): identity, dport
+    (0 = L3-only), nexthdr (0 = L3-only), traffic direction."""
+
+    identity: int
+    dport: int
+    nexthdr: int
+    direction: int
+
+
+@dataclasses.dataclass
+class EndpointPolicySnapshot:
+    """Desired policymap for one endpoint + its slot layout. Entry value
+    is the proxy-redirect flag (proxy port binding happens at the proxy
+    layer, pkg/proxy/proxy.go port allocator)."""
+
+    entries: Dict[PolicyKey, int]
+    slots: List[Tuple[int, int]]
+
+
+def _endpoint_slots(compiled: CompiledPolicy, subj_sel_row: np.ndarray, ingress: bool):
+    """Distinct (port, proto) L4 slots this endpoint's policy can
+    reference: L4 entries whose subject selector matches, plus
+    L7-parser ports (always TCP)."""
+    d = compiled.ingress if ingress else compiled.egress
+
+    def sel_hit(sids: np.ndarray) -> np.ndarray:
+        return (subj_sel_row[sids >> 5] >> (sids & 31)) & 1
+
+    slots = set()
+    valid = d.e_valid & (sel_hit(d.e_subj.astype(np.int64)) == 1)
+    for port, proto in zip(d.e_port[valid], d.e_proto[valid]):
+        slots.add((int(port), int(proto)))
+    lv = d.l7_valid & (sel_hit(d.l7_subj.astype(np.int64)) == 1)
+    for port in d.l7_port[lv]:
+        slots.add((int(port), 6))
+    return sorted(slots)
+
+
+def materialize_endpoints(
+    compiled: CompiledPolicy,
+    device: DevicePolicy,
+    endpoint_identity_ids: Sequence[int],
+    *,
+    ingress: bool = True,
+    slot_bucket: int = 8,
+    block: int = 65536,
+) -> Tuple[PolicymapTables, List[EndpointPolicySnapshot]]:
+    n = compiled.id_bits.shape[0]
+    nw = (n + 31) // 32
+    ep_rows = compiled.rows_for(endpoint_identity_ids)
+    sel_match_host = np.asarray(device.sel_match)
+    live = compiled.row_live
+    direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
+
+    # Flatten (endpoint L3 sweep) + (endpoint, slot) sweeps into one batch.
+    ep_slots: List[List[Tuple[int, int]]] = [
+        _endpoint_slots(compiled, sel_match_host[row], ingress) for row in ep_rows
+    ]
+    seg_subj: List[np.ndarray] = []
+    seg_port: List[int] = []
+    seg_proto: List[int] = []
+    seg_l4: List[bool] = []
+    for e, row in enumerate(ep_rows):
+        seg_subj.append(np.full(n, row, np.int32))
+        seg_port.append(0)
+        seg_proto.append(0)
+        seg_l4.append(False)
+        for port, proto in ep_slots[e]:
+            seg_subj.append(np.full(n, row, np.int32))
+            seg_port.append(port)
+            seg_proto.append(proto)
+            seg_l4.append(True)
+
+    n_seg = len(seg_subj)
+    all_rows = np.arange(n, dtype=np.int32)
+    subj = np.concatenate(seg_subj)
+    peer = np.tile(all_rows, n_seg)
+    dport = np.repeat(np.asarray(seg_port, np.int32), n)
+    proto = np.repeat(np.asarray(seg_proto, np.int32), n)
+    has_l4 = np.repeat(np.asarray(seg_l4, bool), n)
+
+    v = verdict_batch(
+        device,
+        jnp.asarray(subj),
+        jnp.asarray(peer),
+        jnp.asarray(dport),
+        jnp.asarray(proto),
+        jnp.asarray(has_l4),
+        ingress=ingress,
+        block=block,
+    )
+    dec = np.asarray(v.decision).reshape(n_seg, n)
+    l3d = np.asarray(v.l3).reshape(n_seg, n)
+    red = np.asarray(v.l7_redirect).reshape(n_seg, n)
+
+    ep_l3_bits: List[np.ndarray] = []
+    slot_meta: List[List[Tuple[int, int, int]]] = []
+    col_allow: List[np.ndarray] = []
+    col_redirect: List[np.ndarray] = []
+    snapshots: List[EndpointPolicySnapshot] = []
+
+    seg = 0
+    for e, row in enumerate(ep_rows):
+        l3_allow = (l3d[seg] == 1) & live
+        seg += 1
+        ep_l3_bits.append(l3_allow)
+        entries: Dict[PolicyKey, int] = {}
+        for r_idx in np.nonzero(l3_allow)[0]:
+            entries[PolicyKey(int(compiled.row_ids[r_idx]), 0, 0, direction)] = 0
+        meta: List[Tuple[int, int, int]] = []
+        for port, proto_n in ep_slots[e]:
+            allow = (dec[seg] == ALLOW) & live
+            redirect = red[seg] & live
+            seg += 1
+            col = len(col_allow)
+            col_allow.append(allow)
+            col_redirect.append(redirect)
+            meta.append((port, proto_n, col))
+            # Exact {id, port, proto} entries: the datapath consults the
+            # exact key first (bpf/lib/policy.h:46), so L3-allowed
+            # identities still need one when the filter redirects.
+            for r_idx in np.nonzero(allow & (~l3_allow | redirect))[0]:
+                key = PolicyKey(int(compiled.row_ids[r_idx]), port, proto_n, direction)
+                entries[key] = int(redirect[r_idx])
+        slot_meta.append(meta)
+        snapshots.append(EndpointPolicySnapshot(entries=entries, slots=ep_slots[e]))
+
+    # Pack device tables.
+    ep = len(ep_rows)
+    k = slot_bucket
+    while any(len(m) > k for m in slot_meta):
+        k *= 2
+    ncols = max(1, len(col_allow))
+    slot_port = np.zeros((ep, k), np.int32)
+    slot_proto = np.zeros((ep, k), np.int32)
+    slot_col = np.zeros((ep, k), np.int32)
+    slot_valid = np.zeros((ep, k), bool)
+    for e, meta in enumerate(slot_meta):
+        for j, (port, proto_n, col) in enumerate(meta):
+            slot_port[e, j], slot_proto[e, j], slot_col[e, j] = port, proto_n, col
+            slot_valid[e, j] = True
+
+    def pack_rows(rows: List[np.ndarray], count: int) -> jnp.ndarray:
+        if not rows:
+            return jnp.zeros((count, nw), jnp.uint32)
+        return pack_bool_bits(jnp.asarray(np.stack(rows)))
+
+    tables = PolicymapTables(
+        ep_l3=pack_rows(ep_l3_bits, ep),
+        slot_port=jnp.asarray(slot_port),
+        slot_proto=jnp.asarray(slot_proto),
+        slot_col=jnp.asarray(slot_col),
+        slot_valid=jnp.asarray(slot_valid),
+        col_allow=pack_rows(col_allow, ncols),
+        col_redirect=pack_rows(col_redirect, ncols),
+    )
+    return tables, snapshots

@@ -52,7 +52,8 @@ def entity_selector(entity: str) -> EndpointSelector:
 
 @dataclasses.dataclass(frozen=True)
 class PortProtocol:
-    """One L4 port (l4.go PortProtocol). Port 0 = all ports."""
+    """One L4 port (l4.go PortProtocol). Ports are matched literally
+    throughout (L4PolicyMap keys "port/proto" exactly), including 0."""
 
     port: int
     protocol: str = PROTO_ANY
@@ -66,11 +67,6 @@ class PortProtocol:
     @property
     def proto(self) -> str:
         return self.protocol.upper()
-
-    def covers(self, port: int, proto: str) -> bool:
-        if self.port not in (0, port):
-            return False
-        return self.proto == PROTO_ANY or self.proto == proto.upper()
 
     def __str__(self) -> str:
         return f"{self.port}/{self.proto}"

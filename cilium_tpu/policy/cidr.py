@@ -48,12 +48,15 @@ def compute_resultant_cidr_set(rules: Iterable[CIDRRule]) -> List[str]:
 def cidr_selectors(cidrs: Iterable[str], cidr_rules: Iterable[CIDRRule]) -> List[EndpointSelector]:
     """CIDR allows as label selectors over ``cidr:`` identity labels
     (api/cidr.go GetAsEndpointSelectors) — this is how CIDR peers join
-    the same bitmap-matching path as label peers."""
+    the same bitmap-matching path as label peers. The selector key must
+    stay byte-identical to the identity-side label key, so both derive
+    from labels.cidr.ip_string_to_label."""
+    from ..labels.cidr import ip_string_to_label
+
     sels = []
     for c in list(cidrs) + compute_resultant_cidr_set(cidr_rules):
-        net = ipaddress.ip_network(c, strict=False)
-        key = f"{net.network_address}/{net.prefixlen}".replace(":", "-")
-        sels.append(EndpointSelector.make([f"cidr:{key}"]))
+        lbl = ip_string_to_label(c)
+        sels.append(EndpointSelector.make([f"{lbl.source}:{lbl.key}"]))
     return sels
 
 

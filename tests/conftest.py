@@ -12,3 +12,17 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Persistent XLA compile cache: the verdict kernels shape-bucket their
+# tables, so across pytest runs nearly every jit hits this cache.
+import jax
+
+# The axon sitecustomize force-sets jax_platforms="axon,cpu" at
+# interpreter startup (before this conftest), which routes every op to
+# the real TPU over the tunnel — tests must stay on the virtual CPU
+# mesh, so override the *config*, not just the env var.
+jax.config.update("jax_platforms", "cpu")
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)

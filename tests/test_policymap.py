@@ -1,0 +1,119 @@
+"""Materialized policymap tables + lookup kernel vs the full engine.
+
+The lookup path (ops/lookup.py) is the datapath hot loop; it must agree
+with the full verdict engine on every (endpoint, identity, port, proto)
+— the desired/realized contract of pkg/endpoint/endpoint.go:2572
+syncPolicyMap, with redirect semantics following bpf/lib/policy.h
+lookup order (exact {id,port,proto} beats L3-only {id,0,0}).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from cilium_tpu.engine import PROTO_TCP, PROTO_UDP, PolicyEngine
+from cilium_tpu.identity import IdentityRegistry
+from cilium_tpu.labels import parse_label_array
+from cilium_tpu.ops.lookup import lookup_batch
+from cilium_tpu.ops.materialize import PolicyKey, materialize_endpoints
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    HTTPRule,
+    IngressRule,
+    L7Rules,
+    PortProtocol,
+    PortRule,
+    rule,
+)
+from cilium_tpu.policy.repository import Repository
+
+
+def _world():
+    http = L7Rules(http=(HTTPRule(method="GET"),))
+    rules = [
+        rule(
+            ["k8s:app=b"],
+            ingress=[
+                IngressRule(from_endpoints=(EndpointSelector.make(["k8s:app=a"]),)),
+                IngressRule(
+                    from_endpoints=(EndpointSelector.make(["k8s:app=c"]),),
+                    to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),)),),
+                ),
+                IngressRule(
+                    from_endpoints=(EndpointSelector.make(["k8s:app=a"]),),
+                    to_ports=(PortRule(ports=(PortProtocol(8080, "TCP"),), rules=http),),
+                ),
+            ],
+        ),
+        rule(
+            ["k8s:app=d"],
+            ingress=[IngressRule(to_ports=(PortRule(ports=(PortProtocol(53, "ANY"),)),))],
+        ),
+    ]
+    repo = Repository()
+    repo.add_list(rules)
+    reg = IdentityRegistry()
+    idents = {
+        name: reg.allocate(parse_label_array([f"k8s:app={name}"]))
+        for name in ("a", "b", "c", "d")
+    }
+    return PolicyEngine(repo, reg), idents
+
+
+def test_lookup_matches_engine():
+    engine, idents = _world()
+    compiled = engine.refresh()
+    ep_names = ["b", "d"]
+    ep_ids = [idents[n].id for n in ep_names]
+    tables, snaps = materialize_endpoints(compiled, engine.device_policy, ep_ids)
+
+    ports = [(0, PROTO_TCP), (80, PROTO_TCP), (8080, PROTO_TCP), (53, PROTO_UDP), (53, PROTO_TCP)]
+    cases = []
+    for e in range(len(ep_ids)):
+        for src in idents.values():
+            for port, proto in ports:
+                cases.append((e, src.id, port, proto))
+    ep_idx = jnp.asarray(np.array([c[0] for c in cases], np.int32))
+    src_rows = jnp.asarray(engine.rows([c[1] for c in cases]))
+    dport = jnp.asarray(np.array([c[2] for c in cases], np.int32))
+    proto = jnp.asarray(np.array([c[3] for c in cases], np.int32))
+    dec, red = lookup_batch(tables, ep_idx, src_rows, dport, proto)
+
+    v = engine.verdicts(
+        [ep_ids[c[0]] for c in cases],
+        [c[1] for c in cases],
+        [c[2] for c in cases],
+        [c[3] for c in cases],
+        has_l4=[c[2] != 0 for c in cases],
+    )
+    np.testing.assert_array_equal(np.asarray(dec), np.asarray(v.decision))
+    np.testing.assert_array_equal(np.asarray(red), np.asarray(v.l7_redirect))
+
+
+def test_redirect_flag_semantics():
+    engine, idents = _world()
+    # a → b on 8080/TCP goes through the HTTP filter → redirect.
+    v = engine.verdicts([idents["b"].id], [idents["a"].id], [8080], [PROTO_TCP])
+    assert int(v.decision[0]) == 1 and bool(v.l7_redirect[0])
+    # a → b at L3 (a has a plain L3 allow): allowed, and the 8080 allow
+    # still redirects because the exact entry wins in the datapath.
+    v = engine.verdicts([idents["b"].id], [idents["a"].id], [0], [PROTO_TCP], has_l4=[False])
+    assert int(v.decision[0]) == 1 and not bool(v.l7_redirect[0])
+    # c → b on 80/TCP: plain L4 allow, no parser on that port → no redirect.
+    v = engine.verdicts([idents["b"].id], [idents["c"].id], [80], [PROTO_TCP])
+    assert int(v.decision[0]) == 1 and not bool(v.l7_redirect[0])
+
+
+def test_policymap_snapshot_entries():
+    engine, idents = _world()
+    compiled = engine.refresh()
+    tables, snaps = materialize_endpoints(
+        compiled, engine.device_policy, [idents["b"].id]
+    )
+    entries = snaps[0].entries
+    a, c = idents["a"].id, idents["c"].id
+    assert PolicyKey(a, 0, 0, 0) in entries  # L3-only allow for a
+    assert entries[PolicyKey(a, 8080, 6, 0)] == 1  # exact entry, redirect
+    assert entries[PolicyKey(c, 80, 6, 0)] == 0  # exact entry, no redirect
+    assert PolicyKey(c, 0, 0, 0) not in entries

@@ -1,0 +1,412 @@
+"""Differential tests: device verdict engine vs the host oracle.
+
+The contract (SURVEY.md §7 step 2-3): the scalar Repository evaluator is
+the oracle; the compiled TPU engine must agree on every (src, dst, port,
+proto, direction) — the same role pkg/policy/*_test.go verdict tables
+play in the reference, plus randomized differential coverage the
+reference lacks.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from cilium_tpu.engine import PROTO_TCP, PROTO_UDP, PolicyEngine
+from cilium_tpu.identity import IdentityRegistry
+from cilium_tpu.labels import parse_label_array
+from cilium_tpu.policy.api import (
+    CIDRRule,
+    EndpointSelector,
+    HTTPRule,
+    IngressRule,
+    EgressRule,
+    KafkaRule,
+    L7Rules,
+    MatchExpression,
+    PortProtocol,
+    PortRule,
+    Rule,
+    rule,
+)
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.search import Decision, PortContext, SearchContext
+
+
+def _engine(rules, label_sets):
+    repo = Repository()
+    repo.add_list(list(rules))
+    reg = IdentityRegistry()
+    idents = [reg.allocate(parse_label_array(ls)) for ls in label_sets]
+    return PolicyEngine(repo, reg), repo, idents
+
+
+_PROTO_NAME = {PROTO_TCP: "TCP", PROTO_UDP: "UDP"}
+
+
+def _check_all(engine: PolicyEngine, repo: Repository, idents, ports=(0,)):
+    """Assert oracle == engine over the full (src, dst, port, dir) cube."""
+    cases = []
+    for src in idents:
+        for dst in idents:
+            for port in ports:
+                for proto in (PROTO_TCP, PROTO_UDP):
+                    cases.append((src, dst, port, proto, True))
+                    cases.append((src, dst, port, proto, False))
+    for ingress in (True, False):
+        sel = [c for c in cases if c[4] == ingress]
+        subj = [(c[1] if ingress else c[0]).id for c in sel]
+        peer = [(c[0] if ingress else c[1]).id for c in sel]
+        dports = [c[2] for c in sel]
+        protos = [c[3] for c in sel]
+        has_l4 = [c[2] != 0 for c in sel]
+        got = engine.verdicts(subj, peer, dports, protos, ingress=ingress, has_l4=has_l4)
+        for i, (src, dst, port, proto, _) in enumerate(sel):
+            dp = (PortContext(port, _PROTO_NAME[proto]),) if port else ()
+            ctx = SearchContext(src=src.labels, dst=dst.labels, dports=dp)
+            want = repo.allows_ingress(ctx) if ingress else repo.allows_egress(ctx)
+            got_i = int(got.decision[i])
+            assert got_i == int(want), (
+                f"{'ingress' if ingress else 'egress'} {src.labels.to_strings()} -> "
+                f"{dst.labels.to_strings()} port {port}/{proto}: "
+                f"oracle={want!s} engine={got_i}"
+            )
+            if port == 0:
+                ctx2 = SearchContext(src=src.labels, dst=dst.labels)
+                want_l3 = (
+                    repo.can_reach_ingress(ctx2) if ingress else repo.can_reach_egress(ctx2)
+                )
+                assert int(got.l3[i]) == int(want_l3)
+
+
+LBL = {
+    "a": ["k8s:app=a"],
+    "b": ["k8s:app=b"],
+    "c": ["k8s:app=c", "k8s:tier=backend"],
+    "d": ["k8s:app=d", "k8s:env=prod"],
+}
+
+
+class TestL3:
+    def test_simple_allow(self):
+        engine, repo, idents = _engine(
+            [rule(LBL["b"], ingress=[IngressRule(from_endpoints=(EndpointSelector.make(["k8s:app=a"]),))])],
+            [LBL["a"], LBL["b"], LBL["c"]],
+        )
+        _check_all(engine, repo, idents)
+
+    def test_requires_denies(self):
+        # b requires peers to carry env=prod; a lacks it, d has it.
+        engine, repo, idents = _engine(
+            [
+                rule(
+                    LBL["b"],
+                    ingress=[
+                        IngressRule(from_requires=(EndpointSelector.make(["k8s:env=prod"]),)),
+                        IngressRule(from_endpoints=(EndpointSelector.wildcard(),)),
+                    ],
+                )
+            ],
+            [LBL["a"], LBL["b"], LBL["d"]],
+        )
+        _check_all(engine, repo, idents)
+
+    def test_entities_and_reserved(self):
+        engine, repo, idents = _engine(
+            [rule(LBL["b"], ingress=[IngressRule(from_entities=("host",))])],
+            [LBL["a"], LBL["b"], ["reserved:host"], ["reserved:world"]],
+        )
+        _check_all(engine, repo, idents)
+
+    def test_match_expressions(self):
+        sel = EndpointSelector(
+            match_expressions=(
+                MatchExpression(key="k8s:tier", operator="Exists"),
+                MatchExpression(key="k8s:app", operator="NotIn", values=("d",)),
+            )
+        )
+        engine, repo, idents = _engine(
+            [rule(LBL["b"], ingress=[IngressRule(from_endpoints=(sel,))])],
+            [LBL["a"], LBL["b"], LBL["c"], LBL["d"]],
+        )
+        _check_all(engine, repo, idents)
+
+    def test_egress_direction(self):
+        engine, repo, idents = _engine(
+            [rule(LBL["a"], egress=[EgressRule(to_endpoints=(EndpointSelector.make(["k8s:app=b"]),))])],
+            [LBL["a"], LBL["b"], LBL["c"]],
+        )
+        _check_all(engine, repo, idents)
+
+
+class TestL4:
+    def test_port_allow(self):
+        engine, repo, idents = _engine(
+            [
+                rule(
+                    LBL["b"],
+                    ingress=[
+                        IngressRule(
+                            from_endpoints=(EndpointSelector.make(["k8s:app=a"]),),
+                            to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),)),),
+                        )
+                    ],
+                )
+            ],
+            [LBL["a"], LBL["b"], LBL["c"]],
+        )
+        _check_all(engine, repo, idents, ports=(0, 80, 443))
+
+    def test_wildcard_peer_l4(self):
+        engine, repo, idents = _engine(
+            [
+                rule(
+                    LBL["b"],
+                    ingress=[IngressRule(to_ports=(PortRule(ports=(PortProtocol(53, "ANY"),)),))],
+                )
+            ],
+            [LBL["a"], LBL["b"]],
+        )
+        _check_all(engine, repo, idents, ports=(0, 53, 80))
+
+    def test_requires_fold_into_l4(self):
+        # L4 allow from a wildcard peer, but requirements constrain it.
+        engine, repo, idents = _engine(
+            [
+                rule(
+                    LBL["b"],
+                    ingress=[
+                        IngressRule(from_requires=(EndpointSelector.make(["k8s:env=prod"]),)),
+                        IngressRule(
+                            from_endpoints=(EndpointSelector.wildcard(),),
+                            to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),)),),
+                        ),
+                    ],
+                )
+            ],
+            [LBL["a"], LBL["b"], LBL["d"]],
+        )
+        _check_all(engine, repo, idents, ports=(0, 80))
+
+    def test_entity_peer_exempt_from_requires(self):
+        engine, repo, idents = _engine(
+            [
+                rule(
+                    LBL["b"],
+                    ingress=[
+                        IngressRule(from_requires=(EndpointSelector.make(["k8s:env=prod"]),)),
+                        IngressRule(
+                            from_entities=("host",),
+                            to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),)),),
+                        ),
+                    ],
+                )
+            ],
+            [LBL["a"], LBL["b"], ["reserved:host"]],
+        )
+        _check_all(engine, repo, idents, ports=(0, 80))
+
+
+class TestCIDR:
+    def test_cidr_identity_l3(self):
+        engine, repo, idents = _engine(
+            [
+                rule(
+                    LBL["b"],
+                    ingress=[IngressRule(from_cidr=("10.0.0.0/8",))],
+                    egress=[EgressRule(to_cidr_set=(CIDRRule("192.168.0.0/16", ("192.168.10.0/24",)),))],
+                )
+            ],
+            [
+                LBL["a"],
+                LBL["b"],
+                ["cidr:10.1.2.3/32"],  # inside 10/8 — needs covering-prefix labels
+            ],
+        )
+        # CIDR identities carry labels for every covering prefix.
+        from cilium_tpu.labels import LabelArray
+        from cilium_tpu.labels.cidr import cidr_labels
+
+        reg = IdentityRegistry()
+        ids = [
+            reg.allocate(parse_label_array(LBL["a"])),
+            reg.allocate(parse_label_array(LBL["b"])),
+            reg.allocate(LabelArray(cidr_labels("10.1.2.3/32")), local=True),
+            reg.allocate(LabelArray(cidr_labels("192.168.10.5/32")), local=True),
+            reg.allocate(LabelArray(cidr_labels("192.168.99.5/32")), local=True),
+        ]
+        engine = PolicyEngine(repo, reg)
+        _check_all(engine, repo, ids)
+
+
+class TestWildcardL3L4:
+    def test_l3_only_wildcards_l7_filter(self):
+        """An L3-only allow + an L7 filter on the same subject: when L3
+        is requires-denied, the L7 filter's endpoint extension decides
+        (repository.go wildcardL3L4Rules)."""
+        http = L7Rules(http=(HTTPRule(method="GET"),))
+        engine, repo, idents = _engine(
+            [
+                rule(
+                    LBL["b"],
+                    ingress=[
+                        IngressRule(from_requires=(EndpointSelector.make(["k8s:env=prod"]),)),
+                        IngressRule(from_endpoints=(EndpointSelector.make(["k8s:app=a"]),)),
+                        IngressRule(
+                            from_endpoints=(EndpointSelector.make(["k8s:app=d"]),),
+                            to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),), rules=http),),
+                        ),
+                    ],
+                )
+            ],
+            [LBL["a"], LBL["b"], LBL["d"]],
+        )
+        _check_all(engine, repo, idents, ports=(0, 80, 443))
+
+    def test_l4_only_rule_wildcards_same_port(self):
+        http = L7Rules(http=(HTTPRule(path="/admin"),))
+        engine, repo, idents = _engine(
+            [
+                rule(
+                    LBL["b"],
+                    ingress=[
+                        IngressRule(from_requires=(EndpointSelector.make(["k8s:env=prod"]),)),
+                        IngressRule(
+                            from_endpoints=(EndpointSelector.make(["k8s:app=a"]),),
+                            to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),)),),
+                        ),
+                        IngressRule(
+                            from_endpoints=(EndpointSelector.make(["k8s:app=d"]),),
+                            to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),), rules=http),),
+                        ),
+                    ],
+                )
+            ],
+            [LBL["a"], LBL["b"], LBL["d"]],
+        )
+        _check_all(engine, repo, idents, ports=(0, 80))
+
+
+class TestIncremental:
+    def test_revision_refresh(self):
+        engine, repo, idents = _engine(
+            [rule(LBL["b"], ingress=[IngressRule(from_endpoints=(EndpointSelector.make(["k8s:app=a"]),))])],
+            [LBL["a"], LBL["b"]],
+        )
+        a, b = idents
+        assert engine.verdict_one(b.id, a.id, l4=False)[0] == 1
+        repo.delete_by_labels(parse_label_array([]))  # no-op, keeps revision
+        repo.add_list(
+            [rule(LBL["b"], ingress=[IngressRule(from_endpoints=(EndpointSelector.make(["k8s:app=c"]),))])]
+        )
+        # engine refreshes on next query; old allow still present
+        assert engine.verdict_one(b.id, a.id, l4=False)[0] == 1
+        _check_all(engine, repo, idents)
+
+    def test_identity_growth(self):
+        engine, repo, idents = _engine(
+            [rule(LBL["b"], ingress=[IngressRule(from_endpoints=(EndpointSelector.make(["k8s:app=a"]),))])],
+            [LBL["a"], LBL["b"]],
+        )
+        reg = engine.registry
+        new = reg.allocate(parse_label_array(["k8s:app=a", "k8s:extra=1"]))
+        assert engine.verdict_one(idents[1].id, new.id, l4=False)[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# Randomized differential property test
+
+
+_KEYS = ["app", "tier", "env", "zone"]
+_VALS = ["a", "b", "c", "d"]
+
+
+def _rand_label_set(rng):
+    n = rng.randint(1, 3)
+    keys = rng.sample(_KEYS, n)
+    return [f"k8s:{k}={rng.choice(_VALS)}" for k in keys]
+
+
+def _rand_selector(rng):
+    roll = rng.random()
+    if roll < 0.15:
+        return EndpointSelector.wildcard()
+    if roll < 0.75:
+        return EndpointSelector.make(_rand_label_set(rng))
+    ops = [
+        MatchExpression(key=f"k8s:{rng.choice(_KEYS)}", operator="Exists"),
+        MatchExpression(
+            key=f"k8s:{rng.choice(_KEYS)}", operator="In",
+            values=tuple(rng.sample(_VALS, rng.randint(1, 2))),
+        ),
+        MatchExpression(
+            key=f"k8s:{rng.choice(_KEYS)}", operator="NotIn",
+            values=(rng.choice(_VALS),),
+        ),
+        MatchExpression(key=f"k8s:{rng.choice(_KEYS)}", operator="DoesNotExist"),
+    ]
+    return EndpointSelector(match_expressions=tuple(rng.sample(ops, rng.randint(1, 2))))
+
+
+def _rand_port_rule(rng, allow_l7=True):
+    port = rng.choice([0, 53, 80, 443])
+    proto = rng.choice(["TCP", "UDP", "ANY"])
+    l7 = L7Rules()
+    if allow_l7 and port != 0 and rng.random() < 0.3:
+        l7 = L7Rules(http=(HTTPRule(method="GET"),))
+    return PortRule(ports=(PortProtocol(port, proto),), rules=l7)
+
+
+def _rand_ingress(rng):
+    kw = {}
+    if rng.random() < 0.7:
+        kw["from_endpoints"] = tuple(_rand_selector(rng) for _ in range(rng.randint(1, 2)))
+    if rng.random() < 0.25:
+        kw["from_requires"] = (EndpointSelector.make(_rand_label_set(rng)[:1]),)
+    if rng.random() < 0.2:
+        kw["from_cidr"] = (rng.choice(["10.0.0.0/8", "192.168.0.0/16"]),)
+    if rng.random() < 0.15:
+        kw["from_entities"] = (rng.choice(["host", "world", "all"]),)
+    if rng.random() < 0.5:
+        kw["to_ports"] = (_rand_port_rule(rng),)
+    return IngressRule(**kw)
+
+
+def _rand_egress(rng):
+    kw = {}
+    if rng.random() < 0.7:
+        kw["to_endpoints"] = tuple(_rand_selector(rng) for _ in range(rng.randint(1, 2)))
+    if rng.random() < 0.25:
+        kw["to_requires"] = (EndpointSelector.make(_rand_label_set(rng)[:1]),)
+    if rng.random() < 0.2:
+        kw["to_cidr"] = (rng.choice(["10.0.0.0/8", "172.16.0.0/12"]),)
+    if rng.random() < 0.5:
+        kw["to_ports"] = (_rand_port_rule(rng),)
+    return EgressRule(**kw)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_randomized_differential(seed):
+    rng = random.Random(seed)
+    rules = []
+    for _ in range(rng.randint(2, 6)):
+        rules.append(
+            Rule(
+                endpoint_selector=_rand_selector(rng),
+                ingress=tuple(_rand_ingress(rng) for _ in range(rng.randint(0, 2))),
+                egress=tuple(_rand_egress(rng) for _ in range(rng.randint(0, 2))),
+            )
+        )
+    from cilium_tpu.labels import LabelArray
+    from cilium_tpu.labels.cidr import cidr_labels
+
+    repo = Repository()
+    repo.add_list(rules)
+    reg = IdentityRegistry()
+    idents = [reg.allocate(parse_label_array(_rand_label_set(rng))) for _ in range(5)]
+    idents.append(reg.allocate(parse_label_array(["reserved:host"])))
+    idents.append(reg.allocate(LabelArray(cidr_labels("10.1.2.3/32")), local=True))
+    idents.append(reg.allocate(LabelArray(cidr_labels("172.16.5.5/32")), local=True))
+    engine = PolicyEngine(repo, reg)
+    _check_all(engine, repo, idents, ports=(0, 53, 80, 443))
