@@ -44,8 +44,8 @@ from cilium_tpu.policy.repository import Repository
 N_RULES = int(os.environ.get("BENCH_RULES", 10_000))
 N_IDENTITIES = int(os.environ.get("BENCH_IDENTITIES", 2_048))
 N_ENDPOINTS = int(os.environ.get("BENCH_ENDPOINTS", 64))
-BATCH = int(os.environ.get("BENCH_BATCH", 1 << 20))
-ITERS = int(os.environ.get("BENCH_ITERS", 20))
+BATCH = int(os.environ.get("BENCH_BATCH", 1 << 22))
+ITERS = int(os.environ.get("BENCH_ITERS", 10))
 
 
 def build_world(rng: random.Random):
@@ -96,7 +96,7 @@ def main() -> None:
     tables, _snaps = materialize_endpoints(
         compiled, engine.device_policy, ep_ids, ingress=True
     )
-    jax.block_until_ready(tables.ep_l3)
+    jax.block_until_ready(tables.id_allow)
     t_mat = time.time() - t0
 
     # Flow batch (fixed device arrays; realistic mixed ports).

@@ -1,45 +1,55 @@
-"""Lower the policy repository into order-independent dense tables.
+"""Lower the policy repository into order-independent matmul operands.
 
 The reference evaluates verdicts by walking rules in order
 (pkg/policy/repository.go:80-105); the walk is order-independent in
 outcome (a DENIED from any selected rule dominates; otherwise any
-ALLOWED wins; else UNDECIDED), which is what makes a data-parallel
-tensor formulation possible. Per direction we emit:
+ALLOWED wins; else UNDECIDED). That lets the whole rule set compile to
+relations over the *selector axis* S (distinct selectors dedupe
+heavily), evaluated as int8 matmuls on the MXU — per-element gathers
+are pathologically slow on TPU, so nothing downstream of the one
+packed row-gather per flow is data-dependent. Per direction:
 
-- **deny pairs** (subj_sel, req_sel): one per (rule, FromRequires
-  selector). Flow is L3-DENIED iff any pair has subject selected and
-  requirement unmatched by the peer (rule.go:323-345). The same
-  predicate's negation is ``req_ok``, the "all collected requirements
-  hold" term that repository.go:249-261 folds into explicit L4 peer
-  selectors.
-- **allow pairs** (subj_sel, peer_sel): one per (rule, peer selector)
-  for directional rules without ToPorts — the pure-L3 allows, including
-  entity- and CIDR-derived selectors (ingress.go GetSourceEndpointSelectors).
-- **L4 entries** (subj_sel, peer_sel, port, proto, explicit, group):
-  flattened L4Filter contributions (l4.go CreateL4IngressFilter + the
-  merge in rule.go mergeL4IngressPort collapse to an OR over entries).
-  ``explicit`` marks FromEndpoints-derived selectors, which must also
-  satisfy ``req_ok`` (the requirements fold); entity/CIDR selectors and
-  the no-peer wildcard are exempt. ``group`` identifies the directional
-  rule for the peer pre-check (rule.go:133-138: a rule whose peers all
-  fail to match the concrete peer contributes no filters).
-- **group peer table** (group, peer_sel, explicit) + ``group_no_peers``:
-  evaluates that pre-check per flow.
-- **L7-presence entries** (subj_sel, port, group): one per L7-bearing
-  (rule, port). A flow's allow is a proxy redirect iff some L7 entry's
-  subject is selected, the port matches, and its group passes the
-  pre-check — i.e. the merged L4Filter at that port has an l7_parser
-  (l4.go:82 sets parsers only on TCP). This also subsumes
-  wildcardL3L4Rules (repository.go:128-168) on the *decision* path: an
-  extension of an L7 filter's endpoint list by a broader allow never
-  changes a decision (the pre-check that admits the filter already
-  implies a matching L4 entry); it only wildcards which L7 rules apply,
-  which the proxy layer derives separately.
+- ``deny_mat [S,S]``: deny_mat[s1,s2]=1 iff some rule has subject
+  selector s1 and FromRequires selector s2 (rule.go:323-345). Flow is
+  L3-DENIED iff subj∧s1 and ¬(peer∧s2) for some set pair:
+  ``deny = any(subj & ((1-peer) @ deny_matᵀ > 0))``. The negation of
+  deny is ``req_ok``, the "all collected requirements hold" term that
+  repository.go:249-261 folds into explicit L4 peer selectors.
+- ``allow_mat [S,S]``: pure-L3 allows (directional rules without
+  ToPorts), including entity- and CIDR-derived selectors
+  (ingress.go GetSourceEndpointSelectors):
+  ``l3_allow = any(subj & (peer @ allow_matᵀ > 0))``.
+- **port vocab** ``ports/protos [P4]``: distinct (port, proto) keys
+  appearing in any ToPorts (L4PolicyMap's literal "port/proto" keying;
+  a ToPorts port 0 only covers a port-0 query). A flow one-hot-encodes
+  its (dport, proto) against the vocab; a miss means no L4 coverage.
+- **L4 entry relation** over K1 = distinct (subj_sel, port_id) combos:
+  ``s1_mat [S,K1]`` and ``p1_mat [P4,K1]`` activate a combo when the
+  subject matches and the port matches; ``en_mat/ee_mat [K1,S]`` hold
+  the peer selectors reachable from that combo (en = entity/CIDR/
+  wildcard peers, ee = explicit FromEndpoints peers which additionally
+  require req_ok — the requirements fold of rule.go:198-232). This
+  flattens L4Filter creation + merge (l4.go:148, rule.go:46-122) into
+  an OR over (combo, peer) pairs.
+- **group pre-check** (rule.go:133-138: a directional rule whose peers
+  all fail to match the concrete peer contributes no filters):
+  ``gpn_mat/gpe_mat [S,G]`` per-group peer selectors (non-explicit /
+  explicit) + ``group_no_peers [G]``.
+- **L7 presence** over K7 = distinct (subj_sel, port_id) of L7-bearing
+  port rules: ``s7_mat [S,K7]``, ``p7_mat [P4,K7]``, ``g7_mat [G,K7]``
+  (the combo's pre-check group). A flow's L4 allow is a proxy redirect
+  iff some K7 combo activates with its group pre-check passing — i.e.
+  the merged L4Filter at that port has an l7_parser (l4.go:82 sets
+  parsers only on TCP). This subsumes wildcardL3L4Rules
+  (repository.go:128-168) on the *decision* path: extending an L7
+  filter's endpoint list by a broader allow never changes a decision
+  (the pre-check that admits the filter already implies a matching L4
+  entry); it only wildcards which L7 rules apply, which the proxy
+  layer derives separately.
 
-Port matching is literal (a ToPorts port 0 only covers a port-0 query)
-to match L4PolicyMap.covers_context's exact "port/proto" keying.
-Protocols are IANA numbers (u8proto.py), the policymap nexthdr
-encoding (bpf/lib/common.h:180).
+Raw entry lists are kept alongside for host-side consumers (policymap
+slot discovery, debugging). Protocols are IANA numbers (u8proto.py),
+the policymap nexthdr encoding (bpf/lib/common.h:180).
 """
 
 from __future__ import annotations
@@ -82,12 +92,6 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return size
 
 
-def _pad_i32(values: Sequence[int], size: int) -> np.ndarray:
-    out = np.zeros(size, dtype=np.int32)
-    out[: len(values)] = values
-    return out
-
-
 def _pad_bool(values: Sequence[bool], size: int) -> np.ndarray:
     out = np.zeros(size, dtype=bool)
     out[: len(values)] = values
@@ -96,35 +100,37 @@ def _pad_bool(values: Sequence[bool], size: int) -> np.ndarray:
 
 @dataclasses.dataclass
 class DirectionProgram:
-    """Dense tables for one traffic direction (all numpy, padded)."""
+    """Matmul operands for one traffic direction (all numpy, padded to
+    shape buckets so incremental recompiles hit XLA's compile cache).
+    ``s_pad`` is the padded selector-axis size (multiple of 128, =32 ×
+    the packed sel_match word count)."""
 
-    # deny pairs
-    deny_subj: np.ndarray
-    deny_req: np.ndarray
-    deny_valid: np.ndarray
-    # L3 allow pairs
-    allow_subj: np.ndarray
-    allow_peer: np.ndarray
-    allow_valid: np.ndarray
-    # L4 entries
+    s_pad: int
+    # L3 relations
+    deny_mat: np.ndarray  # [S, S] int8
+    allow_mat: np.ndarray  # [S, S] int8
+    # port vocabulary
+    ports: np.ndarray  # [P4] int32 (-1 padding)
+    protos: np.ndarray  # [P4] int32
+    # L4 entry relation over K1 combos
+    s1_mat: np.ndarray  # [S, K1] int8
+    p1_mat: np.ndarray  # [P4, K1] int8
+    en_mat: np.ndarray  # [K1, S] int8  entity/CIDR/wildcard peers
+    ee_mat: np.ndarray  # [K1, S] int8  explicit peers (req_ok-gated)
+    # group pre-check
+    gpn_mat: np.ndarray  # [S, G] int8
+    gpe_mat: np.ndarray  # [S, G] int8
+    group_no_peers: np.ndarray  # [G] bool
+    # L7 presence over K7 combos
+    s7_mat: np.ndarray  # [S, K7] int8
+    p7_mat: np.ndarray  # [P4, K7] int8
+    g7_mat: np.ndarray  # [G, K7] int8
+    # raw (unpadded) entry lists for host-side consumers
     e_subj: np.ndarray
-    e_peer: np.ndarray
     e_port: np.ndarray
     e_proto: np.ndarray
-    e_explicit: np.ndarray
-    e_group: np.ndarray
-    e_valid: np.ndarray
-    # group pre-check
-    group_no_peers: np.ndarray  # [G] bool
-    gp_group: np.ndarray
-    gp_sel: np.ndarray
-    gp_explicit: np.ndarray
-    gp_valid: np.ndarray
-    # L7-parser presence (always TCP, l4.go:82)
     l7_subj: np.ndarray
     l7_port: np.ndarray
-    l7_group: np.ndarray
-    l7_valid: np.ndarray
 
 
 @dataclasses.dataclass
@@ -156,9 +162,21 @@ class CompiledPolicy:
         return np.array([self.id_to_row[i] for i in identity_ids], dtype=np.int32)
 
 
+@dataclasses.dataclass
+class _RawDirection:
+    """Intermediate pair/entry lists before matrix packing."""
+
+    deny: List[Tuple[int, int]]
+    allow: List[Tuple[int, int]]
+    entries: List[Tuple[int, int, int, int, bool, int]]
+    group_no_peers: List[bool]
+    gp: List[Tuple[int, int, bool]]
+    l7_ports: List[Tuple[int, int, int]]
+
+
 def _extract_direction(
     rules: Sequence[Rule], table: SelectorTable, ingress: bool
-) -> DirectionProgram:
+) -> _RawDirection:
     deny: List[Tuple[int, int]] = []
     allow: List[Tuple[int, int]] = []
     entries: List[Tuple[int, int, int, int, bool, int]] = []
@@ -214,31 +232,91 @@ def _extract_direction(
                             for sid, expl in peers:
                                 entries.append((subj, sid, pp.port, proto, expl, group))
 
-    nd, na, ne = _bucket(len(deny)), _bucket(len(allow)), _bucket(len(entries))
-    ng, ngp, nl7 = _bucket(len(group_no_peers)), _bucket(len(gp)), _bucket(len(l7_ports))
+    return _RawDirection(deny, allow, entries, group_no_peers, gp, l7_ports)
+
+
+def _pack_direction(raw: _RawDirection, s_pad: int) -> DirectionProgram:
+    deny_mat = np.zeros((s_pad, s_pad), np.int8)
+    for s1, s2 in raw.deny:
+        deny_mat[s1, s2] = 1
+    allow_mat = np.zeros((s_pad, s_pad), np.int8)
+    for s1, s2 in raw.allow:
+        allow_mat[s1, s2] = 1
+
+    # Port vocabulary over entries ∪ L7 ports (L7 is always TCP).
+    port_id: Dict[Tuple[int, int], int] = {}
+    for e in raw.entries:
+        port_id.setdefault((e[2], e[3]), len(port_id))
+    for l in raw.l7_ports:
+        port_id.setdefault((l[1], PROTO_TCP_N), len(port_id))
+    p4 = _bucket(len(port_id))
+    ports = np.full(p4, -1, np.int32)
+    protos = np.full(p4, -1, np.int32)
+    for (port, proto), i in port_id.items():
+        ports[i], protos[i] = port, proto
+
+    # K1 combos: (subj_sel, port_id) with explicit/other peer matrices.
+    combo_id: Dict[Tuple[int, int], int] = {}
+    combo_peers: List[List[Tuple[int, bool]]] = []
+    for subj, sid, port, proto, expl, _group in raw.entries:
+        key = (subj, port_id[(port, proto)])
+        k = combo_id.setdefault(key, len(combo_peers))
+        if k == len(combo_peers):
+            combo_peers.append([])
+        combo_peers[k].append((sid, expl))
+    k1 = _bucket(len(combo_id))
+    s1_mat = np.zeros((s_pad, k1), np.int8)
+    p1_mat = np.zeros((p4, k1), np.int8)
+    en_mat = np.zeros((k1, s_pad), np.int8)
+    ee_mat = np.zeros((k1, s_pad), np.int8)
+    for (subj, pid), k in combo_id.items():
+        s1_mat[subj, k] = 1
+        p1_mat[pid, k] = 1
+        for sid, expl in combo_peers[k]:
+            (ee_mat if expl else en_mat)[k, sid] = 1
+
+    g = _bucket(len(raw.group_no_peers))
+    gpn_mat = np.zeros((s_pad, g), np.int8)
+    gpe_mat = np.zeros((s_pad, g), np.int8)
+    for group, sid, expl in raw.gp:
+        (gpe_mat if expl else gpn_mat)[sid, group] = 1
+    no_peers = _pad_bool(raw.group_no_peers, g)
+
+    # K7 combos: (subj_sel, port_id, group) for L7 presence.
+    k7_ids: Dict[Tuple[int, int, int], int] = {}
+    for subj, port, group in raw.l7_ports:
+        k7_ids.setdefault((subj, port_id[(port, PROTO_TCP_N)], group), len(k7_ids))
+    k7_keys = list(k7_ids)
+    k7 = _bucket(len(k7_keys))
+    s7_mat = np.zeros((s_pad, k7), np.int8)
+    p7_mat = np.zeros((p4, k7), np.int8)
+    g7_mat = np.zeros((g, k7), np.int8)
+    for i, (subj, pid, group) in enumerate(k7_keys):
+        s7_mat[subj, i] = 1
+        p7_mat[pid, i] = 1
+        g7_mat[group, i] = 1
+
     return DirectionProgram(
-        deny_subj=_pad_i32([d[0] for d in deny], nd),
-        deny_req=_pad_i32([d[1] for d in deny], nd),
-        deny_valid=_pad_bool([True] * len(deny), nd),
-        allow_subj=_pad_i32([a[0] for a in allow], na),
-        allow_peer=_pad_i32([a[1] for a in allow], na),
-        allow_valid=_pad_bool([True] * len(allow), na),
-        e_subj=_pad_i32([e[0] for e in entries], ne),
-        e_peer=_pad_i32([e[1] for e in entries], ne),
-        e_port=_pad_i32([e[2] for e in entries], ne),
-        e_proto=_pad_i32([e[3] for e in entries], ne),
-        e_explicit=_pad_bool([e[4] for e in entries], ne),
-        e_group=_pad_i32([e[5] for e in entries], ne),
-        e_valid=_pad_bool([True] * len(entries), ne),
-        group_no_peers=_pad_bool(group_no_peers, ng),
-        gp_group=_pad_i32([g[0] for g in gp], ngp),
-        gp_sel=_pad_i32([g[1] for g in gp], ngp),
-        gp_explicit=_pad_bool([g[2] for g in gp], ngp),
-        gp_valid=_pad_bool([True] * len(gp), ngp),
-        l7_subj=_pad_i32([l[0] for l in l7_ports], nl7),
-        l7_port=_pad_i32([l[1] for l in l7_ports], nl7),
-        l7_group=_pad_i32([l[2] for l in l7_ports], nl7),
-        l7_valid=_pad_bool([True] * len(l7_ports), nl7),
+        s_pad=s_pad,
+        deny_mat=deny_mat,
+        allow_mat=allow_mat,
+        ports=ports,
+        protos=protos,
+        s1_mat=s1_mat,
+        p1_mat=p1_mat,
+        en_mat=en_mat,
+        ee_mat=ee_mat,
+        gpn_mat=gpn_mat,
+        gpe_mat=gpe_mat,
+        group_no_peers=no_peers,
+        s7_mat=s7_mat,
+        p7_mat=p7_mat,
+        g7_mat=g7_mat,
+        e_subj=np.asarray([e[0] for e in raw.entries], np.int32),
+        e_port=np.asarray([e[2] for e in raw.entries], np.int32),
+        e_proto=np.asarray([e[3] for e in raw.entries], np.int32),
+        l7_subj=np.asarray([l[0] for l in raw.l7_ports], np.int32),
+        l7_port=np.asarray([l[1] for l in raw.l7_ports], np.int32),
     )
 
 
@@ -254,11 +332,19 @@ def compile_policy(repo: Repository, registry: IdentityRegistry) -> CompiledPoli
     with repo._lock:
         rules = list(repo.rules)
         revision = repo.revision
-    ingress = _extract_direction(rules, table, ingress=True)
-    egress = _extract_direction(rules, table, ingress=False)
+    raw_ingress = _extract_direction(rules, table, ingress=True)
+    raw_egress = _extract_direction(rules, table, ingress=False)
+
+    # Selector axis padded to a multiple of 128 (MXU tile) — the padded
+    # tail never matches (no conjuncts) and relation matrices are zero
+    # there.
+    s_pad = max(128, ((len(table) + 127) // 128) * 128)
+    ingress = _pack_direction(raw_ingress, s_pad)
+    egress = _pack_direction(raw_egress, s_pad)
 
     vocab = registry.vocab
     lowered = table.lower_bits(vocab)
+    lowered += [[] for _ in range(s_pad - len(lowered))]
     id_bits, row_ids, row_live = registry.dense_view()
     num_words = id_bits.shape[1]
     conj_req, conj_forbid, conj_valid, req_count = table.pack(lowered, vocab, num_words)

@@ -27,8 +27,13 @@ import jax
 import jax.numpy as jnp
 
 
-def _unpack_bits_u32(words: jnp.ndarray) -> jnp.ndarray:
-    """[..., W] uint32 → [..., W*32] int8 (bit 0 of word 0 first)."""
+def unpack_bits_u32(words: jnp.ndarray) -> jnp.ndarray:
+    """[..., W] uint32 → [..., W*32] int8 (bit 0 of word 0 first).
+
+    The single definition of the packed-bitmap bit order — the inverse
+    of pack_bool_bits — shared by the selector-match, verdict, and
+    policymap-lookup kernels.
+    """
     shifts = jnp.arange(32, dtype=jnp.uint32)
     bits = (words[..., None] >> shifts) & jnp.uint32(1)
     return bits.reshape(*words.shape[:-1], words.shape[-1] * 32).astype(jnp.int8)
@@ -66,8 +71,8 @@ def compute_selector_matches(
     s, cps, _ = conj_req.shape
     l = w * 32
 
-    req_t = _unpack_bits_u32(conj_req.reshape(s * cps, w)).T  # [L, S*CPS] int8
-    forbid_t = _unpack_bits_u32(conj_forbid.reshape(s * cps, w)).T
+    req_t = unpack_bits_u32(conj_req.reshape(s * cps, w)).T  # [L, S*CPS] int8
+    forbid_t = unpack_bits_u32(conj_forbid.reshape(s * cps, w)).T
     req_n = req_count.reshape(1, s * cps)
     valid = conj_valid.reshape(1, s * cps)
 
@@ -76,7 +81,7 @@ def compute_selector_matches(
     chunks = padded.reshape(-1, row_chunk, w)
 
     def one_chunk(chunk_words: jnp.ndarray) -> jnp.ndarray:
-        bits = _unpack_bits_u32(chunk_words)  # [chunk, L] int8
+        bits = unpack_bits_u32(chunk_words)  # [chunk, L] int8
         hit_req = jax.lax.dot_general(
             bits, req_t, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.int32,

@@ -3,14 +3,15 @@
 The TPU replacement for the reference's hottest control-plane loop,
 computeDesiredL3PolicyMapEntries (pkg/endpoint/policy.go:317-389): for
 every local endpoint, evaluate the full policy for *every known
-identity* (and every L4 slot) and emit the dense lookup tables of
-ops/lookup.py plus host-visible policymap entries (pkg/maps/policymap
-key format) for the datapath front-end.
+identity* (and every L4 slot) and emit the column-bitmap lookup tables
+of ops/lookup.py plus host-visible policymap entries
+(pkg/maps/policymap key format) for the datapath front-end.
 
 The whole sweep — endpoints × identities × (L3 + each L4 slot) — is
 flattened into ONE batched device call, so a full regeneration costs a
 single dispatch regardless of endpoint count (the reference pays a
-per-endpoint per-identity Go loop; we pay one kernel launch).
+per-endpoint per-identity Go loop; we pay one kernel launch of int8
+matmuls).
 """
 
 from __future__ import annotations
@@ -61,12 +62,14 @@ def _endpoint_slots(compiled: CompiledPolicy, subj_sel_row: np.ndarray, ingress:
         return (subj_sel_row[sids >> 5] >> (sids & 31)) & 1
 
     slots = set()
-    valid = d.e_valid & (sel_hit(d.e_subj.astype(np.int64)) == 1)
-    for port, proto in zip(d.e_port[valid], d.e_proto[valid]):
-        slots.add((int(port), int(proto)))
-    lv = d.l7_valid & (sel_hit(d.l7_subj.astype(np.int64)) == 1)
-    for port in d.l7_port[lv]:
-        slots.add((int(port), 6))
+    if d.e_subj.size:
+        hit = sel_hit(d.e_subj.astype(np.int64)) == 1
+        for port, proto in zip(d.e_port[hit], d.e_proto[hit]):
+            slots.add((int(port), int(proto)))
+    if d.l7_subj.size:
+        hit = sel_hit(d.l7_subj.astype(np.int64)) == 1
+        for port in d.l7_port[hit]:
+            slots.add((int(port), 6))
     return sorted(slots)
 
 
@@ -76,11 +79,9 @@ def materialize_endpoints(
     endpoint_identity_ids: Sequence[int],
     *,
     ingress: bool = True,
-    slot_bucket: int = 8,
-    block: int = 65536,
+    block: int = 8192,
 ) -> Tuple[PolicymapTables, List[EndpointPolicySnapshot]]:
     n = compiled.id_bits.shape[0]
-    nw = (n + 31) // 32
     ep_rows = compiled.rows_for(endpoint_identity_ids)
     sel_match_host = np.asarray(device.sel_match)
     live = compiled.row_live
@@ -90,36 +91,30 @@ def materialize_endpoints(
     ep_slots: List[List[Tuple[int, int]]] = [
         _endpoint_slots(compiled, sel_match_host[row], ingress) for row in ep_rows
     ]
-    seg_subj: List[np.ndarray] = []
+    seg_row: List[int] = []
     seg_port: List[int] = []
     seg_proto: List[int] = []
     seg_l4: List[bool] = []
     for e, row in enumerate(ep_rows):
-        seg_subj.append(np.full(n, row, np.int32))
+        seg_row.append(int(row))
         seg_port.append(0)
         seg_proto.append(0)
         seg_l4.append(False)
         for port, proto in ep_slots[e]:
-            seg_subj.append(np.full(n, row, np.int32))
+            seg_row.append(int(row))
             seg_port.append(port)
             seg_proto.append(proto)
             seg_l4.append(True)
 
-    n_seg = len(seg_subj)
+    n_seg = len(seg_row)
     all_rows = np.arange(n, dtype=np.int32)
-    subj = np.concatenate(seg_subj)
-    peer = np.tile(all_rows, n_seg)
-    dport = np.repeat(np.asarray(seg_port, np.int32), n)
-    proto = np.repeat(np.asarray(seg_proto, np.int32), n)
-    has_l4 = np.repeat(np.asarray(seg_l4, bool), n)
-
     v = verdict_batch(
         device,
-        jnp.asarray(subj),
-        jnp.asarray(peer),
-        jnp.asarray(dport),
-        jnp.asarray(proto),
-        jnp.asarray(has_l4),
+        jnp.asarray(np.repeat(np.asarray(seg_row, np.int32), n)),
+        jnp.asarray(np.tile(all_rows, n_seg)),
+        jnp.asarray(np.repeat(np.asarray(seg_port, np.int32), n)),
+        jnp.asarray(np.repeat(np.asarray(seg_proto, np.int32), n)),
+        jnp.asarray(np.repeat(np.asarray(seg_l4, bool), n)),
         ingress=ingress,
         block=block,
     )
@@ -127,65 +122,61 @@ def materialize_endpoints(
     l3d = np.asarray(v.l3).reshape(n_seg, n)
     red = np.asarray(v.l7_redirect).reshape(n_seg, n)
 
-    ep_l3_bits: List[np.ndarray] = []
-    slot_meta: List[List[Tuple[int, int, int]]] = []
+    # Column layout: one column per (endpoint, L3) + (endpoint, slot).
+    col_ep: List[int] = []
+    col_port: List[int] = []
+    col_proto: List[int] = []
+    col_is_l3: List[bool] = []
     col_allow: List[np.ndarray] = []
-    col_redirect: List[np.ndarray] = []
+    col_red: List[np.ndarray] = []
     snapshots: List[EndpointPolicySnapshot] = []
 
     seg = 0
     for e, row in enumerate(ep_rows):
         l3_allow = (l3d[seg] == 1) & live
         seg += 1
-        ep_l3_bits.append(l3_allow)
+        col_ep.append(e)
+        col_port.append(0)
+        col_proto.append(0)
+        col_is_l3.append(True)
+        col_allow.append(l3_allow)
+        col_red.append(np.zeros(n, bool))
         entries: Dict[PolicyKey, int] = {}
         for r_idx in np.nonzero(l3_allow)[0]:
             entries[PolicyKey(int(compiled.row_ids[r_idx]), 0, 0, direction)] = 0
-        meta: List[Tuple[int, int, int]] = []
         for port, proto_n in ep_slots[e]:
             allow = (dec[seg] == ALLOW) & live
             redirect = red[seg] & live
             seg += 1
-            col = len(col_allow)
+            col_ep.append(e)
+            col_port.append(port)
+            col_proto.append(proto_n)
+            col_is_l3.append(False)
             col_allow.append(allow)
-            col_redirect.append(redirect)
-            meta.append((port, proto_n, col))
+            col_red.append(redirect)
             # Exact {id, port, proto} entries: the datapath consults the
             # exact key first (bpf/lib/policy.h:46), so L3-allowed
             # identities still need one when the filter redirects.
             for r_idx in np.nonzero(allow & (~l3_allow | redirect))[0]:
                 key = PolicyKey(int(compiled.row_ids[r_idx]), port, proto_n, direction)
                 entries[key] = int(redirect[r_idx])
-        slot_meta.append(meta)
         snapshots.append(EndpointPolicySnapshot(entries=entries, slots=ep_slots[e]))
 
-    # Pack device tables.
-    ep = len(ep_rows)
-    k = slot_bucket
-    while any(len(m) > k for m in slot_meta):
-        k *= 2
-    ncols = max(1, len(col_allow))
-    slot_port = np.zeros((ep, k), np.int32)
-    slot_proto = np.zeros((ep, k), np.int32)
-    slot_col = np.zeros((ep, k), np.int32)
-    slot_valid = np.zeros((ep, k), bool)
-    for e, meta in enumerate(slot_meta):
-        for j, (port, proto_n, col) in enumerate(meta):
-            slot_port[e, j], slot_proto[e, j], slot_col[e, j] = port, proto_n, col
-            slot_valid[e, j] = True
-
-    def pack_rows(rows: List[np.ndarray], count: int) -> jnp.ndarray:
-        if not rows:
-            return jnp.zeros((count, nw), jnp.uint32)
-        return pack_bool_bits(jnp.asarray(np.stack(rows)))
+    c = len(col_ep)
+    c_pad = max(32, ((c + 31) // 32) * 32)
+    pad = c_pad - c
+    allow_nc = np.zeros((n, c_pad), bool)
+    red_nc = np.zeros((n, c_pad), bool)
+    if c:
+        allow_nc[:, :c] = np.stack(col_allow, axis=1)
+        red_nc[:, :c] = np.stack(col_red, axis=1)
 
     tables = PolicymapTables(
-        ep_l3=pack_rows(ep_l3_bits, ep),
-        slot_port=jnp.asarray(slot_port),
-        slot_proto=jnp.asarray(slot_proto),
-        slot_col=jnp.asarray(slot_col),
-        slot_valid=jnp.asarray(slot_valid),
-        col_allow=pack_rows(col_allow, ncols),
-        col_redirect=pack_rows(col_redirect, ncols),
+        col_ep=jnp.asarray(np.pad(np.asarray(col_ep, np.int32), (0, pad), constant_values=-1)),
+        col_port=jnp.asarray(np.pad(np.asarray(col_port, np.int32), (0, pad))),
+        col_proto=jnp.asarray(np.pad(np.asarray(col_proto, np.int32), (0, pad))),
+        col_is_l3=jnp.asarray(np.pad(np.asarray(col_is_l3, bool), (0, pad))),
+        id_allow=pack_bool_bits(jnp.asarray(allow_nc)),
+        id_redirect=pack_bool_bits(jnp.asarray(red_nc)),
     )
     return tables, snapshots

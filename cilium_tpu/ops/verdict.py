@@ -1,34 +1,38 @@
-"""Batched policy-verdict kernel.
+"""Batched policy-verdict kernel (matmul formulation).
 
 Evaluates, entirely on device, the verdict semantics of
 pkg/policy/repository.go AllowsIngressRLocked/AllowsEgressRLocked for a
 batch of flows (subject identity row, peer identity row, dport, proto):
 
-    deny      = any deny-pair (subject selected & requirement unmatched)
-    l3_allow  = any allow-pair (subject selected & peer matched)
-    req_ok    = ¬deny                       # folded-requirements term
-    l4_allow  = any L4 entry | any wildcard-L3L4 entry
-    verdict   = ALLOW  if l3_allow & ¬deny
-              | ALLOW  if flow has L4 context & l4_allow
+    deny      = any(subj ∧ ((1-peer) @ deny_matᵀ > 0))
+    l3_allow  = any(subj ∧ (peer @ allow_matᵀ > 0))
+    req_ok    = ¬deny                        # folded-requirements term
+    combo     = (subj @ s1) ∧ (port_onehot @ p1)
+    l4_allow  = any(combo ∧ peer@enᵀ) | req_ok ∧ any(combo ∧ peer@eeᵀ)
+    l7_present= any((subj @ s7) ∧ (port @ p7) ∧ (group_ok @ g7))
+    verdict   = ALLOW  if l3_allow ∧ ¬deny
+              | ALLOW  if flow has L4 context ∧ l4_allow
               | DENY   otherwise
 
-All selector tests are single-gather bit probes into the precomputed
-``sel_match`` matrix (ops/bitmap.py), so per-flow cost is a fixed set
-of gathers + reductions — no data-dependent control flow, fully
-batchable and shardable.
+Per flow the only data-dependent access is ONE packed row-gather from
+``sel_match`` (an embedding lookup); everything else is int8 matmuls on
+the MXU plus elementwise logic on the VPU. This is deliberate: TPU
+executes per-element dynamic gathers essentially serially, so the
+earlier gather-per-(flow, rule-pair) formulation ran ~1000× slower than
+this one.
 """
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 
 import chex
 import jax
 import jax.numpy as jnp
 
-from ..compiler.program import CompiledPolicy, DirectionProgram
+from ..compiler.program import DirectionProgram
 from ..policy.search import Decision
+from .bitmap import unpack_bits_u32
 
 ALLOW = int(Decision.ALLOWED)
 DENY = int(Decision.DENIED)
@@ -38,8 +42,8 @@ DENY = int(Decision.DENIED)
 class Verdict:
     """Per-flow results. ``decision``: 1 allow / 2 deny. ``l3`` is the
     pure-L3 stage decision (0 undecided / 1 allowed / 2 denied) used by
-    the policymap materializer; ``l7_redirect`` flags flows whose allow
-    came only from L7-bearing entries (proxy redirect candidates)."""
+    the policymap materializer; ``l7_redirect`` flags flows whose L4
+    allow passes through a parser-bearing filter (proxy redirect)."""
 
     decision: jnp.ndarray
     l3: jnp.ndarray
@@ -48,37 +52,43 @@ class Verdict:
 
 @chex.dataclass(frozen=True)
 class DeviceTables:
-    """DirectionProgram as device arrays (a pytree leaf bundle)."""
+    """DirectionProgram matrices as device arrays. Transposed copies of
+    the peer-side relations are stored so the kernel's contractions all
+    run with the contracted axis leading (no per-call transpose)."""
 
-    deny_subj: jnp.ndarray
-    deny_req: jnp.ndarray
-    deny_valid: jnp.ndarray
-    allow_subj: jnp.ndarray
-    allow_peer: jnp.ndarray
-    allow_valid: jnp.ndarray
-    e_subj: jnp.ndarray
-    e_peer: jnp.ndarray
-    e_port: jnp.ndarray
-    e_proto: jnp.ndarray
-    e_explicit: jnp.ndarray
-    e_group: jnp.ndarray
-    e_valid: jnp.ndarray
-    group_no_peers: jnp.ndarray
-    gp_group: jnp.ndarray
-    gp_sel: jnp.ndarray
-    gp_explicit: jnp.ndarray
-    gp_valid: jnp.ndarray
-    l7_subj: jnp.ndarray
-    l7_port: jnp.ndarray
-    l7_group: jnp.ndarray
-    l7_valid: jnp.ndarray
+    deny_t: jnp.ndarray  # [S, S]  deny_matᵀ
+    allow_t: jnp.ndarray  # [S, S]  allow_matᵀ
+    ports: jnp.ndarray  # [P4]
+    protos: jnp.ndarray  # [P4]
+    s1_mat: jnp.ndarray  # [S, K1]
+    p1_mat: jnp.ndarray  # [P4, K1]
+    en_t: jnp.ndarray  # [S, K1]  en_matᵀ
+    ee_t: jnp.ndarray  # [S, K1]  ee_matᵀ
+    gpn_mat: jnp.ndarray  # [S, G]
+    gpe_mat: jnp.ndarray  # [S, G]
+    group_no_peers: jnp.ndarray  # [G]
+    s7_mat: jnp.ndarray  # [S, K7]
+    p7_mat: jnp.ndarray  # [P4, K7]
+    g7_mat: jnp.ndarray  # [G, K7]
 
     @classmethod
     def from_host(cls, d: DirectionProgram) -> "DeviceTables":
-        return cls(**{
-            f.name: jnp.asarray(getattr(d, f.name))
-            for f in dataclasses.fields(DirectionProgram)
-        })
+        return cls(
+            deny_t=jnp.asarray(d.deny_mat.T),
+            allow_t=jnp.asarray(d.allow_mat.T),
+            ports=jnp.asarray(d.ports),
+            protos=jnp.asarray(d.protos),
+            s1_mat=jnp.asarray(d.s1_mat),
+            p1_mat=jnp.asarray(d.p1_mat),
+            en_t=jnp.asarray(d.en_mat.T),
+            ee_t=jnp.asarray(d.ee_mat.T),
+            gpn_mat=jnp.asarray(d.gpn_mat),
+            gpe_mat=jnp.asarray(d.gpe_mat),
+            group_no_peers=jnp.asarray(d.group_no_peers),
+            s7_mat=jnp.asarray(d.s7_mat),
+            p7_mat=jnp.asarray(d.p7_mat),
+            g7_mat=jnp.asarray(d.g7_mat),
+        )
 
 
 @chex.dataclass(frozen=True)
@@ -86,20 +96,19 @@ class DevicePolicy:
     """Fully device-resident compiled policy."""
 
     id_bits: jnp.ndarray  # [N, W] uint32
-    sel_match: jnp.ndarray  # [N, S_words] uint32 (bit-packed over selectors)
+    sel_match: jnp.ndarray  # [N, S/32] uint32 (bit-packed selector matches)
     ingress: DeviceTables
     egress: DeviceTables
 
 
-def _sel_bit(
-    sel_flat: jnp.ndarray, s_words: int, rows: jnp.ndarray, sel_ids: jnp.ndarray
-) -> jnp.ndarray:
-    """[B] rows × [P] selector ids → [B, P] bool membership probes."""
-    word = sel_ids >> 5
-    shift = (sel_ids & 31).astype(jnp.uint32)
-    flat_idx = rows[:, None] * s_words + word[None, :]
-    words = jnp.take(sel_flat, flat_idx, axis=0)
-    return ((words >> shift[None, :]) & jnp.uint32(1)).astype(bool)
+def _mm(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """int8 [B, A] @ int8 [A, C] → bool [B, C] (int32 accumulate)."""
+    return (
+        jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+        )
+        > 0
+    )
 
 
 def _verdict_block(
@@ -111,57 +120,34 @@ def _verdict_block(
     proto: jnp.ndarray,
     has_l4: jnp.ndarray,
 ) -> Verdict:
-    s_words = sel_match.shape[1]
-    sf = sel_match.reshape(-1)
-    b = subj_rows.shape[0]
+    subj8 = unpack_bits_u32(jnp.take(sel_match, subj_rows, axis=0))  # [b, S]
+    peer8 = unpack_bits_u32(jnp.take(sel_match, peer_rows, axis=0))
+    subj_b = subj8.astype(bool)
 
-    deny = (
-        _sel_bit(sf, s_words, subj_rows, t.deny_subj)
-        & ~_sel_bit(sf, s_words, peer_rows, t.deny_req)
-        & t.deny_valid[None, :]
-    ).any(axis=1)
-    l3_allow = (
-        _sel_bit(sf, s_words, subj_rows, t.allow_subj)
-        & _sel_bit(sf, s_words, peer_rows, t.allow_peer)
-        & t.allow_valid[None, :]
-    ).any(axis=1)
+    deny = (subj_b & _mm(jnp.int8(1) - peer8, t.deny_t)).any(axis=1)
+    l3_allow = (subj_b & _mm(peer8, t.allow_t)).any(axis=1)
     req_ok = ~deny
 
-    peer_hit = _sel_bit(sf, s_words, peer_rows, t.e_peer)
-    entry_ok = (
-        _sel_bit(sf, s_words, subj_rows, t.e_subj)
-        & (dport[:, None] == t.e_port[None, :])
-        & (proto[:, None] == t.e_proto[None, :])
-        & peer_hit
-        & (~t.e_explicit[None, :] | req_ok[:, None])
-        & t.e_valid[None, :]
-    )
-    l4_allow = entry_ok.any(axis=1)
-
-    # Pre-check per directional-rule group (rule.go:133-138): a one-hot
-    # matmul instead of scatter-max (cheaper to compile, MXU-friendly).
-    gp_hit = (
-        _sel_bit(sf, s_words, peer_rows, t.gp_sel)
-        & (~t.gp_explicit[None, :] | req_ok[:, None])
-        & t.gp_valid[None, :]
+    pp = (
+        (dport[:, None] == t.ports[None, :])
+        & (proto[:, None] == t.protos[None, :])
+        & has_l4[:, None]
     ).astype(jnp.int8)
-    g = t.group_no_peers.shape[0]
-    onehot = (t.gp_group[:, None] == jnp.arange(g)[None, :]).astype(jnp.int8)
-    group_ok = (
-        jax.lax.dot_general(
-            gp_hit, onehot, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        )
-        > 0
-    ) | t.group_no_peers[None, :]
 
-    # Merged-filter parser presence at (port, TCP) — the redirect gate.
+    combo = _mm(subj8, t.s1_mat) & _mm(pp, t.p1_mat)  # [b, K1]
+    l4_allow = (combo & _mm(peer8, t.en_t)).any(axis=1) | (
+        req_ok & (combo & _mm(peer8, t.ee_t)).any(axis=1)
+    )
+
+    group_ok = (
+        _mm(peer8, t.gpn_mat)
+        | (_mm(peer8, t.gpe_mat) & req_ok[:, None])
+        | t.group_no_peers[None, :]
+    )  # [b, G]
     l7_present = (
-        _sel_bit(sf, s_words, subj_rows, t.l7_subj)
-        & (dport[:, None] == t.l7_port[None, :])
-        & (proto[:, None] == jnp.int32(6))
-        & jnp.take(group_ok, t.l7_group, axis=1)
-        & t.l7_valid[None, :]
+        _mm(subj8, t.s7_mat)
+        & _mm(pp, t.p7_mat)
+        & _mm(group_ok.astype(jnp.int8), t.g7_mat)
     ).any(axis=1)
 
     l3 = jnp.where(deny, jnp.int8(2), jnp.where(l3_allow, jnp.int8(1), jnp.int8(0)))
@@ -184,13 +170,13 @@ def verdict_batch(
     subj_rows: jnp.ndarray,  # [B] int32 identity rows
     peer_rows: jnp.ndarray,  # [B] int32
     dport: jnp.ndarray,  # [B] int32 (with has_l4)
-    proto: jnp.ndarray,  # [B] int32 IANA proto (6/17)
+    proto: jnp.ndarray,  # [B] int32 IANA proto (u8proto)
     has_l4: jnp.ndarray,  # [B] bool — False = pure-L3 query
     ingress: bool = True,
-    block: int = 4096,
+    block: int = 8192,
 ) -> Verdict:
     """Batch verdicts; blocks the batch with lax.map to bound the
-    [block, table_len] gather intermediates."""
+    [block, S] activation footprint."""
     t = policy.ingress if ingress else policy.egress
     b = subj_rows.shape[0]
     pad = (-b) % block
