@@ -1,0 +1,18 @@
+"""Datapath: the flow-processing pipeline (reference: bpf/ + pkg/datapath).
+
+The kernel-eBPF role collapses here into a batched device pipeline:
+prefilter deny tries → ipcache LPM identity derivation → policymap
+lookup — the XDP hook (bpf/bpf_xdp.c), netdev identity resolution
+(bpf/bpf_netdev.c:376), and per-endpoint policy program
+(bpf/lib/policy.h) as one jitted program over flow batches.
+"""
+
+from .pipeline import DatapathPipeline, DatapathTables, DROP_PREFILTER, DROP_POLICY, FORWARD
+
+__all__ = [
+    "DatapathPipeline",
+    "DatapathTables",
+    "DROP_PREFILTER",
+    "DROP_POLICY",
+    "FORWARD",
+]

@@ -1,0 +1,153 @@
+"""Authoritative userspace IP/CIDR → identity map.
+
+Reference: pkg/ipcache/ipcache.go — `Upsert` with source-priority
+overwrite rules (:183,217), `Delete` (:429), lookups by prefix and by
+identity (:438-493), and listener fan-out (`IPIdentityMappingListener`,
+listener.go) that keeps derived state (the datapath LPM tensors here;
+the BPF ipcache map + Envoy NPHDS in the reference) in sync.
+
+The device view is a pair of stride-8 tries (ops/lpm.py) mapping
+prefixes to identity *rows*; the datapath pipeline rebuilds them via
+``build_device`` when ``version`` moves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import ipaddress
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops.lpm import build_trie
+
+# Source priorities (ipcache.go allowOverwrite: agent-local knowledge
+# beats the kvstore, which beats k8s-derived, which beats generated).
+SOURCE_AGENT = "agent"
+SOURCE_KVSTORE = "kvstore"
+SOURCE_K8S = "k8s"
+SOURCE_GENERATED = "generated"
+_PRIORITY = {SOURCE_AGENT: 3, SOURCE_KVSTORE: 2, SOURCE_K8S: 1, SOURCE_GENERATED: 0}
+
+
+@dataclasses.dataclass(frozen=True)
+class Entry:
+    identity: int
+    source: str
+    host_ip: Optional[str] = None  # tunnel endpoint for remote entries
+
+
+# fn(cidr, old_entry_or_None, new_entry_or_None)
+Listener = Callable[[str, Optional[Entry], Optional[Entry]], None]
+
+
+class IPCache:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._by_prefix: Dict[str, Entry] = {}
+        self._by_identity: Dict[int, set] = {}
+        self._listeners: List[Listener] = []
+        self.version = 0
+
+    # ------------------------------------------------------------------
+    def _norm(self, cidr: str) -> str:
+        if "/" not in cidr:
+            ip = ipaddress.ip_address(cidr)
+            cidr = f"{ip}/{32 if ip.version == 4 else 128}"
+        return str(ipaddress.ip_network(cidr, strict=False))
+
+    def add_listener(self, fn: Listener, replay: bool = True) -> None:
+        """SetListeners (listener fan-out); replay synthesizes the
+        current state like the reference's initial dump."""
+        with self._lock:
+            self._listeners.append(fn)
+            if replay:
+                for cidr, e in self._by_prefix.items():
+                    fn(cidr, None, e)
+
+    def upsert(
+        self,
+        cidr: str,
+        identity: int,
+        source: str,
+        host_ip: Optional[str] = None,
+    ) -> bool:
+        """Returns False when a higher-priority source owns the entry
+        (ipcache.go:183 allowOverwrite)."""
+        key = self._norm(cidr)
+        new = Entry(identity, source, host_ip)
+        with self._lock:
+            old = self._by_prefix.get(key)
+            if old is not None and _PRIORITY[old.source] > _PRIORITY[source]:
+                return False
+            self._by_prefix[key] = new
+            if old is not None:
+                s = self._by_identity.get(old.identity)
+                if s:
+                    s.discard(key)
+            self._by_identity.setdefault(identity, set()).add(key)
+            self.version += 1
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn(key, old, new)
+        return True
+
+    def delete(self, cidr: str, source: str) -> bool:
+        key = self._norm(cidr)
+        with self._lock:
+            old = self._by_prefix.get(key)
+            if old is None or _PRIORITY[old.source] > _PRIORITY[source]:
+                return False
+            del self._by_prefix[key]
+            s = self._by_identity.get(old.identity)
+            if s:
+                s.discard(key)
+            self.version += 1
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn(key, old, None)
+        return True
+
+    # -- lookups --------------------------------------------------------
+    def lookup_exact(self, cidr: str) -> Optional[Entry]:
+        return self._by_prefix.get(self._norm(cidr))
+
+    def lookup_by_ip(self, ip: str) -> Optional[Entry]:
+        """Host-side LPM walk (the datapath does this on device)."""
+        addr = ipaddress.ip_address(ip)
+        max_len = 32 if addr.version == 4 else 128
+        with self._lock:
+            for plen in range(max_len, -1, -1):
+                net = ipaddress.ip_network(f"{ip}/{plen}", strict=False)
+                e = self._by_prefix.get(str(net))
+                if e is not None:
+                    return e
+        return None
+
+    def prefixes_for_identity(self, identity: int) -> List[str]:
+        with self._lock:
+            return sorted(self._by_identity.get(identity, ()))
+
+    def __len__(self) -> int:
+        return len(self._by_prefix)
+
+    def items(self) -> List[Tuple[str, Entry]]:
+        with self._lock:
+            return list(self._by_prefix.items())
+
+    # -- device view ----------------------------------------------------
+    def build_device(
+        self, row_of: Callable[[int], Optional[int]]
+    ) -> Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]:
+        """→ ((child4, info4), (child6, info6)) stride-8 tries holding
+        identity rows (the datapath's cilium_ipcache equivalent).
+        Entries whose identity has no row yet are skipped."""
+        with self._lock:
+            v4, v6 = [], []
+            for cidr, e in self._by_prefix.items():
+                row = row_of(e.identity)
+                if row is None:
+                    continue
+                (v6 if ":" in cidr else v4).append((cidr, int(row)))
+        return build_trie(v4, ipv6=False), build_trie(v6, ipv6=True)

@@ -1,0 +1,140 @@
+"""Longest-prefix-match as stride-8 trie tensors.
+
+Replaces the kernel LPM trie maps (bpf/lib/maps.h cilium_ipcache LPM,
+bpf/bpf_xdp.c:54-86 CIDR deny tries) with device-resident node tables
+walked by chained row-gathers — the gather pattern TPU executes well
+(one bounded-size embedding row per flow per level, no data-dependent
+loop trip counts; levels are a static unroll).
+
+Layout (per address family):
+    child [M, 256] int32   next node id (0 = none; node 0 is the root)
+    info  [M, 256] int32   value at this (node, byte) + 1 (0 = none)
+
+A prefix of length ℓ populates ⌈ℓ/8⌉ levels; the last level writes
+``info`` into every byte slot the prefix covers (a /12 writes 16 slots
+of its level-2 node), so the walk needs no masking. The deepest
+non-zero ``info`` seen along the walk is the longest match — exactly
+the LPM_TRIE semantics of the kernel map. IPv4 walks 4 levels, IPv6 16.
+
+Values are small ints (identity rows for ipcache, 1 for deny sets).
+"""
+
+from __future__ import annotations
+
+import functools
+import ipaddress
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TrieBuilder:
+    """Host-side incremental stride-8 trie. Rebuild-on-change is cheap
+    (ms for 100k prefixes); the device arrays are immutable snapshots."""
+
+    def __init__(self, levels: int) -> None:
+        self.levels = levels
+        # node storage: list of dicts byte→child_id / (value+1, plen)
+        self._children: List[Dict[int, int]] = [{}]
+        self._info: List[Dict[int, Tuple[int, int]]] = [{}]
+
+    def _new_node(self) -> int:
+        self._children.append({})
+        self._info.append({})
+        return len(self._children) - 1
+
+    def _write(self, node: int, slot: int, value: int, plen: int) -> None:
+        # Within one level, slots covered by several prefixes keep the
+        # longest writer (a /0 expansion must not clobber a /8 entry) —
+        # insert-order independence like the kernel LPM trie.
+        old = self._info[node].get(slot)
+        if old is None or plen >= old[1]:
+            self._info[node][slot] = (value + 1, plen)
+
+    def insert(self, prefix_bytes: bytes, prefix_len: int, value: int) -> None:
+        """value ≥ 0; stored as value+1 internally."""
+        node = 0
+        full, rem = divmod(prefix_len, 8)
+        for i in range(full):
+            b = prefix_bytes[i]
+            if rem == 0 and i == full - 1:
+                self._write(node, b, value, prefix_len)
+                return
+            nxt = self._children[node].get(b)
+            if nxt is None:
+                nxt = self._new_node()
+                self._children[node][b] = nxt
+            node = nxt
+        # partial byte: populate all covered slots at this level
+        b = prefix_bytes[full] if full < len(prefix_bytes) else 0
+        lo = b & (0xFF << (8 - rem)) & 0xFF
+        for slot in range(lo, lo + (1 << (8 - rem))):
+            self._write(node, slot, value, prefix_len)
+
+    def arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        m = len(self._children)
+        child = np.zeros((m, 256), np.int32)
+        info = np.zeros((m, 256), np.int32)
+        for n in range(m):
+            for b, c in self._children[n].items():
+                child[n, b] = c
+            for b, (v, _plen) in self._info[n].items():
+                info[n, b] = v
+        return child, info
+
+
+def build_trie(
+    prefixes: Iterable[Tuple[str, int]], *, ipv6: bool = False
+) -> Tuple[np.ndarray, np.ndarray]:
+    """[(cidr_string, value)] → (child, info) arrays for one family."""
+    levels = 16 if ipv6 else 4
+    t = TrieBuilder(levels)
+    for cidr, value in prefixes:
+        net = ipaddress.ip_network(cidr, strict=False)
+        if (net.version == 6) != ipv6:
+            continue
+        t.insert(net.network_address.packed, net.prefixlen, value)
+    return t.arrays()
+
+
+@functools.partial(jax.jit, static_argnames=("levels",))
+def lpm_lookup(
+    child: jnp.ndarray,  # [M, 256] int32
+    info: jnp.ndarray,  # [M, 256] int32
+    addr_bytes: jnp.ndarray,  # [B, levels] int32 (byte per level)
+    levels: int = 4,
+) -> jnp.ndarray:
+    """→ [B] int32: matched value+1, 0 = no match (longest wins)."""
+    b = addr_bytes.shape[0]
+    node = jnp.zeros(b, jnp.int32)
+    alive = jnp.ones(b, jnp.bool_)
+    best = jnp.zeros(b, jnp.int32)
+    for lvl in range(levels):
+        byte = addr_bytes[:, lvl]
+        flat = node * 256 + byte
+        hit = jnp.take(info.reshape(-1), flat)
+        best = jnp.where(alive & (hit > 0), hit, best)
+        nxt = jnp.take(child.reshape(-1), flat)
+        alive = alive & (nxt > 0)
+        node = jnp.where(alive, nxt, node)
+    return best
+
+
+def ipv4_to_bytes(addrs: np.ndarray) -> np.ndarray:
+    """[B] uint32 host-order IPv4 → [B, 4] int32 big-endian bytes."""
+    a = addrs.astype(np.uint32)
+    return np.stack(
+        [(a >> 24) & 0xFF, (a >> 16) & 0xFF, (a >> 8) & 0xFF, a & 0xFF], axis=1
+    ).astype(np.int32)
+
+
+def ip_strings_to_u32(ips: Iterable[str]) -> np.ndarray:
+    return np.array([int(ipaddress.IPv4Address(ip)) for ip in ips], np.uint32)
+
+
+def ipv6_to_bytes(ips: Iterable[str]) -> np.ndarray:
+    return np.array(
+        [list(ipaddress.IPv6Address(ip).packed) for ip in ips], np.int32
+    )

@@ -22,7 +22,7 @@ from typing import Dict, List, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..compiler.program import CompiledPolicy
+from ..compiler.program import CompiledPolicy, PROTO_TCP_N
 from .bitmap import pack_bool_bits
 from .lookup import PolicymapTables
 from .verdict import ALLOW, DevicePolicy, verdict_batch
@@ -69,7 +69,7 @@ def _endpoint_slots(compiled: CompiledPolicy, subj_sel_row: np.ndarray, ingress:
     if d.l7_subj.size:
         hit = sel_hit(d.l7_subj.astype(np.int64)) == 1
         for port in d.l7_port[hit]:
-            slots.add((int(port), 6))
+            slots.add((int(port), PROTO_TCP_N))
     return sorted(slots)
 
 
