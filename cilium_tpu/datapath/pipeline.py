@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..engine import PolicyEngine
+from ..identity.model import ID_WORLD
 from ..ipcache.ipcache import IPCache
 from ..ipcache.prefilter import PreFilter
 from ..ops.lookup import PolicymapTables, lookup_batch
@@ -97,15 +98,29 @@ class DatapathPipeline:
         self.prefilter = prefilter or PreFilter()
         self._lock = threading.Lock()
         self._endpoints: List[int] = []  # identity ids of local endpoints
+        self._endpoint_ids: List[int] = []  # endpoint ids (same order)
         self._tables: Optional[DatapathTables] = None
         self._snapshots: List[EndpointPolicySnapshot] = []
         self._built_versions: Tuple = ()
         self.counters = np.zeros((0, 3), np.int64)
 
-    def set_endpoints(self, identity_ids: Sequence[int]) -> None:
+    def set_endpoints(self, endpoints: Sequence) -> None:
+        """Accepts identity ids (endpoint id == identity id) or
+        (endpoint_id, identity_id) pairs; order defines the datapath
+        endpoint index."""
         with self._lock:
-            self._endpoints = list(identity_ids)
+            pairs = [
+                e if isinstance(e, tuple) else (int(e), int(e)) for e in endpoints
+            ]
+            self._endpoint_ids = [p[0] for p in pairs]
+            self._endpoints = [p[1] for p in pairs]
             self._built_versions = ()
+
+    def endpoint_index(self, endpoint_id: int) -> Optional[int]:
+        try:
+            return self._endpoint_ids.index(endpoint_id)
+        except ValueError:
+            return None
 
     # ------------------------------------------------------------------
     def _versions(self) -> Tuple:
@@ -121,16 +136,20 @@ class DatapathPipeline:
         with self._lock:
             if not force and self._tables is not None and self._built_versions == self._versions():
                 return self._tables
-            compiled = self.engine.refresh()
-            tables, snaps = materialize_endpoints(
-                compiled, self.engine.device_policy, self._endpoints
-            )
+            # Capture versions BEFORE reading the sources: a concurrent
+            # mutation mid-build then triggers one extra rebuild rather
+            # than being silently marked materialized.
+            versions = self._versions()
+            compiled, device = self.engine.snapshot()
+            tables, snaps = materialize_endpoints(compiled, device, self._endpoints)
             pf_child4, pf_info4 = self.prefilter.build_device()[0]
             ip4, _ip6 = self.ipcache.build_device(
                 lambda ident: compiled.id_to_row.get(ident)
             )
             ip_child4, ip_info4 = ip4
-            world_row = compiled.id_to_row.get(2, 0)  # reserved:world = 2
+            world_row = compiled.id_to_row.get(ID_WORLD)
+            if world_row is None:
+                raise RuntimeError("reserved:world identity has no device row")
             self._tables = DatapathTables(
                 pf_child4=jnp.asarray(pf_child4),
                 pf_info4=jnp.asarray(pf_info4),
@@ -140,7 +159,7 @@ class DatapathPipeline:
                 policymap=tables,
             )
             self._snapshots = snaps
-            self._built_versions = self._versions()
+            self._built_versions = versions
             if self.counters.shape[0] != len(self._endpoints):
                 self.counters = np.zeros((len(self._endpoints), 3), np.int64)
             return self._tables

@@ -1,0 +1,205 @@
+"""Endpoint: a workload whose policy is enforced.
+
+Reference: pkg/endpoint/endpoint.go (struct :288, state machine
+:264-270,442-450), policy.go (regeneration pipeline :506-812), and the
+desired/realized policymap sync (endpoint.go:2572).
+
+Regeneration here = recompute the endpoint's desired policymap entries
+through the device engine (ops/materialize for this endpoint's
+identity), then diff desired vs realized into the endpoint's PolicyMap
+— the syncPolicyMap semantics — while the datapath pipeline swaps its
+device tables wholesale. The per-phase wall time lands in
+RegenerationStats (spanstat, pkg/endpoint/metrics.go).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .. import metrics
+from ..identity.model import Identity
+from ..labels import LabelArray, parse_label_array
+from ..maps.policymap import PolicyMap
+from ..ops.materialize import PolicyKey
+from ..option import OptionMap
+from ..utils.spanstat import SpanStat
+
+
+class EndpointState(str, enum.Enum):
+    # endpoint.go state strings (:264-270)
+    CREATING = "creating"
+    WAITING_FOR_IDENTITY = "waiting-for-identity"
+    READY = "ready"
+    WAITING_TO_REGENERATE = "waiting-to-regenerate"
+    REGENERATING = "regenerating"
+    RESTORING = "restoring"
+    DISCONNECTING = "disconnecting"
+    DISCONNECTED = "disconnected"
+    INVALID = "invalid"
+
+
+# Legal transitions (endpoint.go SetStateLocked:442).
+_TRANSITIONS = {
+    EndpointState.CREATING: {EndpointState.WAITING_FOR_IDENTITY, EndpointState.READY, EndpointState.DISCONNECTING, EndpointState.INVALID},
+    EndpointState.WAITING_FOR_IDENTITY: {EndpointState.READY, EndpointState.DISCONNECTING},
+    EndpointState.READY: {EndpointState.WAITING_TO_REGENERATE, EndpointState.DISCONNECTING},
+    EndpointState.WAITING_TO_REGENERATE: {EndpointState.REGENERATING, EndpointState.DISCONNECTING},
+    EndpointState.REGENERATING: {EndpointState.READY, EndpointState.WAITING_TO_REGENERATE, EndpointState.DISCONNECTING},
+    EndpointState.RESTORING: {EndpointState.WAITING_TO_REGENERATE, EndpointState.DISCONNECTING},
+    EndpointState.DISCONNECTING: {EndpointState.DISCONNECTED},
+    EndpointState.DISCONNECTED: set(),
+    EndpointState.INVALID: set(),
+}
+
+
+@dataclasses.dataclass
+class RegenerationStats:
+    total: SpanStat = dataclasses.field(default_factory=SpanStat)
+    policy_calculation: SpanStat = dataclasses.field(default_factory=SpanStat)
+    map_sync: SpanStat = dataclasses.field(default_factory=SpanStat)
+    success: bool = False
+
+
+class Endpoint:
+    def __init__(
+        self,
+        endpoint_id: int,
+        labels: LabelArray,
+        *,
+        ipv4: Optional[str] = None,
+        ipv6: Optional[str] = None,
+        container_id: str = "",
+        pod_name: str = "",
+        parent_options: Optional[OptionMap] = None,
+    ) -> None:
+        self.id = endpoint_id
+        self.labels = labels
+        self.ipv4 = ipv4
+        self.ipv6 = ipv6
+        self.container_id = container_id
+        self.pod_name = pod_name
+        self.identity: Optional[Identity] = None
+        self.options = OptionMap(parent=parent_options)
+        self.state = EndpointState.CREATING
+        self.policy_revision = 0  # realized revision
+        self.policy_map = PolicyMap(name=f"cilium_policy_{endpoint_id}")
+        self.desired: Dict[PolicyKey, int] = {}
+        self.stats = RegenerationStats()
+        self._lock = threading.RLock()
+        # One builder per endpoint at a time (the reference serializes
+        # via the build queue, pkg/endpoint/policy.go:812).
+        self._build_lock = threading.Lock()
+        self._state_log: List[Tuple[float, EndpointState]] = [(time.time(), self.state)]
+
+    # -- state machine --------------------------------------------------
+    def set_state(self, new: EndpointState) -> bool:
+        with self._lock:
+            if new == self.state:
+                return True
+            if new not in _TRANSITIONS.get(self.state, set()):
+                return False
+            self.state = new
+            self._state_log.append((time.time(), new))
+            return True
+
+    def set_identity(self, identity: Identity) -> None:
+        with self._lock:
+            self.identity = identity
+            if self.state in (EndpointState.CREATING, EndpointState.WAITING_FOR_IDENTITY):
+                self.state = EndpointState.READY
+
+    # -- desired/realized sync -----------------------------------------
+    def sync_policy_map(self, desired: Dict[PolicyKey, int]) -> Tuple[int, int]:
+        """Diff desired vs realized and apply (endpoint.go:2572):
+        returns (added, deleted)."""
+        with self._lock:
+            realized = {k: e.proxy_port for k, e in self.policy_map.dump()}
+            added = deleted = 0
+            for key, proxy in desired.items():
+                if realized.get(key) != proxy:
+                    self.policy_map.allow(key, proxy)
+                    added += 1
+            for key in realized:
+                if key not in desired:
+                    self.policy_map.delete(key)
+                    deleted += 1
+            self.desired = dict(desired)
+            return added, deleted
+
+    def regenerate(self, pipeline, reason: str = "") -> bool:
+        """One regeneration pass against the shared datapath pipeline
+        (the regenerateBPF orchestration, pkg/endpoint/bpf.go:362).
+        Serialized per endpoint via the build lock."""
+        with self._build_lock:
+            if not self.set_state(EndpointState.WAITING_TO_REGENERATE):
+                if self.state != EndpointState.WAITING_TO_REGENERATE:
+                    return False
+            self.set_state(EndpointState.REGENERATING)
+            stats = self.stats = RegenerationStats()
+            ok = False
+            try:
+                with stats.total:
+                    with stats.policy_calculation:
+                        pipeline.rebuild()
+                        snaps = pipeline.snapshots()
+                        idx = pipeline.endpoint_index(self.id)
+                        desired = snaps[idx].entries if idx is not None else {}
+                    with stats.map_sync:
+                        self.sync_policy_map(desired)
+                    self.policy_revision = pipeline.engine.repo.revision
+                ok = True
+            finally:
+                stats.success = ok
+                self.set_state(EndpointState.READY)
+                metrics.endpoint_regeneration_count.inc(
+                    labels={"outcome": "success" if ok else "failure"}
+                )
+                metrics.endpoint_regeneration_time.observe(stats.total.total())
+            return ok
+
+    # -- snapshot/restore (pkg/endpoint/restore.go) ---------------------
+    def to_snapshot(self) -> str:
+        return json.dumps(
+            {
+                "id": self.id,
+                "labels": list(self.labels.to_strings()),
+                "ipv4": self.ipv4,
+                "ipv6": self.ipv6,
+                "container_id": self.container_id,
+                "pod_name": self.pod_name,
+                "policy_revision": self.policy_revision,
+                "state": self.state.value,
+            }
+        )
+
+    @classmethod
+    def from_snapshot(cls, blob: str, parent_options: Optional[OptionMap] = None) -> "Endpoint":
+        d = json.loads(blob)
+        ep = cls(
+            d["id"],
+            parse_label_array(d["labels"]),
+            ipv4=d.get("ipv4"),
+            ipv6=d.get("ipv6"),
+            container_id=d.get("container_id", ""),
+            pod_name=d.get("pod_name", ""),
+            parent_options=parent_options,
+        )
+        ep.state = EndpointState.RESTORING
+        ep.policy_revision = d.get("policy_revision", 0)
+        return ep
+
+    def status(self) -> Dict:
+        return {
+            "id": self.id,
+            "state": self.state.value,
+            "identity": self.identity.id if self.identity else None,
+            "labels": list(self.labels.to_strings()),
+            "ipv4": self.ipv4,
+            "policy-revision": self.policy_revision,
+            "policy-map-entries": len(self.policy_map),
+        }

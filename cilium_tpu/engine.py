@@ -92,6 +92,14 @@ class PolicyEngine:
         assert self._device is not None
         return self._device
 
+    def snapshot(self) -> Tuple[CompiledPolicy, DevicePolicy]:
+        """A consistent (compiled, device) pair from one refresh —
+        callers must never mix row/selector layouts across refreshes."""
+        self.refresh()
+        with self._lock:
+            assert self._compiled is not None and self._device is not None
+            return self._compiled, self._device
+
     def _rows_snapshot(
         self, low: np.ndarray, high: dict, identity_ids: Sequence[int]
     ) -> np.ndarray:

@@ -77,6 +77,9 @@ class IPCache:
         (ipcache.go:183 allowOverwrite)."""
         key = self._norm(cidr)
         new = Entry(identity, source, host_ip)
+        # Listener fan-out happens under the lock so derived state sees
+        # events in map-update order (the reference holds the ipcache
+        # mutex across IPIdentityMappingListener callbacks).
         with self._lock:
             old = self._by_prefix.get(key)
             if old is not None and _PRIORITY[old.source] > _PRIORITY[source]:
@@ -88,9 +91,8 @@ class IPCache:
                     s.discard(key)
             self._by_identity.setdefault(identity, set()).add(key)
             self.version += 1
-            listeners = list(self._listeners)
-        for fn in listeners:
-            fn(key, old, new)
+            for fn in self._listeners:
+                fn(key, old, new)
         return True
 
     def delete(self, cidr: str, source: str) -> bool:
@@ -104,9 +106,8 @@ class IPCache:
             if s:
                 s.discard(key)
             self.version += 1
-            listeners = list(self._listeners)
-        for fn in listeners:
-            fn(key, old, None)
+            for fn in self._listeners:
+                fn(key, old, None)
         return True
 
     # -- lookups --------------------------------------------------------

@@ -1,0 +1,149 @@
+"""Metrics registry with Prometheus text exposition.
+
+Reference: pkg/metrics/metrics.go:37,87-180 — a process-wide registry
+of counters/gauges/histograms covering endpoint regeneration, policy
+revision/import counts, datapath errors, and event counts, served over
+HTTP and bridged into the REST API. No external client library — the
+text exposition format is trivial to emit.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels: Optional[Dict[str, str]]) -> _LabelKey:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _fmt_labels(key: _LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str) -> None:
+        self.name, self.help = name, help_
+        self._values: Dict[_LabelKey, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, labels: Optional[Dict[str, str]] = None, value: float = 1.0) -> None:
+        k = _labels_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def get(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_labels_key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(k)} {v}")
+        return out
+
+
+class Gauge(Counter):
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_labels_key(labels)] = value
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(k)} {v}")
+        return out
+
+
+class Histogram:
+    DEFAULT_BUCKETS = (0.001, 0.01, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0)
+
+    def __init__(self, name: str, help_: str, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self.buckets = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._n += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            cum += self._counts[i]
+            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+        cum += self._counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {self._sum}")
+        out.append(f"{self.name}_count {self._n}")
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help_))
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str = "", buckets=Histogram.DEFAULT_BUCKETS) -> Histogram:
+        return self._get(name, lambda: Histogram(name, help_, buckets))
+
+    def _get(self, name, ctor):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = ctor()
+                self._metrics[name] = m
+            return m
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            for m in self._metrics.values():
+                lines.extend(m.expose())  # type: ignore[attr-defined]
+        return "\n".join(lines) + "\n"
+
+
+# Process-wide registry + the metric families of pkg/metrics/metrics.go.
+registry = Registry()
+
+endpoint_regeneration_count = registry.counter(
+    "cilium_tpu_endpoint_regenerations_total", "Count of endpoint regenerations"
+)
+endpoint_regeneration_time = registry.histogram(
+    "cilium_tpu_endpoint_regeneration_seconds", "Endpoint regeneration latency"
+)
+policy_count = registry.gauge("cilium_tpu_policy_count", "Rules in the repository")
+policy_revision = registry.gauge("cilium_tpu_policy_max_revision", "Policy revision")
+policy_import_errors = registry.counter(
+    "cilium_tpu_policy_import_errors_total", "Failed policy imports"
+)
+verdict_batches = registry.counter(
+    "cilium_tpu_datapath_batches_total", "Flow batches processed"
+)
+verdicts_total = registry.counter(
+    "cilium_tpu_datapath_verdicts_total", "Flow verdicts by outcome"
+)
+identity_count = registry.gauge("cilium_tpu_identity_count", "Allocated identities")
+compile_time = registry.histogram(
+    "cilium_tpu_policy_compile_seconds", "Policy tensor compile latency"
+)

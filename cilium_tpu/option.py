@@ -1,0 +1,135 @@
+"""Daemon configuration + mutable runtime options.
+
+Reference: pkg/option — a frozen daemon `Config` (config.go:142,
+populated from flags/env/file at boot, `Validate` :297) plus a
+*mutable* option map (option.go) patchable at runtime via
+`PATCH /config` and per-endpoint (`cilium endpoint config`), each
+option with parse/verify hooks; endpoints inherit daemon options
+(pkg/endpoint applyOptsLocked).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Dict, Optional
+
+
+@dataclasses.dataclass
+class DaemonConfig:
+    """Boot-frozen configuration (option.Config equivalent)."""
+
+    cluster_name: str = "default"
+    cluster_id: int = 0
+    enable_ipv4: bool = True
+    enable_ipv6: bool = False
+    enforcement_mode: str = "default"  # default | always | never
+    identity_row_bucket: int = 256
+    verdict_block: int = 8192
+    lookup_block: int = 65536
+    kvstore: str = ""  # "" = disabled, "memory" for tests
+    monitor_queue_size: int = 4096
+    proxy_port_min: int = 10000
+    proxy_port_max: int = 20000
+
+    def validate(self) -> None:
+        if self.enforcement_mode not in ("default", "always", "never"):
+            raise ValueError(f"invalid enforcement mode {self.enforcement_mode!r}")
+        if self.cluster_id < 0 or self.cluster_id > 255:
+            raise ValueError("cluster-id must be 0-255")
+        if self.proxy_port_min >= self.proxy_port_max:
+            raise ValueError("invalid proxy port range")
+
+
+_config = DaemonConfig()
+
+
+def get_config() -> DaemonConfig:
+    return _config
+
+
+def set_config(cfg: DaemonConfig) -> None:
+    cfg.validate()
+    global _config
+    _config = cfg
+
+
+# -- mutable runtime options (pkg/option/option.go) -----------------------
+
+BoolParser = Callable[[str], bool]
+
+
+def _parse_bool(v: str) -> bool:
+    lv = str(v).lower()
+    if lv in ("true", "enabled", "1", "on"):
+        return True
+    if lv in ("false", "disabled", "0", "off"):
+        return False
+    raise ValueError(f"invalid option value {v!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class OptionSpec:
+    name: str
+    description: str = ""
+    requires: tuple = ()  # options force-enabled alongside this one
+
+
+# The runtime-mutable option set (defaults mirror the reference's
+# endpoint options: Conntrack, Policy, Debug, DropNotify, TraceNotify).
+OPTION_SPECS: Dict[str, OptionSpec] = {
+    o.name: o
+    for o in (
+        OptionSpec("Conntrack", "Connection tracking"),
+        OptionSpec("Debug", "Debug event emission"),
+        OptionSpec("DropNotification", "Drop notification events"),
+        OptionSpec("TraceNotification", "Trace notification events"),
+        OptionSpec("Policy", "Policy enforcement"),
+        OptionSpec("PolicyVerdictNotification", "Per-verdict events"),
+    )
+}
+
+
+class OptionMap:
+    """Mutable option set with change callbacks + inheritance."""
+
+    def __init__(self, parent: Optional["OptionMap"] = None) -> None:
+        self._lock = threading.Lock()
+        self._values: Dict[str, bool] = {}
+        self._parent = parent
+        self._on_change: Optional[Callable[[str, bool], None]] = None
+
+    def on_change(self, fn: Callable[[str, bool], None]) -> None:
+        self._on_change = fn
+
+    def get(self, name: str) -> bool:
+        with self._lock:
+            if name in self._values:
+                return self._values[name]
+        if self._parent is not None:
+            return self._parent.get(name)
+        return False
+
+    def set(self, name: str, value) -> bool:
+        """Returns True when the value changed; raises on unknown option
+        (option.go Validate)."""
+        spec = OPTION_SPECS.get(name)
+        if spec is None:
+            raise KeyError(f"unknown option {name!r}")
+        b = value if isinstance(value, bool) else _parse_bool(value)
+        with self._lock:
+            old = self._values.get(name)
+            self._values[name] = b
+        changed = old != b
+        if changed and self._on_change:
+            self._on_change(name, b)
+        if b:
+            for req in spec.requires:
+                self.set(req, True)
+        return changed
+
+    def snapshot(self) -> Dict[str, bool]:
+        out = dict(self._parent.snapshot()) if self._parent else {}
+        with self._lock:
+            out.update(self._values)
+        return out

@@ -1,0 +1,41 @@
+"""Exponential backoff with jitter (reference: pkg/backoff/backoff.go)."""
+
+from __future__ import annotations
+
+import random
+import threading
+
+
+class Backoff:
+    def __init__(
+        self,
+        min_s: float = 1.0,
+        max_s: float = 60.0,
+        factor: float = 2.0,
+        jitter: bool = True,
+    ) -> None:
+        self.min_s = min_s
+        self.max_s = max_s
+        self.factor = factor
+        self.jitter = jitter
+        self._attempt = 0
+        self._lock = threading.Lock()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._attempt = 0
+
+    def duration(self) -> float:
+        """Next wait duration; attempt counter advances."""
+        with self._lock:
+            self._attempt += 1
+            attempt = self._attempt
+        d = min(self.max_s, self.min_s * (self.factor ** (attempt - 1)))
+        if self.jitter:
+            d = random.uniform(d / 2, d)
+        return d
+
+    def wait(self, event: threading.Event) -> bool:
+        """Sleep the backoff duration or until event fires; returns True
+        when interrupted by the event."""
+        return event.wait(self.duration())

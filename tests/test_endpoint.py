@@ -1,0 +1,127 @@
+"""Endpoint lifecycle: state machine, regeneration, desired/realized
+policymap sync, snapshot/restore, manager fan-out (reference:
+pkg/endpoint + pkg/endpointmanager test strategy)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cilium_tpu.datapath import DatapathPipeline, FORWARD, DROP_POLICY
+from cilium_tpu.endpoint import Endpoint, EndpointManager, EndpointState
+from cilium_tpu.engine import PolicyEngine
+from cilium_tpu.identity import IdentityRegistry
+from cilium_tpu.ipcache import IPCache, SOURCE_AGENT
+from cilium_tpu.labels import parse_label_array
+from cilium_tpu.maps.ctmap import ConntrackMap
+from cilium_tpu.ops.lpm import ip_strings_to_u32
+from cilium_tpu.ops.materialize import PolicyKey
+from cilium_tpu.policy.api import EndpointSelector, IngressRule, PortProtocol, PortRule, rule
+from cilium_tpu.policy.repository import Repository
+
+
+def _world():
+    repo = Repository()
+    repo.add_list([
+        rule(["k8s:app=web"], ingress=[
+            IngressRule(from_endpoints=(EndpointSelector.make(["k8s:app=client"]),)),
+        ]),
+    ])
+    reg = IdentityRegistry()
+    client = reg.allocate(parse_label_array(["k8s:app=client"]))
+    web = reg.allocate(parse_label_array(["k8s:app=web"]))
+    engine = PolicyEngine(repo, reg)
+    cache = IPCache()
+    cache.upsert("10.0.0.1", client.id, SOURCE_AGENT)
+    pipe = DatapathPipeline(engine, cache)
+    return repo, reg, engine, cache, pipe, client, web
+
+
+class TestStateMachine:
+    def test_legal_transitions(self):
+        ep = Endpoint(100, parse_label_array(["k8s:app=web"]))
+        assert ep.state == EndpointState.CREATING
+        assert ep.set_state(EndpointState.WAITING_FOR_IDENTITY)
+        assert ep.set_state(EndpointState.READY)
+        assert ep.set_state(EndpointState.WAITING_TO_REGENERATE)
+        assert ep.set_state(EndpointState.REGENERATING)
+        assert ep.set_state(EndpointState.READY)
+        assert not ep.set_state(EndpointState.CREATING)  # illegal
+        assert ep.set_state(EndpointState.DISCONNECTING)
+        assert ep.set_state(EndpointState.DISCONNECTED)
+        assert not ep.set_state(EndpointState.READY)
+
+
+class TestRegeneration:
+    def test_regenerate_and_sync(self):
+        repo, reg, engine, cache, pipe, client, web = _world()
+        ep = Endpoint(1, parse_label_array(["k8s:app=web"]), ipv4="10.0.0.2")
+        ep.set_identity(web)
+        pipe.set_endpoints([(ep.id, web.id)])
+        assert ep.regenerate(pipe)
+        assert ep.state == EndpointState.READY
+        assert ep.policy_revision == repo.revision
+        key = PolicyKey(client.id, 0, 0, 0)
+        assert ep.policy_map.lookup(key) is not None
+        # Policy change → new desired set; stale entries deleted.
+        repo.delete_by_labels(parse_label_array([]))
+        repo.rules.clear()
+        repo._bump()
+        assert ep.regenerate(pipe)
+        assert ep.policy_map.lookup(key) is None
+        assert ep.stats.success and ep.stats.total.total() > 0
+
+    def test_pipeline_agrees_with_policymap(self):
+        repo, reg, engine, cache, pipe, client, web = _world()
+        ep = Endpoint(1, parse_label_array(["k8s:app=web"]))
+        ep.set_identity(web)
+        pipe.set_endpoints([(ep.id, web.id)])
+        ep.regenerate(pipe)
+        v, _ = pipe.process(
+            ip_strings_to_u32(["10.0.0.1", "9.9.9.9"]),
+            np.zeros(2, np.int32), np.zeros(2, np.int32), np.full(2, 6, np.int32),
+        )
+        assert list(v) == [FORWARD, DROP_POLICY]
+
+
+class TestSnapshotRestore:
+    def test_roundtrip(self):
+        ep = Endpoint(7, parse_label_array(["k8s:app=x"]), ipv4="1.2.3.4", pod_name="ns/pod")
+        ep.policy_revision = 5
+        blob = ep.to_snapshot()
+        ep2 = Endpoint.from_snapshot(blob)
+        assert ep2.id == 7 and ep2.ipv4 == "1.2.3.4" and ep2.pod_name == "ns/pod"
+        assert ep2.state == EndpointState.RESTORING
+        assert ep2.policy_revision == 5
+        assert ep2.set_state(EndpointState.WAITING_TO_REGENERATE)
+
+
+class TestManager:
+    def test_lookups_and_fanout(self):
+        repo, reg, engine, cache, pipe, client, web = _world()
+        mgr = EndpointManager(workers=2)
+        eps = []
+        for i in range(3):
+            ep = Endpoint(10 + i, parse_label_array(["k8s:app=web"]),
+                          ipv4=f"10.0.1.{i}", container_id=f"c{i}", pod_name=f"default/p{i}")
+            ep.set_identity(web)
+            mgr.insert(ep)
+            eps.append(ep)
+        pipe.set_endpoints([(ep.id, web.id) for ep in eps])
+        assert mgr.lookup(11) is eps[1]
+        assert mgr.lookup_container("c2") is eps[2]
+        assert mgr.lookup_pod("default/p0") is eps[0]
+        assert mgr.lookup_ipv4("10.0.1.1") is eps[1]
+        assert mgr.regenerate_all(pipe) == 3
+        assert all(ep.state == EndpointState.READY for ep in eps)
+        mgr.remove(eps[0])
+        assert mgr.lookup(10) is None and len(mgr) == 2
+        mgr.shutdown()
+
+    def test_conntrack_gc(self):
+        mgr = EndpointManager(workers=1)
+        ct = ConntrackMap()
+        ct.create((1, 2, 3, 4, 6, 0), 1, False, lifetime=-1.0)  # already expired
+        ct.create((1, 2, 3, 5, 6, 0), 1, False, lifetime=60.0)
+        assert ct.gc() == 1 and len(ct) == 1
+        mgr.shutdown()

@@ -1,0 +1,155 @@
+"""Infrastructure utilities: controller, trigger, backoff, completion,
+spanstat, serializer, metrics, options (reference: pkg/{controller,
+trigger,backoff,completion,spanstat,serializer,option,metrics})."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from cilium_tpu import metrics
+from cilium_tpu.option import DaemonConfig, OptionMap
+from cilium_tpu.utils import Backoff, Controller, ControllerManager, FunctionQueue, SpanStat, Trigger, WaitGroup
+
+
+class TestController:
+    def test_success_and_status(self):
+        ran = threading.Event()
+        mgr = ControllerManager()
+        mgr.update_controller("t", ran.set)
+        assert ran.wait(2)
+        for _ in range(50):
+            if mgr.lookup("t").success_count:
+                break
+            time.sleep(0.02)
+        st = mgr.lookup("t").status()
+        assert st["success-count"] >= 1 and st["last-failure-msg"] is None
+        assert mgr.remove_controller("t")
+        assert not mgr.remove_controller("t")
+
+    def test_failure_retry(self):
+        calls = []
+
+        def boom():
+            calls.append(1)
+            if len(calls) < 2:
+                raise RuntimeError("first fails")
+
+        c = Controller("boom", boom, error_retry_base=0.01)
+        c.trigger()
+        for _ in range(100):
+            if c.success_count:
+                break
+            time.sleep(0.02)
+        assert c.success_count >= 1 and c.failure_count >= 1
+        assert c.consecutive_failures == 0
+        c.stop()
+
+
+class TestTrigger:
+    def test_folding(self):
+        runs = []
+        done = threading.Event()
+
+        def fn(reasons):
+            runs.append(list(reasons))
+            done.set()
+
+        t = Trigger(fn, min_interval=0.05)
+        t.trigger("a")
+        t.trigger("b")
+        t.trigger("c")
+        assert done.wait(2)
+        time.sleep(0.2)
+        t.shutdown()
+        all_reasons = [r for batch in runs for r in batch]
+        assert sorted(all_reasons) == ["a", "b", "c"]
+        assert len(runs) <= 2  # folded under min_interval
+
+
+class TestBackoffSpanstat:
+    def test_backoff_growth(self):
+        b = Backoff(min_s=1, max_s=10, jitter=False)
+        assert [b.duration() for _ in range(4)] == [1, 2, 4, 8]
+        b.reset()
+        assert b.duration() == 1
+
+    def test_spanstat(self):
+        s = SpanStat()
+        with s:
+            time.sleep(0.01)
+        assert s.success_total > 0
+        with pytest.raises(ValueError):
+            with s:
+                raise ValueError("x")
+        assert s.failure_total > 0
+
+
+class TestCompletion:
+    def test_waitgroup(self):
+        wg = WaitGroup()
+        c1, c2 = wg.add(), wg.add()
+        threading.Timer(0.02, c1.complete).start()
+        threading.Timer(0.04, c2.complete).start()
+        assert wg.wait(2)
+
+    def test_error_propagates(self):
+        wg = WaitGroup()
+        c = wg.add()
+        c.complete(RuntimeError("nack"))
+        with pytest.raises(RuntimeError):
+            wg.wait(0.1)
+
+
+class TestSerializer:
+    def test_fifo_order(self):
+        q = FunctionQueue()
+        out = []
+        done = threading.Event()
+        for i in range(10):
+            q.enqueue(lambda i=i: out.append(i))
+        q.enqueue(done.set)
+        assert done.wait(2)
+        assert out == list(range(10))
+        q.stop()
+
+
+class TestMetrics:
+    def test_exposition(self):
+        r = metrics.Registry()
+        c = r.counter("test_total", "help text")
+        c.inc({"outcome": "ok"})
+        c.inc({"outcome": "ok"})
+        g = r.gauge("test_gauge")
+        g.set(42.0)
+        h = r.histogram("test_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        text = r.expose()
+        assert 'test_total{outcome="ok"} 2.0' in text
+        assert "test_gauge 42.0" in text
+        assert 'test_seconds_bucket{le="+Inf"} 2' in text
+        assert "test_seconds_count 2" in text
+
+
+class TestOptions:
+    def test_config_validate(self):
+        cfg = DaemonConfig(enforcement_mode="bogus")
+        with pytest.raises(ValueError):
+            cfg.validate()
+
+    def test_option_inheritance(self):
+        parent = OptionMap()
+        child = OptionMap(parent=parent)
+        parent.set("Debug", "enabled")
+        assert child.get("Debug")
+        child.set("Debug", "false")
+        assert not child.get("Debug") and parent.get("Debug")
+        with pytest.raises(KeyError):
+            child.set("NoSuchOption", True)
+        changes = []
+        child.on_change(lambda n, v: changes.append((n, v)))
+        child.set("Conntrack", "on")
+        assert changes == [("Conntrack", True)]
