@@ -131,10 +131,13 @@ class Endpoint:
             self.desired = dict(desired)
             return added, deleted
 
-    def regenerate(self, pipeline, reason: str = "") -> bool:
+    def regenerate(self, pipeline, reason: str = "", proxy=None) -> bool:
         """One regeneration pass against the shared datapath pipeline
         (the regenerateBPF orchestration, pkg/endpoint/bpf.go:362).
-        Serialized per endpoint via the build lock."""
+        Serialized per endpoint via the build lock. When ``proxy`` is
+        given, L7 redirects are created/updated/removed to match the
+        resolved L4 policy (addNewRedirects / removeOldRedirects,
+        pkg/endpoint/bpf.go:488-497)."""
         with self._build_lock:
             if not self.set_state(EndpointState.WAITING_TO_REGENERATE):
                 if self.state != EndpointState.WAITING_TO_REGENERATE:
@@ -149,6 +152,8 @@ class Endpoint:
                         snaps = pipeline.snapshots()
                         idx = pipeline.endpoint_index(self.id)
                         desired = snaps[idx].entries if idx is not None else {}
+                    if proxy is not None:
+                        self._update_redirects(pipeline, proxy)
                     with stats.map_sync:
                         self.sync_policy_map(desired)
                     self.policy_revision = pipeline.engine.repo.revision
@@ -161,6 +166,53 @@ class Endpoint:
                 )
                 metrics.endpoint_regeneration_time.observe(stats.total.total())
             return ok
+
+    def _update_redirects(self, pipeline, proxy) -> None:
+        """Create/update redirects for every L7-bearing filter in the
+        resolved L4 policy; remove stale ones. Identity scoping per rule
+        comes from matching filter endpoint selectors over the registry
+        (the NPDS policy translation, pkg/envoy/server.go:267-331)."""
+        from ..l7.http_policy import HTTPPolicy
+        from ..l7.kafka_policy import KafkaACL
+        from ..policy.api import HTTPRule, KafkaRule
+
+        engine = pipeline.engine
+        l4 = engine.repo.resolve_l4_policy(self.labels)
+        identities = list(engine.registry)
+        wanted = set()
+        for direction_map, ingress in ((l4.ingress, True), (l4.egress, False)):
+            for f in direction_map:
+                if not f.is_redirect:
+                    continue
+                http_rules, kafka_rules = [], []
+                for sel, rules in f.l7_rules_per_ep.items():
+                    if sel.is_wildcard:
+                        idents = None
+                    else:
+                        idents = {i.id for i in identities if sel.matches(i.labels)}
+                    for hr in rules.http:
+                        http_rules.append((hr, idents))
+                    for kr in rules.kafka:
+                        kafka_rules.append((kr, idents))
+                    if not rules.http and not rules.kafka:
+                        # Wildcarded L7: this peer flows through the
+                        # proxy unrestricted (wildcardL3L4Rules).
+                        if f.l7_parser == "http":
+                            http_rules.append((HTTPRule(), idents))
+                        elif f.l7_parser == "kafka":
+                            kafka_rules.append((KafkaRule(), idents))
+                proxy.create_or_update_redirect(
+                    self.id,
+                    f.port,
+                    f.l7_parser,
+                    ingress=ingress,
+                    http_policy=HTTPPolicy(http_rules) if f.l7_parser == "http" else None,
+                    kafka_acl=KafkaACL(kafka_rules) if f.l7_parser == "kafka" else None,
+                )
+                wanted.add((f.port, ingress))
+        for key, r in proxy.redirects().items():
+            if r.endpoint_id == self.id and (r.dst_port, r.ingress) not in wanted:
+                proxy.remove_redirect(r.endpoint_id, r.dst_port, r.ingress)
 
     # -- snapshot/restore (pkg/endpoint/restore.go) ---------------------
     def to_snapshot(self) -> str:

@@ -1,0 +1,157 @@
+"""Per-endpoint-port HTTP policy: compiled DFA enforcement.
+
+Reference: the NPDS policy Envoy enforces per request
+(envoy/cilium_network_policy.h:68-202 PortNetworkPolicy.Matches chain —
+remote identity must match an allowed selector AND some HTTP rule's
+method/path/host/header matchers must all pass; deny → 403).
+
+Compilation: distinct non-empty method/path/host regexes across the
+rules become three multi-pattern DFAs; a rule matches when its bits are
+set (or the field is a wildcard) in every field's accept mask. Header
+checks are exact matches evaluated host-side (rare in practice).
+Patterns that exceed the DFA state cap fall back to host `re` matching
+— fail-safe, never fail-open.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..ops.dfa import match_patterns
+from ..policy.api import HTTPRule
+from .regex_compile import MultiDFA, RegexError, compile_patterns
+
+
+@dataclasses.dataclass(frozen=True)
+class HTTPRequest:
+    method: str
+    path: str
+    host: str = ""
+    headers: Tuple[Tuple[str, str], ...] = ()
+    src_identity: int = 0
+
+    def header_dict(self) -> Dict[str, str]:
+        return {k.lower(): v for k, v in self.headers}
+
+
+class _PatternSet:
+    """Interned patterns for one field + its compiled DFA (None when any
+    pattern overflowed the state cap → host fallback)."""
+
+    def __init__(self) -> None:
+        self.patterns: List[str] = []
+        self._ids: Dict[str, int] = {}
+        self.dfa: Optional[MultiDFA] = None
+        self.fallback = False
+
+    def intern(self, pattern: str) -> int:
+        pid = self._ids.get(pattern)
+        if pid is None:
+            pid = len(self.patterns)
+            self._ids[pattern] = pid
+            self.patterns.append(pattern)
+        return pid
+
+    def compile(self) -> None:
+        if not self.patterns:
+            return
+        try:
+            self.dfa = compile_patterns(self.patterns)
+        except RegexError:
+            self.fallback = True
+
+    def masks(self, values: Sequence[str], max_len: int) -> np.ndarray:
+        """[B] uint64 accept masks for a batch of field values."""
+        if not self.patterns:
+            return np.zeros(len(values), np.uint64)
+        if self.dfa is not None and not self.fallback:
+            return match_patterns(self.dfa, [v.encode() for v in values], max_len)
+        out = np.zeros(len(values), np.uint64)
+        for i, v in enumerate(values):
+            m = 0
+            for pid, p in enumerate(self.patterns):
+                if re.fullmatch(p, v):
+                    m |= 1 << pid
+            out[i] = m
+        return out
+
+
+@dataclasses.dataclass
+class _CompiledRule:
+    rule: HTTPRule
+    method_pid: int  # -1 = wildcard
+    path_pid: int
+    host_pid: int
+    allowed_identities: Optional[Set[int]]  # None = any peer
+
+
+class HTTPPolicy:
+    """All HTTP rules for one (endpoint, port): the NPDS
+    PortNetworkPolicy equivalent. ``rules`` pairs each HTTPRule with the
+    identity set it applies to (None = wildcard peer — e.g. after
+    wildcardL3L4Rules widened it)."""
+
+    def __init__(
+        self,
+        rules: Sequence[Tuple[HTTPRule, Optional[Set[int]]]],
+        max_len: int = 128,
+    ) -> None:
+        self.max_len = max_len
+        self._methods = _PatternSet()
+        self._paths = _PatternSet()
+        self._hosts = _PatternSet()
+        self._rules: List[_CompiledRule] = []
+        for rule, idents in rules:
+            self._rules.append(
+                _CompiledRule(
+                    rule=rule,
+                    method_pid=self._methods.intern(rule.method) if rule.method else -1,
+                    path_pid=self._paths.intern(rule.path) if rule.path else -1,
+                    host_pid=self._hosts.intern(rule.host) if rule.host else -1,
+                    allowed_identities=set(idents) if idents is not None else None,
+                )
+            )
+        for ps in (self._methods, self._paths, self._hosts):
+            ps.compile()
+
+    def __len__(self) -> int:
+        return len(self._rules)
+
+    def check_batch(self, requests: Sequence[HTTPRequest]) -> np.ndarray:
+        """→ [B] bool allow. Empty rule list allows everything (a filter
+        with no L7 rules is a pure L4 redirect)."""
+        n = len(requests)
+        if not self._rules:
+            return np.ones(n, bool)
+        m_mask = self._methods.masks([r.method for r in requests], 16)
+        p_mask = self._paths.masks([r.path for r in requests], self.max_len)
+        h_mask = self._hosts.masks([r.host for r in requests], self.max_len)
+        out = np.zeros(n, bool)
+        for i, req in enumerate(requests):
+            for cr in self._rules:
+                if cr.allowed_identities is not None and req.src_identity not in cr.allowed_identities:
+                    continue
+                if cr.method_pid >= 0 and not (int(m_mask[i]) >> cr.method_pid) & 1:
+                    continue
+                if cr.path_pid >= 0 and not (int(p_mask[i]) >> cr.path_pid) & 1:
+                    continue
+                if cr.host_pid >= 0 and not (int(h_mask[i]) >> cr.host_pid) & 1:
+                    continue
+                if cr.rule.headers:
+                    hd = req.header_dict()
+                    if not all(
+                        (lambda name, want: (got := hd.get(name.strip().lower())) is not None
+                         and (not want or got.strip() == want.strip()))(*h.partition(":")[::2])
+                        for h in cr.rule.headers
+                    ):
+                        continue
+                out[i] = True
+                break
+        return out
+
+    def check(self, request: HTTPRequest) -> bool:
+        return bool(self.check_batch([request])[0])

@@ -1,0 +1,98 @@
+"""Kafka ACL enforcement as broadcast-compare tables.
+
+Reference: pkg/kafka/policy.go:144,200 — a request (api_key,
+api_version, client_id, topics) matches a rule when every set field
+matches, with Role produce/consume expanding to api-key sets
+(pkg/policy/api/kafka.go). Deny → synthesized error response
+(pkg/kafka/request.go:158).
+
+Tensorization: api-key sets become a 32-bit mask per rule; topics and
+client-ids are interned to ids; a batch check is [B, R] broadcast
+compares — fully device-friendly, no string work per request after
+interning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..policy.api import KafkaRule
+
+
+@dataclasses.dataclass(frozen=True)
+class KafkaRequest:
+    api_key: int
+    api_version: int = 0
+    client_id: str = ""
+    topic: str = ""
+    src_identity: int = 0
+
+
+class KafkaACL:
+    """All Kafka rules for one (endpoint, port)."""
+
+    def __init__(self, rules: Sequence[Tuple[KafkaRule, Optional[Set[int]]]]) -> None:
+        self._rules = list(rules)
+        self._topic_ids: Dict[str, int] = {}
+        r = len(rules)
+        self.key_mask = np.zeros(r, np.uint32)  # bit k = api_key k allowed
+        self.version = np.full(r, -1, np.int32)  # -1 = wildcard
+        self.topic_id = np.full(r, -1, np.int32)
+        self.client_id: List[str] = []
+        for i, (rule, _idents) in enumerate(rules):
+            keys = rule.allowed_api_keys()
+            self.key_mask[i] = (
+                np.uint32(0xFFFFFFFF)
+                if not keys
+                else np.uint32(sum(1 << k for k in keys))
+            )
+            if rule.api_version:
+                self.version[i] = int(rule.api_version)
+            if rule.topic:
+                self.topic_id[i] = self._intern_topic(rule.topic)
+            self.client_id.append(rule.client_id)
+
+    def _intern_topic(self, topic: str) -> int:
+        tid = self._topic_ids.get(topic)
+        if tid is None:
+            tid = len(self._topic_ids)
+            self._topic_ids[topic] = tid
+        return tid
+
+    def __len__(self) -> int:
+        return len(self._rules)
+
+    def check_batch(self, requests: Sequence[KafkaRequest]) -> np.ndarray:
+        """→ [B] bool allow (empty rule list allows everything)."""
+        n = len(requests)
+        if not self._rules:
+            return np.ones(n, bool)
+        api_key = np.array([r.api_key for r in requests], np.int32)
+        version = np.array([r.api_version for r in requests], np.int32)
+        topic = np.array(
+            [self._topic_ids.get(r.topic, -2) for r in requests], np.int32
+        )
+        # [B, R] broadcast compares (the device-friendly form; numpy here
+        # because L7 batch sizes are modest — the same expressions jit
+        # directly when wired into the proxy fast path).
+        key_ok = (self.key_mask[None, :] >> api_key[:, None].clip(0, 31)) & 1 == 1
+        key_ok &= api_key[:, None] < 32
+        ver_ok = (self.version[None, :] < 0) | (self.version[None, :] == version[:, None])
+        top_ok = (self.topic_id[None, :] < 0) | (self.topic_id[None, :] == topic[:, None])
+        ok = key_ok & ver_ok & top_ok
+        # client-id + identity: host-side (strings / sets)
+        for i, req in enumerate(requests):
+            for j, (rule, idents) in enumerate(self._rules):
+                if not ok[i, j]:
+                    continue
+                if rule.client_id and rule.client_id != req.client_id:
+                    ok[i, j] = False
+                elif idents is not None and req.src_identity not in idents:
+                    ok[i, j] = False
+        return ok.any(axis=1)
+
+    def check(self, request: KafkaRequest) -> bool:
+        return bool(self.check_batch([request])[0])

@@ -1,0 +1,89 @@
+"""Batched multi-pattern DFA execution on device.
+
+The L7 HTTP matcher: strings (method/path/host) walk a combined DFA
+(l7/regex_compile.py) whose accept sets are per-state pattern bitmasks.
+The walk is a static unroll of chained row-index gathers — length is
+shape-bucketed, no data-dependent trip counts. Accept masks come back
+as two uint32 words (pattern bit i = pattern i matches).
+
+This is the "vmapped NFA tables" piece of the north star
+(BASELINE.json): regex evaluation for a whole request batch in one
+dispatch instead of per-request Envoy regex calls
+(envoy/cilium_l7policy.cc AccessFilter::decodeHeaders).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..l7.regex_compile import MultiDFA
+
+
+def strings_to_batch(strings: Sequence[bytes], max_len: int) -> Tuple[np.ndarray, np.ndarray]:
+    """→ (bytes [B, max_len] int32, lengths [B] int32); overlong strings
+    are marked length -1 (never match — fail closed)."""
+    b = len(strings)
+    out = np.zeros((b, max_len), np.int32)
+    lens = np.zeros(b, np.int32)
+    for i, s in enumerate(strings):
+        if len(s) > max_len:
+            lens[i] = -1
+            continue
+        out[i, : len(s)] = np.frombuffer(s, np.uint8)
+        lens[i] = len(s)
+    return out, lens
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def dfa_match_batch(
+    trans: jnp.ndarray,  # [Q, 256] int32 (state 0 = dead)
+    accept_lo: jnp.ndarray,  # [Q] uint32
+    accept_hi: jnp.ndarray,  # [Q] uint32
+    start: jnp.ndarray,  # [] int32
+    str_bytes: jnp.ndarray,  # [B, max_len] int32
+    lengths: jnp.ndarray,  # [B] int32 (-1 = fail closed)
+    max_len: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """→ (mask_lo [B] uint32, mask_hi [B] uint32)."""
+    b = str_bytes.shape[0]
+    flat = trans.reshape(-1)
+    state = jnp.full((b,), start, jnp.int32)
+
+    def step(lvl, state):
+        byte = str_bytes[:, lvl]
+        nxt = jnp.take(flat, state * 256 + byte)
+        return jnp.where(lvl < lengths, nxt, state)
+
+    state = jax.lax.fori_loop(0, max_len, step, state)
+    ok = lengths >= 0
+    lo = jnp.where(ok, jnp.take(accept_lo, state), jnp.uint32(0))
+    hi = jnp.where(ok, jnp.take(accept_hi, state), jnp.uint32(0))
+    return lo, hi
+
+
+def device_dfa(dfa: MultiDFA) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Host MultiDFA → device arrays (accept u64 split into u32 words)."""
+    lo = (dfa.accept & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (dfa.accept >> np.uint64(32)).astype(np.uint32)
+    return (
+        jnp.asarray(dfa.trans),
+        jnp.asarray(lo),
+        jnp.asarray(hi),
+        jnp.asarray(np.int32(dfa.start)),
+    )
+
+
+def match_patterns(
+    dfa: MultiDFA, strings: Sequence[bytes], max_len: int = 128
+) -> np.ndarray:
+    """Convenience host API → [B] uint64 accept masks."""
+    sb, lens = strings_to_batch(strings, max_len)
+    lo, hi = dfa_match_batch(
+        *device_dfa(dfa), jnp.asarray(sb), jnp.asarray(lens), max_len
+    )
+    return np.asarray(lo).astype(np.uint64) | (np.asarray(hi).astype(np.uint64) << np.uint64(32))

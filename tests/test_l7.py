@@ -1,0 +1,206 @@
+"""L7 tests: regex→DFA differential vs Python re, HTTP policy vs the
+HTTPRule oracle, Kafka ACL vs the KafkaRule oracle, proxy lifecycle."""
+
+from __future__ import annotations
+
+import random
+import re
+import string
+
+import numpy as np
+import pytest
+
+from cilium_tpu.l7 import HTTPPolicy, HTTPRequest, KafkaACL, KafkaRequest, RegexError, compile_patterns
+from cilium_tpu.ops.dfa import match_patterns
+from cilium_tpu.policy.api import HTTPRule, KafkaRule
+from cilium_tpu.proxy import AccessLogServer, Proxy
+
+
+class TestRegexDFA:
+    CASES = [
+        ("/api/v1/.*", ["/api/v1/", "/api/v1/x", "/api/v2/x", "/api/v1"]),
+        ("GET|POST", ["GET", "POST", "PUT", "GE", "GETX"]),
+        ("/users/[0-9]+", ["/users/1", "/users/123", "/users/", "/users/abc"]),
+        ("[a-z]{2,4}", ["ab", "abcd", "a", "abcde", "AB"]),
+        ("a+b*c?", ["a", "aab", "abc", "c", "aabbc"]),
+        ("foo\\.bar", ["foo.bar", "fooxbar"]),
+        ("(ab|cd)+", ["ab", "abcd", "cdab", "abc", ""]),
+        ("[^/]+", ["abc", "a/b", ""]),
+        ("a{3}", ["aaa", "aa", "aaaa"]),
+        ("a{2,}", ["a", "aa", "aaaaa"]),
+        ("h.llo", ["hello", "hallo", "hllo", "hxllo"]),
+        ("\\d+-\\d+", ["12-34", "1-2", "a-b", "12-"]),
+        ("/health/?", ["/health", "/health/", "/health//"]),
+        ("", ["", "a"]),
+    ]
+
+    @pytest.mark.parametrize("pattern,probes", CASES)
+    def test_single_pattern_vs_re(self, pattern, probes):
+        dfa = compile_patterns([pattern])
+        for probe in probes:
+            want = re.fullmatch(pattern, probe) is not None
+            got = dfa.match_str(probe.encode()) & 1 == 1
+            assert got == want, f"{pattern!r} vs {probe!r}: dfa={got} re={want}"
+
+    def test_multi_pattern_masks(self):
+        pats = ["/api/.*", "/health", "GET", ".*\\.html"]
+        dfa = compile_patterns(pats)
+        probes = ["/api/x", "/health", "GET", "index.html", "/api/a.html", "zzz"]
+        masks = match_patterns(dfa, [p.encode() for p in probes], max_len=32)
+        for probe, mask in zip(probes, masks):
+            for i, pat in enumerate(pats):
+                want = re.fullmatch(pat, probe) is not None
+                got = (int(mask) >> i) & 1 == 1
+                assert got == want, f"{pat!r} vs {probe!r}"
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_randomized_differential(self, seed):
+        rng = random.Random(seed)
+        alphabet = "abc01/."
+
+        def rand_pattern(depth=0):
+            parts = []
+            for _ in range(rng.randint(1, 4)):
+                roll = rng.random()
+                if roll < 0.45 or depth > 2:
+                    atom = re.escape(rng.choice(alphabet))
+                elif roll < 0.6:
+                    atom = "."
+                elif roll < 0.75:
+                    chars = "".join(rng.sample("abc01", rng.randint(1, 3)))
+                    atom = f"[{chars}]"
+                else:
+                    atom = "(" + rand_pattern(depth + 1) + ")"
+                q = rng.random()
+                if q < 0.2:
+                    atom += "*"
+                elif q < 0.3:
+                    atom += "+"
+                elif q < 0.4:
+                    atom += "?"
+                parts.append(atom)
+            if rng.random() < 0.3:
+                return "|".join(["".join(parts), rand_pattern(depth + 1)])
+            return "".join(parts)
+
+        pats = [rand_pattern() for _ in range(8)]
+        dfa = compile_patterns(pats)
+        probes = [
+            "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 8)))
+            for _ in range(200)
+        ]
+        masks = match_patterns(dfa, [p.encode() for p in probes], max_len=16)
+        for probe, mask in zip(probes, masks):
+            for i, pat in enumerate(pats):
+                want = re.fullmatch(pat, probe) is not None
+                got = (int(mask) >> i) & 1 == 1
+                assert got == want, f"seed {seed}: {pat!r} vs {probe!r}: dfa={got} re={want}"
+
+    def test_state_cap(self):
+        with pytest.raises(RegexError):
+            compile_patterns(["(a|b){20}(c|d){20}(e|f){20}"], max_states=64)
+
+    def test_overlong_string_fails_closed(self):
+        dfa = compile_patterns([".*"])
+        masks = match_patterns(dfa, [b"x" * 1000], max_len=64)
+        assert int(masks[0]) == 0
+
+
+class TestHTTPPolicy:
+    def test_oracle_parity(self):
+        rules = [
+            HTTPRule(method="GET", path="/public/.*"),
+            HTTPRule(method="POST", path="/api/v[0-9]+/submit", host="api\\.example\\.com"),
+            HTTPRule(path="/health"),
+        ]
+        pol = HTTPPolicy([(r, None) for r in rules])
+        reqs = [
+            HTTPRequest("GET", "/public/x"),
+            HTTPRequest("GET", "/private/x"),
+            HTTPRequest("POST", "/api/v2/submit", host="api.example.com"),
+            HTTPRequest("POST", "/api/v2/submit", host="evil.com"),
+            HTTPRequest("DELETE", "/health"),
+            HTTPRequest("GET", "/health"),
+        ]
+        got = pol.check_batch(reqs)
+        for req, g in zip(reqs, got):
+            want = any(r.matches(req.method, req.path, req.host, req.header_dict()) for r in rules)
+            assert bool(g) == want, f"{req}"
+
+    def test_identity_scoping(self):
+        rule = HTTPRule(method="GET")
+        pol = HTTPPolicy([(rule, {100})])
+        assert pol.check(HTTPRequest("GET", "/", src_identity=100))
+        assert not pol.check(HTTPRequest("GET", "/", src_identity=200))
+
+    def test_header_matching(self):
+        rule = HTTPRule(headers=("X-Token: secret", "X-Flag"))
+        pol = HTTPPolicy([(rule, None)])
+        ok = HTTPRequest("GET", "/", headers=(("X-Token", "secret"), ("X-Flag", "1")))
+        bad = HTTPRequest("GET", "/", headers=(("X-Token", "wrong"), ("X-Flag", "1")))
+        missing = HTTPRequest("GET", "/", headers=(("X-Token", "secret"),))
+        assert pol.check(ok) and not pol.check(bad) and not pol.check(missing)
+
+    def test_empty_rules_allow_all(self):
+        pol = HTTPPolicy([])
+        assert pol.check(HTTPRequest("BREW", "/coffee"))
+
+
+class TestKafkaACL:
+    def test_oracle_parity(self):
+        rules = [
+            KafkaRule(role="produce", topic="logs"),
+            KafkaRule(api_key="fetch", topic="metrics", api_version="2"),
+            KafkaRule(client_id="admin"),
+        ]
+        acl = KafkaACL([(r, None) for r in rules])
+        reqs = [
+            KafkaRequest(api_key=0, topic="logs"),       # produce on logs
+            KafkaRequest(api_key=0, topic="other"),      # produce on wrong topic
+            KafkaRequest(api_key=1, topic="metrics", api_version=2),
+            KafkaRequest(api_key=1, topic="metrics", api_version=3),
+            KafkaRequest(api_key=19, client_id="admin"),
+            KafkaRequest(api_key=19, client_id="guest"),
+            KafkaRequest(api_key=3, topic="logs"),       # metadata in produce role
+        ]
+        got = acl.check_batch(reqs)
+        for req, g in zip(reqs, got):
+            want = any(
+                r.matches(req.api_key, req.api_version, req.client_id, req.topic)
+                for r in rules
+            )
+            assert bool(g) == want, f"{req}"
+
+    def test_identity_scoping(self):
+        acl = KafkaACL([(KafkaRule(topic="t"), {5})])
+        assert acl.check(KafkaRequest(api_key=0, topic="t", src_identity=5))
+        assert not acl.check(KafkaRequest(api_key=0, topic="t", src_identity=6))
+
+
+class TestProxy:
+    def test_redirect_lifecycle_and_ports(self):
+        p = Proxy()
+        r1 = p.create_or_update_redirect(1, 80, "http")
+        r2 = p.create_or_update_redirect(2, 80, "http")
+        assert r1.proxy_port != r2.proxy_port
+        assert 10000 <= r1.proxy_port < 20000
+        # update keeps port
+        r1b = p.create_or_update_redirect(1, 80, "http")
+        assert r1b.proxy_port == r1.proxy_port
+        with pytest.raises(ValueError):
+            p.create_or_update_redirect(1, 80, "kafka")
+        assert p.remove_redirect(1, 80)
+        assert not p.remove_redirect(1, 80)
+        r3 = p.create_or_update_redirect(3, 9092, "kafka")
+        assert r3.parser == "kafka"
+
+    def test_enforcement_and_accesslog(self):
+        p = Proxy()
+        pol = HTTPPolicy([(HTTPRule(method="GET"), None)])
+        r = p.create_or_update_redirect(1, 80, "http", http_policy=pol)
+        allows = p.check_http(r, [HTTPRequest("GET", "/"), HTTPRequest("POST", "/")])
+        assert list(allows) == [True, False]
+        recent = p.accesslog.recent()
+        assert len(recent) == 2
+        assert recent[0].verdict == "Forwarded" and recent[1].verdict == "Denied"
+        assert recent[1].http["code"] == 403
