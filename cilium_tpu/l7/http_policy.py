@@ -65,19 +65,37 @@ class _PatternSet:
             self.fallback = True
 
     def masks(self, values: Sequence[str], max_len: int) -> np.ndarray:
-        """[B] uint64 accept masks for a batch of field values."""
+        """[B] uint64 accept masks for a batch of field values.
+
+        Values longer than ``max_len`` can't ride the fixed-width DFA
+        batch, so they walk the same DFA host-side (linear time — no
+        backtracking a long attacker-controlled string could exploit)
+        instead of silently never matching (long request paths are
+        common enough that fail-closed here would diverge from the
+        reference)."""
         if not self.patterns:
             return np.zeros(len(values), np.uint64)
         if self.dfa is not None and not self.fallback:
-            return match_patterns(self.dfa, [v.encode() for v in values], max_len)
-        out = np.zeros(len(values), np.uint64)
-        for i, v in enumerate(values):
-            m = 0
-            for pid, p in enumerate(self.patterns):
-                if re.fullmatch(p, v):
-                    m |= 1 << pid
-            out[i] = m
-        return out
+            encs = [v.encode() for v in values]
+            out = match_patterns(self.dfa, encs, max_len)
+            for i, enc in enumerate(encs):
+                if len(enc) > max_len:
+                    out[i] = np.uint64(self.dfa.match_str(enc))
+            return out
+        # DFA compile overflowed the state cap: host `re` is the only
+        # engine left. re.error propagates loudly — a pattern this
+        # parser accepts but `re` rejects must not silently never-match.
+        return np.array(
+            [
+                sum(
+                    1 << pid
+                    for pid, p in enumerate(self.patterns)
+                    if re.fullmatch(p, v)
+                )
+                for v in values
+            ],
+            np.uint64,
+        )
 
 
 @dataclasses.dataclass
@@ -98,7 +116,7 @@ class HTTPPolicy:
     def __init__(
         self,
         rules: Sequence[Tuple[HTTPRule, Optional[Set[int]]]],
-        max_len: int = 128,
+        max_len: int = 256,
     ) -> None:
         self.max_len = max_len
         self._methods = _PatternSet()

@@ -39,11 +39,13 @@ class KafkaACL:
         self._topic_ids: Dict[str, int] = {}
         r = len(rules)
         self.key_mask = np.zeros(r, np.uint32)  # bit k = api_key k allowed
+        self.key_wild = np.zeros(r, bool)  # rule has no api-key restriction
         self.version = np.full(r, -1, np.int32)  # -1 = wildcard
         self.topic_id = np.full(r, -1, np.int32)
         self.client_id: List[str] = []
         for i, (rule, _idents) in enumerate(rules):
             keys = rule.allowed_api_keys()
+            self.key_wild[i] = not keys
             self.key_mask[i] = (
                 np.uint32(0xFFFFFFFF)
                 if not keys
@@ -78,8 +80,12 @@ class KafkaACL:
         # [B, R] broadcast compares (the device-friendly form; numpy here
         # because L7 batch sizes are modest — the same expressions jit
         # directly when wired into the proxy fast path).
-        key_ok = (self.key_mask[None, :] >> api_key[:, None].clip(0, 31)) & 1 == 1
-        key_ok &= api_key[:, None] < 32
+        # Real api keys exceed 31 (DescribeConfigs=32, SaslAuthenticate=36);
+        # the 32-bit mask only constrains rules with an explicit key set —
+        # wildcard rules match every key.
+        in_mask = (self.key_mask[None, :] >> api_key[:, None].clip(0, 31)) & 1 == 1
+        in_range = (api_key[:, None] >= 0) & (api_key[:, None] < 32)
+        key_ok = self.key_wild[None, :] | (in_mask & in_range)
         ver_ok = (self.version[None, :] < 0) | (self.version[None, :] == version[:, None])
         top_ok = (self.topic_id[None, :] < 0) | (self.topic_id[None, :] == topic[:, None])
         ok = key_ok & ver_ok & top_ok

@@ -57,6 +57,24 @@ class _NFA:
 
 _META = set("().[]*+?{}|\\^$")
 
+_ESCAPE_CLASSES = {
+    "d": set(range(ord("0"), ord("9") + 1)),
+    "w": set(range(ord("a"), ord("z") + 1))
+    | set(range(ord("A"), ord("Z") + 1))
+    | set(range(ord("0"), ord("9") + 1))
+    | {ord("_")},
+    "s": {0x20, 0x09, 0x0A, 0x0D, 0x0B, 0x0C},
+}
+
+
+def _class_for_escape(c: str) -> Optional[Set[int]]:
+    """\\d/\\w/\\s → byte set, uppercase → complement, else None."""
+    if c in _ESCAPE_CLASSES:
+        return _ESCAPE_CLASSES[c]
+    if c.isupper() and c.lower() in _ESCAPE_CLASSES:
+        return set(range(ALPHABET)) - _ESCAPE_CLASSES[c.lower()]
+    return None
+
 
 class _Parser:
     """Grammar: alt := concat ('|' concat)* ; concat := repeat* ;
@@ -252,18 +270,9 @@ class _Parser:
         return self._byte_set({ord(c)})
 
     def _escape(self, c: str) -> Tuple[int, int]:
-        classes = {
-            "d": set(range(ord("0"), ord("9") + 1)),
-            "w": set(range(ord("a"), ord("z") + 1))
-            | set(range(ord("A"), ord("Z") + 1))
-            | set(range(ord("0"), ord("9") + 1))
-            | {ord("_")},
-            "s": {0x20, 0x09, 0x0A, 0x0D, 0x0B, 0x0C},
-        }
-        if c in classes:
-            return self._byte_set(classes[c])
-        if c.upper() in classes and c.isupper():
-            return self._byte_set(set(range(ALPHABET)) - classes[c.lower()])
+        cls = _class_for_escape(c)
+        if cls is not None:
+            return self._byte_set(cls)
         return self._byte_set({ord(c)})
 
     def _char_class(self) -> Tuple[int, int]:
@@ -285,6 +294,10 @@ class _Parser:
             self.take()
             if c == "\\":
                 nxt = self.take()
+                cls = _class_for_escape(nxt)
+                if cls is not None:
+                    chars |= cls
+                    continue
                 cv = ord(nxt)
             else:
                 cv = ord(c)

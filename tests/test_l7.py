@@ -105,6 +105,24 @@ class TestRegexDFA:
         masks = match_patterns(dfa, [b"x" * 1000], max_len=64)
         assert int(masks[0]) == 0
 
+    @pytest.mark.parametrize(
+        "pattern,probes",
+        [
+            ("\\D+", ["abc", "123", "a1"]),
+            ("\\S+", ["abc", "a b", " "]),
+            ("\\W+", ["--", "ab", "_"]),
+            ("[\\d]+", ["123", "abc", "1a"]),
+            ("[\\w.]+", ["a.b_1", "a b", "..."]),
+            ("[^\\d]+", ["abc", "1", "a1"]),
+        ],
+    )
+    def test_negated_and_class_escapes(self, pattern, probes):
+        dfa = compile_patterns([pattern])
+        for probe in probes:
+            want = re.fullmatch(pattern, probe) is not None
+            got = dfa.match_str(probe.encode()) & 1 == 1
+            assert got == want, f"{pattern!r} vs {probe!r}: dfa={got} re={want}"
+
 
 class TestHTTPPolicy:
     def test_oracle_parity(self):
@@ -145,6 +163,14 @@ class TestHTTPPolicy:
         pol = HTTPPolicy([])
         assert pol.check(HTTPRequest("BREW", "/coffee"))
 
+    def test_overlong_path_takes_host_fallback(self):
+        # Long request paths must still match allow rules (advisor
+        # finding: fail-closed divergence at common path lengths).
+        pol = HTTPPolicy([(HTTPRule(path="/a.*"), None)], max_len=64)
+        long_path = "/a" + "x" * 500
+        assert pol.check(HTTPRequest("GET", long_path))
+        assert not pol.check(HTTPRequest("GET", "/b" + "x" * 500))
+
 
 class TestKafkaACL:
     def test_oracle_parity(self):
@@ -170,6 +196,17 @@ class TestKafkaACL:
                 for r in rules
             )
             assert bool(g) == want, f"{req}"
+
+    def test_wildcard_rule_allows_high_api_keys(self):
+        # DescribeConfigs=32, SaslAuthenticate=36 exceed the 32-bit key
+        # mask; a rule with no api-key restriction must still allow them.
+        acl = KafkaACL([(KafkaRule(topic="logs"), None)])
+        assert acl.check(KafkaRequest(api_key=32, topic="logs"))
+        assert acl.check(KafkaRequest(api_key=36, topic="logs"))
+        assert not acl.check(KafkaRequest(api_key=36, topic="other"))
+        # but an explicit key set still clamps high keys out
+        keyed = KafkaACL([(KafkaRule(api_key="fetch"), None)])
+        assert not keyed.check(KafkaRequest(api_key=36))
 
     def test_identity_scoping(self):
         acl = KafkaACL([(KafkaRule(topic="t"), {5})])
