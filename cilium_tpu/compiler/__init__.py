@@ -9,11 +9,25 @@ that a jitted verdict kernel evaluates for *batches* of flows.
 """
 
 from .selectors import SelectorTable
-from .program import CompiledPolicy, DirectionProgram, compile_policy
+from .program import (
+    CompiledPolicy,
+    CompileState,
+    DirectionPacker,
+    DirectionProgram,
+    compile_policy,
+    compile_policy_state,
+    host_selector_matches,
+    try_append_rules,
+)
 
 __all__ = [
     "SelectorTable",
     "CompiledPolicy",
+    "CompileState",
+    "DirectionPacker",
     "DirectionProgram",
     "compile_policy",
+    "compile_policy_state",
+    "host_selector_matches",
+    "try_append_rules",
 ]

@@ -92,6 +92,13 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return size
 
 
+def _bucket_slack(n: int, minimum: int = 8) -> int:
+    """Bucket with ≥25% headroom so incremental rule appends usually fit
+    without a reshape-forced full recompile (only the cheap non-selector
+    axes use this — S² matrices keep exact buckets)."""
+    return _bucket(n + max(4, n // 4), minimum)
+
+
 def _pad_bool(values: Sequence[bool], size: int) -> np.ndarray:
     out = np.zeros(size, dtype=bool)
     out[: len(values)] = values
@@ -235,92 +242,208 @@ def _extract_direction(
     return _RawDirection(deny, allow, entries, group_no_peers, gp, l7_ports)
 
 
+class DirectionPacker:
+    """Stateful matrix packer for one direction: builds the
+    DirectionProgram from raw lists and supports **in-place appends**
+    of later rule batches, provided every axis stays inside its padded
+    bucket. This is the incremental half of the regeneration protocol
+    (pkg/endpoint/policy.go:506-552): a single rule import mutates a
+    few matrix cells instead of recompiling the world."""
+
+    def __init__(self, raw: _RawDirection, s_pad: int) -> None:
+        self.s_pad = s_pad
+        self.n_groups = len(raw.group_no_peers)
+        self.entries: List[Tuple[int, int, int, int, bool, int]] = []
+        self.l7_list: List[Tuple[int, int, int]] = []
+
+        # Port vocabulary over entries ∪ L7 ports (L7 is always TCP).
+        self.port_id: Dict[Tuple[int, int], int] = {}
+        for e in raw.entries:
+            self.port_id.setdefault((e[2], e[3]), len(self.port_id))
+        for l in raw.l7_ports:
+            self.port_id.setdefault((l[1], PROTO_TCP_N), len(self.port_id))
+        p4 = _bucket_slack(len(self.port_id))
+        ports = np.full(p4, -1, np.int32)
+        protos = np.full(p4, -1, np.int32)
+        for (port, proto), i in self.port_id.items():
+            ports[i], protos[i] = port, proto
+
+        # K1 combos: (subj_sel, port_id) with explicit/other peer sets.
+        self.combo_id: Dict[Tuple[int, int], int] = {}
+        for subj, _sid, port, proto, _expl, _group in raw.entries:
+            self.combo_id.setdefault((subj, self.port_id[(port, proto)]), len(self.combo_id))
+        k1 = _bucket_slack(len(self.combo_id))
+
+        g = _bucket_slack(max(1, self.n_groups))
+
+        # K7 combos: (subj_sel, port_id, group) for L7 presence.
+        self.k7_ids: Dict[Tuple[int, int, int], int] = {}
+        for subj, port, group in raw.l7_ports:
+            self.k7_ids.setdefault(
+                (subj, self.port_id[(port, PROTO_TCP_N)], group), len(self.k7_ids)
+            )
+        k7 = _bucket_slack(len(self.k7_ids))
+
+        self.prog = DirectionProgram(
+            s_pad=s_pad,
+            deny_mat=np.zeros((s_pad, s_pad), np.int8),
+            allow_mat=np.zeros((s_pad, s_pad), np.int8),
+            ports=ports,
+            protos=protos,
+            s1_mat=np.zeros((s_pad, k1), np.int8),
+            p1_mat=np.zeros((p4, k1), np.int8),
+            en_mat=np.zeros((k1, s_pad), np.int8),
+            ee_mat=np.zeros((k1, s_pad), np.int8),
+            gpn_mat=np.zeros((s_pad, g), np.int8),
+            gpe_mat=np.zeros((s_pad, g), np.int8),
+            group_no_peers=np.zeros(g, bool),
+            s7_mat=np.zeros((s_pad, k7), np.int8),
+            p7_mat=np.zeros((p4, k7), np.int8),
+            g7_mat=np.zeros((g, k7), np.int8),
+            e_subj=np.zeros(0, np.int32),
+            e_port=np.zeros(0, np.int32),
+            e_proto=np.zeros(0, np.int32),
+            l7_subj=np.zeros(0, np.int32),
+            l7_port=np.zeros(0, np.int32),
+        )
+        self.n_groups = 0
+        # Cell-level write log: (matrix, i, j, value). Appends record
+        # their writes here so the engine can patch device tables with
+        # tiny scatters instead of re-uploading whole matrices.
+        self.writes: List[Tuple[str, int, int, int]] = []
+        self._write(raw, group_offset=0)
+        self.writes.clear()  # initial build uploads wholesale
+
+    def take_writes(self) -> List[Tuple[str, int, int, int]]:
+        w, self.writes = self.writes, []
+        return w
+
+    # ------------------------------------------------------------------
+    def can_append(self, raw: _RawDirection) -> bool:
+        """True iff ``raw`` fits the existing buckets (no shape change)."""
+        p = self.prog
+        new_ports = set()
+        for e in raw.entries:
+            if (e[2], e[3]) not in self.port_id:
+                new_ports.add((e[2], e[3]))
+        for l in raw.l7_ports:
+            if (l[1], PROTO_TCP_N) not in self.port_id:
+                new_ports.add((l[1], PROTO_TCP_N))
+        if len(self.port_id) + len(new_ports) > p.ports.size:
+            return False
+        # combos/k7 need port ids; count conservatively with new keys
+        pid_probe = dict(self.port_id)
+        for key in new_ports:
+            pid_probe[key] = len(pid_probe)
+        new_combos = {
+            (e[0], pid_probe[(e[2], e[3])])
+            for e in raw.entries
+            if (e[0], pid_probe[(e[2], e[3])]) not in self.combo_id
+        }
+        if len(self.combo_id) + len(new_combos) > p.s1_mat.shape[1]:
+            return False
+        if self.n_groups + len(raw.group_no_peers) > p.gpn_mat.shape[1]:
+            return False
+        off = self.n_groups
+        new_k7 = {
+            (l[0], pid_probe[(l[1], PROTO_TCP_N)], l[2] + off)
+            for l in raw.l7_ports
+        }
+        if len(self.k7_ids) + len(new_k7 - set(self.k7_ids)) > p.s7_mat.shape[1]:
+            return False
+        max_sel = -1
+        for s1, s2 in raw.deny + raw.allow:
+            max_sel = max(max_sel, s1, s2)
+        for e in raw.entries:
+            max_sel = max(max_sel, e[0], e[1])
+        for _g, sid, _x in raw.gp:
+            max_sel = max(max_sel, sid)
+        return max_sel < self.s_pad
+
+    def append(self, raw: _RawDirection) -> None:
+        """In-place append (caller must have checked ``can_append``)."""
+        self._write(raw, group_offset=self.n_groups)
+
+    # ------------------------------------------------------------------
+    def _port(self, port: int, proto: int) -> int:
+        key = (port, proto)
+        pid = self.port_id.get(key)
+        if pid is None:
+            pid = len(self.port_id)
+            self.port_id[key] = pid
+            self.prog.ports[pid] = port
+            self.prog.protos[pid] = proto
+            self.writes.append(("port_vocab", pid, port, proto))
+        return pid
+
+    def _set(self, name: str, mat: np.ndarray, i: int, j: int) -> None:
+        if not mat[i, j]:
+            mat[i, j] = 1
+            self.writes.append((name, i, j, 1))
+
+    def _write(self, raw: _RawDirection, group_offset: int) -> None:
+        p = self.prog
+        for s1, s2 in raw.deny:
+            self._set("deny", p.deny_mat, s1, s2)
+        for s1, s2 in raw.allow:
+            self._set("allow", p.allow_mat, s1, s2)
+
+        for subj, sid, port, proto, expl, group in raw.entries:
+            pid = self._port(port, proto)
+            key = (subj, pid)
+            k = self.combo_id.setdefault(key, len(self.combo_id))
+            self._set("s1", p.s1_mat, subj, k)
+            self._set("p1", p.p1_mat, pid, k)
+            if expl:
+                self._set("ee", p.ee_mat, k, sid)
+            else:
+                self._set("en", p.en_mat, k, sid)
+            self.entries.append((subj, sid, port, proto, expl, group + group_offset))
+
+        for i, no_peers in enumerate(raw.group_no_peers):
+            p.group_no_peers[group_offset + i] = no_peers
+            if no_peers:
+                self.writes.append(("group_no_peers", group_offset + i, 0, 1))
+        for group, sid, expl in raw.gp:
+            name, mat = ("gpe", p.gpe_mat) if expl else ("gpn", p.gpn_mat)
+            self._set(name, mat, sid, group + group_offset)
+        self.n_groups += len(raw.group_no_peers)
+
+        for subj, port, group in raw.l7_ports:
+            pid = self._port(port, PROTO_TCP_N)
+            k = self.k7_ids.setdefault((subj, pid, group + group_offset), len(self.k7_ids))
+            self._set("s7", p.s7_mat, subj, k)
+            self._set("p7", p.p7_mat, pid, k)
+            self._set("g7", p.g7_mat, group + group_offset, k)
+            self.l7_list.append((subj, port, group + group_offset))
+
+        # refresh raw entry views for host-side consumers
+        p.e_subj = np.asarray([e[0] for e in self.entries], np.int32)
+        p.e_port = np.asarray([e[2] for e in self.entries], np.int32)
+        p.e_proto = np.asarray([e[3] for e in self.entries], np.int32)
+        p.l7_subj = np.asarray([l[0] for l in self.l7_list], np.int32)
+        p.l7_port = np.asarray([l[1] for l in self.l7_list], np.int32)
+
+
 def _pack_direction(raw: _RawDirection, s_pad: int) -> DirectionProgram:
-    deny_mat = np.zeros((s_pad, s_pad), np.int8)
-    for s1, s2 in raw.deny:
-        deny_mat[s1, s2] = 1
-    allow_mat = np.zeros((s_pad, s_pad), np.int8)
-    for s1, s2 in raw.allow:
-        allow_mat[s1, s2] = 1
-
-    # Port vocabulary over entries ∪ L7 ports (L7 is always TCP).
-    port_id: Dict[Tuple[int, int], int] = {}
-    for e in raw.entries:
-        port_id.setdefault((e[2], e[3]), len(port_id))
-    for l in raw.l7_ports:
-        port_id.setdefault((l[1], PROTO_TCP_N), len(port_id))
-    p4 = _bucket(len(port_id))
-    ports = np.full(p4, -1, np.int32)
-    protos = np.full(p4, -1, np.int32)
-    for (port, proto), i in port_id.items():
-        ports[i], protos[i] = port, proto
-
-    # K1 combos: (subj_sel, port_id) with explicit/other peer matrices.
-    combo_id: Dict[Tuple[int, int], int] = {}
-    combo_peers: List[List[Tuple[int, bool]]] = []
-    for subj, sid, port, proto, expl, _group in raw.entries:
-        key = (subj, port_id[(port, proto)])
-        k = combo_id.setdefault(key, len(combo_peers))
-        if k == len(combo_peers):
-            combo_peers.append([])
-        combo_peers[k].append((sid, expl))
-    k1 = _bucket(len(combo_id))
-    s1_mat = np.zeros((s_pad, k1), np.int8)
-    p1_mat = np.zeros((p4, k1), np.int8)
-    en_mat = np.zeros((k1, s_pad), np.int8)
-    ee_mat = np.zeros((k1, s_pad), np.int8)
-    for (subj, pid), k in combo_id.items():
-        s1_mat[subj, k] = 1
-        p1_mat[pid, k] = 1
-        for sid, expl in combo_peers[k]:
-            (ee_mat if expl else en_mat)[k, sid] = 1
-
-    g = _bucket(len(raw.group_no_peers))
-    gpn_mat = np.zeros((s_pad, g), np.int8)
-    gpe_mat = np.zeros((s_pad, g), np.int8)
-    for group, sid, expl in raw.gp:
-        (gpe_mat if expl else gpn_mat)[sid, group] = 1
-    no_peers = _pad_bool(raw.group_no_peers, g)
-
-    # K7 combos: (subj_sel, port_id, group) for L7 presence.
-    k7_ids: Dict[Tuple[int, int, int], int] = {}
-    for subj, port, group in raw.l7_ports:
-        k7_ids.setdefault((subj, port_id[(port, PROTO_TCP_N)], group), len(k7_ids))
-    k7_keys = list(k7_ids)
-    k7 = _bucket(len(k7_keys))
-    s7_mat = np.zeros((s_pad, k7), np.int8)
-    p7_mat = np.zeros((p4, k7), np.int8)
-    g7_mat = np.zeros((g, k7), np.int8)
-    for i, (subj, pid, group) in enumerate(k7_keys):
-        s7_mat[subj, i] = 1
-        p7_mat[pid, i] = 1
-        g7_mat[group, i] = 1
-
-    return DirectionProgram(
-        s_pad=s_pad,
-        deny_mat=deny_mat,
-        allow_mat=allow_mat,
-        ports=ports,
-        protos=protos,
-        s1_mat=s1_mat,
-        p1_mat=p1_mat,
-        en_mat=en_mat,
-        ee_mat=ee_mat,
-        gpn_mat=gpn_mat,
-        gpe_mat=gpe_mat,
-        group_no_peers=no_peers,
-        s7_mat=s7_mat,
-        p7_mat=p7_mat,
-        g7_mat=g7_mat,
-        e_subj=np.asarray([e[0] for e in raw.entries], np.int32),
-        e_port=np.asarray([e[2] for e in raw.entries], np.int32),
-        e_proto=np.asarray([e[3] for e in raw.entries], np.int32),
-        l7_subj=np.asarray([l[0] for l in raw.l7_ports], np.int32),
-        l7_port=np.asarray([l[1] for l in raw.l7_ports], np.int32),
-    )
+    return DirectionPacker(raw, s_pad).prog
 
 
-def compile_policy(repo: Repository, registry: IdentityRegistry) -> CompiledPolicy:
+@dataclasses.dataclass
+class CompileState:
+    """Persistent compiler state for incremental appends: the selector
+    interner, per-direction packers, and how many selectors have been
+    lowered into the conjunct arrays so far."""
+
+    table: SelectorTable
+    ingress: DirectionPacker
+    egress: DirectionPacker
+    lowered_selectors: int
+
+
+def compile_policy_state(
+    repo: Repository, registry: IdentityRegistry
+) -> Tuple[CompiledPolicy, CompileState]:
     """Lower repository + identities to dense tables.
 
     Order matters: selectors intern their vocab bits first, then the
@@ -339,8 +462,8 @@ def compile_policy(repo: Repository, registry: IdentityRegistry) -> CompiledPoli
     # tail never matches (no conjuncts) and relation matrices are zero
     # there.
     s_pad = max(128, ((len(table) + 127) // 128) * 128)
-    ingress = _pack_direction(raw_ingress, s_pad)
-    egress = _pack_direction(raw_egress, s_pad)
+    ing_packer = DirectionPacker(raw_ingress, s_pad)
+    eg_packer = DirectionPacker(raw_egress, s_pad)
 
     vocab = registry.vocab
     lowered = table.lower_bits(vocab)
@@ -350,7 +473,7 @@ def compile_policy(repo: Repository, registry: IdentityRegistry) -> CompiledPoli
     conj_req, conj_forbid, conj_valid, req_count = table.pack(lowered, vocab, num_words)
 
     id_to_row = {int(i): r for r, i in enumerate(row_ids) if row_live[r]}
-    return CompiledPolicy(
+    compiled = CompiledPolicy(
         revision=revision,
         identity_version=registry.version,
         vocab_version=vocab.version,
@@ -364,6 +487,114 @@ def compile_policy(repo: Repository, registry: IdentityRegistry) -> CompiledPoli
         conj_forbid=conj_forbid,
         conj_valid=conj_valid,
         req_count=req_count,
-        ingress=ingress,
-        egress=egress,
+        ingress=ing_packer.prog,
+        egress=eg_packer.prog,
     )
+    return compiled, CompileState(
+        table=table,
+        ingress=ing_packer,
+        egress=eg_packer,
+        lowered_selectors=len(table),
+    )
+
+
+def compile_policy(repo: Repository, registry: IdentityRegistry) -> CompiledPolicy:
+    return compile_policy_state(repo, registry)[0]
+
+
+def try_append_rules(
+    compiled: CompiledPolicy,
+    state: CompileState,
+    registry: IdentityRegistry,
+    rules: Sequence[Rule],
+    new_revision: int,
+) -> Optional[Tuple[int, int]]:
+    """Append ``rules`` into the compiled tables **in place**.
+
+    Returns the (old, new) selector count on success, or None when a
+    full rebuild is required (selector/port/combo/group bucket overflow,
+    vocab word growth, or conjunct-slot growth). On None the caller
+    must recompile from scratch; the partially-grown interner state is
+    discarded there, so bailing is always safe.
+    """
+    table = state.table
+    old_len = len(table)
+    raw_in = _extract_direction(rules, table, ingress=True)
+    raw_eg = _extract_direction(rules, table, ingress=False)
+    if len(table) > compiled.ingress.s_pad:
+        return None
+    vocab = registry.vocab
+    new_lowered = [
+        table.selector(sid).conjuncts(vocab) for sid in range(old_len, len(table))
+    ]
+    if vocab.num_words > compiled.num_words:
+        return None
+    cps = compiled.conj_req.shape[1]
+    if any(len(c) > cps for c in new_lowered):
+        return None
+    if not (state.ingress.can_append(raw_in) and state.egress.can_append(raw_eg)):
+        return None
+
+    state.ingress.append(raw_in)
+    state.egress.append(raw_eg)
+    for i, conjs in enumerate(new_lowered):
+        sid = old_len + i
+        for j, (require, forbid) in enumerate(conjs):
+            compiled.conj_req[sid, j] = vocab.pack(require, compiled.num_words)
+            compiled.conj_forbid[sid, j] = vocab.pack(forbid, compiled.num_words)
+            compiled.conj_valid[sid, j] = True
+            compiled.req_count[sid, j] = len(set(require))
+    compiled.num_selectors = len(table)
+    compiled.vocab_version = vocab.version
+    state.lowered_selectors = len(table)
+    compiled.revision = new_revision
+    return old_len, len(table)
+
+
+def unpack_conjuncts(
+    conj_req: np.ndarray, conj_forbid: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pre-unpack conjunct word masks to transposed bit matrices for
+    host_selector_matches (cacheable across incremental updates)."""
+    s, cps, w = conj_req.shape
+    req = np.unpackbits(
+        conj_req.reshape(s * cps, w).view(np.uint8).reshape(s * cps, w * 4),
+        axis=1,
+        bitorder="little",
+    ).astype(np.int32)
+    forbid = np.unpackbits(
+        conj_forbid.reshape(s * cps, w).view(np.uint8).reshape(s * cps, w * 4),
+        axis=1,
+        bitorder="little",
+    ).astype(np.int32)
+    return req.T.copy(), forbid.T.copy()
+
+
+def host_selector_matches(
+    id_bits: np.ndarray,
+    conj_req: np.ndarray,
+    conj_forbid: np.ndarray,
+    conj_valid: np.ndarray,
+    req_count: np.ndarray,
+    unpacked: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+) -> np.ndarray:
+    """Numpy mirror of ops.bitmap.compute_selector_matches for small
+    selector slices (incremental appends): → [N, S_slice] bool."""
+    n, w = id_bits.shape
+    s, cps, _ = conj_req.shape
+    if s == 0:
+        return np.zeros((n, 0), bool)
+    bits = np.unpackbits(
+        id_bits.view(np.uint8).reshape(n, w * 4), axis=1, bitorder="little"
+    ).astype(np.int32)
+    req_t, forbid_t = unpacked if unpacked is not None else unpack_conjuncts(
+        conj_req, conj_forbid
+    )
+    hit_req = bits @ req_t
+    hit_forbid = bits @ forbid_t
+    ok = (
+        (hit_req == req_count.reshape(1, s * cps))
+        & (hit_forbid == 0)
+        & conj_valid.reshape(1, s * cps)
+    )
+    return ok.reshape(n, s, cps).any(axis=2)

@@ -7,11 +7,13 @@ lookup — the XDP hook (bpf/bpf_xdp.c), netdev identity resolution
 (bpf/lib/policy.h) as one jitted program over flow batches.
 """
 
+from .fastpath import VerdictFastpath
 from .pipeline import DatapathPipeline, DatapathTables, DROP_PREFILTER, DROP_POLICY, FORWARD
 
 __all__ = [
     "DatapathPipeline",
     "DatapathTables",
+    "VerdictFastpath",
     "DROP_PREFILTER",
     "DROP_POLICY",
     "FORWARD",

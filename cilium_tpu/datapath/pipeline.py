@@ -29,7 +29,12 @@ from ..ipcache.ipcache import IPCache
 from ..ipcache.prefilter import PreFilter
 from ..ops.lookup import PolicymapTables, lookup_batch
 from ..ops.lpm import lpm_lookup, ipv4_to_bytes
-from ..ops.materialize import EndpointPolicySnapshot, materialize_endpoints
+from ..ops.materialize import (
+    EndpointPolicySnapshot,
+    MaterializedState,
+    materialize_endpoints_state,
+    patch_identity_rows,
+)
 
 FORWARD = 1
 DROP_POLICY = 2
@@ -100,8 +105,11 @@ class DatapathPipeline:
         self._endpoints: List[int] = []  # identity ids of local endpoints
         self._endpoint_ids: List[int] = []  # endpoint ids (same order)
         self._tables: Optional[DatapathTables] = None
-        self._snapshots: List[EndpointPolicySnapshot] = []
-        self._built_versions: Tuple = ()
+        self._mat: Optional[MaterializedState] = None
+        self._mat_sig: Tuple = ()  # endpoint list the policymap was built for
+        self._last_delta_seq = 0  # engine delta cursor
+        self._trie_versions: Tuple = ()  # (ipcache.version, prefilter.revision)
+        self._tries: Optional[Tuple] = None  # (pf_child4, pf_info4, ip_child4, ip_info4, world_row)
         self.counters = np.zeros((0, 3), np.int64)
 
     def set_endpoints(self, endpoints: Sequence) -> None:
@@ -114,7 +122,7 @@ class DatapathPipeline:
             ]
             self._endpoint_ids = [p[0] for p in pairs]
             self._endpoints = [p[1] for p in pairs]
-            self._built_versions = ()
+            self._mat = None  # column layout changes with the endpoint set
 
     def endpoint_index(self, endpoint_id: int) -> Optional[int]:
         try:
@@ -123,50 +131,104 @@ class DatapathPipeline:
             return None
 
     # ------------------------------------------------------------------
-    def _versions(self) -> Tuple:
-        return (
-            self.engine.repo.revision,
-            self.engine.registry.version,
-            self.ipcache.version,
-            self.prefilter.revision,
-            tuple(self._endpoints),
-        )
-
     def rebuild(self, force: bool = False) -> DatapathTables:
+        """Bring device state up to date. Incremental where possible:
+
+        - identity churn ("rows" engine deltas) → policymap row patches
+          (n_seg × k verdicts instead of the full sweep)
+        - rule appends / full recompiles → warm re-materialization
+        - ipcache/prefilter moves → trie rebuild only (policymap kept)
+        """
         with self._lock:
-            if not force and self._tables is not None and self._built_versions == self._versions():
-                return self._tables
             # Capture versions BEFORE reading the sources: a concurrent
             # mutation mid-build then triggers one extra rebuild rather
             # than being silently marked materialized.
-            versions = self._versions()
+            trie_versions = (self.ipcache.version, self.prefilter.revision)
+            delta_target = self.engine.delta_seq
             compiled, device = self.engine.snapshot()
-            tables, snaps = materialize_endpoints(compiled, device, self._endpoints)
-            pf_child4, pf_info4 = self.prefilter.build_device()[0]
-            ip4, _ip6 = self.ipcache.build_device(
-                lambda ident: compiled.id_to_row.get(ident)
-            )
-            ip_child4, ip_info4 = ip4
-            world_row = compiled.id_to_row.get(ID_WORLD)
-            if world_row is None:
-                raise RuntimeError("reserved:world identity has no device row")
+            delta_target = max(delta_target, self.engine.delta_seq)
+            ep_sig = tuple(self._endpoints)
+
+            mat_fresh = False
+            saw_release = False
+            if force or self._mat is None or self._mat_sig != ep_sig:
+                self._mat = materialize_endpoints_state(
+                    compiled, device, self._endpoints
+                )
+                mat_fresh = True
+            else:
+                deltas = self.engine.deltas_since(self._last_delta_seq)
+                if deltas is None or any(k != "rows" for _, k, _ in deltas):
+                    # rule appends or full recompiles invalidate column
+                    # layout / verdict basis → re-materialize (warm jit,
+                    # shape-bucketed, so this is the fast full path)
+                    self._mat = materialize_endpoints_state(
+                        compiled, device, self._endpoints
+                    )
+                    mat_fresh = True
+                else:
+                    for _seq, _kind, events in deltas:
+                        patch_identity_rows(self._mat, compiled, device, events)
+                        saw_release |= any(not live for _r, _i, live in events)
+            self._mat_sig = ep_sig
+            self._last_delta_seq = delta_target
+
+            # Tries: rebuilt when their sources move, when the row basis
+            # was re-established, or when any row event could have
+            # changed an ipcache row mapping (identity release).
+            if (
+                force
+                or self._tries is None
+                or trie_versions != self._trie_versions
+                or mat_fresh
+                or saw_release  # released identity may be referenced by tries
+                or self._tables is None
+            ):
+                pf_child4, pf_info4 = self.prefilter.build_device()[0]
+                ip4, _ip6 = self.ipcache.build_device(
+                    lambda ident: compiled.id_to_row.get(ident)
+                )
+                ip_child4, ip_info4 = ip4
+                world_row = compiled.id_to_row.get(ID_WORLD)
+                if world_row is None:
+                    raise RuntimeError("reserved:world identity has no device row")
+                self._tries = (
+                    jnp.asarray(pf_child4),
+                    jnp.asarray(pf_info4),
+                    jnp.asarray(ip_child4),
+                    jnp.asarray(ip_info4),
+                    jnp.asarray(np.int32(world_row)),
+                )
+                self._trie_versions = trie_versions
+
+            assert self._tries is not None and self._mat is not None
             self._tables = DatapathTables(
-                pf_child4=jnp.asarray(pf_child4),
-                pf_info4=jnp.asarray(pf_info4),
-                ip_child4=jnp.asarray(ip_child4),
-                ip_info4=jnp.asarray(ip_info4),
-                world_row=jnp.asarray(np.int32(world_row)),
-                policymap=tables,
+                pf_child4=self._tries[0],
+                pf_info4=self._tries[1],
+                ip_child4=self._tries[2],
+                ip_info4=self._tries[3],
+                world_row=self._tries[4],
+                policymap=self._mat.tables,
             )
-            self._snapshots = snaps
-            self._built_versions = versions
             if self.counters.shape[0] != len(self._endpoints):
                 self.counters = np.zeros((len(self._endpoints), 3), np.int64)
             return self._tables
 
     def snapshots(self) -> List[EndpointPolicySnapshot]:
         self.rebuild()
-        return self._snapshots
+        assert self._mat is not None
+        return self._mat.snapshots
+
+    def fastpath(self):
+        """Per-flow verdict cache over the current realized policymaps
+        (datapath/fastpath.py). Row patches from identity churn are
+        visible through the shared snapshot dicts; re-fetch after rule
+        changes (re-materialization swaps the snapshot objects)."""
+        from .fastpath import VerdictFastpath
+
+        self.rebuild()
+        assert self._mat is not None
+        return VerdictFastpath(self._mat.snapshots)
 
     # ------------------------------------------------------------------
     def process(

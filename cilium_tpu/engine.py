@@ -9,18 +9,33 @@ answers batched verdict queries.
 The refresh is the "datapath compile" of this framework — instead of
 clang→llc per endpoint (pkg/datapath/loader/compile.go), it re-packs
 numpy tables and lets jit shape-bucketing reuse compiled XLA programs.
+
+Refresh is **incremental** where the reference's is per-endpoint
+(pkg/endpoint/policy.go:506-552 revision gate): identity churn becomes
+device row updates (id_bits + sel_match rows), and rule imports that
+fit the existing shape buckets append matrix cells in place
+(compiler.DirectionPacker) with only the new selector columns
+recomputed. Full recompiles happen only on bucket overflow, rule
+deletion, or vocab word growth.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import u8proto
-from .compiler import CompiledPolicy, compile_policy
+from .compiler import (
+    CompiledPolicy,
+    compile_policy_state,
+    host_selector_matches,
+    try_append_rules,
+)
+from .compiler.program import unpack_conjuncts
 from .identity import IdentityRegistry
 from .identity.model import MAX_USER_IDENTITY
 from .ops.bitmap import compute_selector_matches
@@ -31,19 +46,79 @@ PROTO_TCP = u8proto.TCP
 PROTO_UDP = u8proto.UDP
 
 
+@jax.jit
+def _set_rows(buf: jnp.ndarray, idx: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    # No donation: concurrent readers may still hold the old buffer.
+    return buf.at[idx].set(rows)
+
+
+@jax.jit
+def _set_rows2(
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    idx: jnp.ndarray,
+    rows_a: jnp.ndarray,
+    rows_b: jnp.ndarray,
+):
+    """Row-update two buffers in ONE dispatch (device round trips
+    dominate small updates, especially over the axon tunnel)."""
+    return a.at[idx].set(rows_a), b.at[idx].set(rows_b)
+
+
+def _pack_match_words(m: np.ndarray) -> np.ndarray:
+    """[k, S] bool → [k, S/32] uint32 in sel_match bit order (S is a
+    multiple of 128, so the byte view folds cleanly into words)."""
+    packed = np.packbits(m, axis=1, bitorder="little")  # [k, S/8] uint8
+    return packed.view(np.uint32).reshape(m.shape[0], m.shape[1] // 32)
+
+
 class PolicyEngine:
+    # Delta-log ring consumed by DatapathPipeline for incremental
+    # policymap materialization.
+    DELTA_LOG_CAP = 512
+
     def __init__(self, repo: Repository, registry: IdentityRegistry) -> None:
         self.repo = repo
         self.registry = registry
         self._lock = threading.Lock()
         self._compiled: Optional[CompiledPolicy] = None
+        self._state = None  # compiler.CompileState
         self._device: Optional[DevicePolicy] = None
+        self._sel_match_host: Optional[np.ndarray] = None
         # Dense row table for the compact ranges (reserved + user,
         # < 65536) and a dict for sparse local/CIDR identities
         # (≥ LOCAL_IDENTITY_BASE = 1<<24) — a dense table over the full
         # numeric space would be ~64MB per refresh.
         self._low_rows: Optional[np.ndarray] = None
         self._high_rows: dict = {}
+        self._conj_unpacked = None  # cached unpack_conjuncts result
+        # Identity change feed (registry observer) + outward delta log.
+        self._pending_idents: List[Tuple[object, bool]] = []
+        registry.observe(
+            lambda ident, added: self._pending_idents.append((ident, added))
+        )
+        self.delta_seq = 0
+        self._delta_log: List[Tuple[int, str, tuple]] = []
+
+    # ------------------------------------------------------------------
+    def _log_delta(self, kind: str, payload: tuple) -> None:
+        self.delta_seq += 1
+        self._delta_log.append((self.delta_seq, kind, payload))
+        if len(self._delta_log) > self.DELTA_LOG_CAP:
+            del self._delta_log[: len(self._delta_log) - self.DELTA_LOG_CAP]
+
+    def deltas_since(self, seq: int):
+        """Refresh deltas with seq > ``seq`` (oldest first), or None when
+        the log has been truncated past that point (consumer must do a
+        full rebuild)."""
+        with self._lock:
+            if seq >= self.delta_seq:
+                return []
+            if self._delta_log and self._delta_log[0][0] > seq + 1:
+                return None
+            if not self._delta_log and self.delta_seq > seq:
+                return None
+            return list(e for e in self._delta_log if e[0] > seq)
 
     # ------------------------------------------------------------------
     def _stale(self) -> bool:
@@ -55,37 +130,249 @@ class PolicyEngine:
         )
 
     def refresh(self, force: bool = False) -> CompiledPolicy:
-        """Recompile if repository or identity state moved (the revision
-        gate of pkg/endpoint/policy.go:506)."""
+        """Recompile (or incrementally patch) if repository or identity
+        state moved (the revision gate of pkg/endpoint/policy.go:506)."""
         with self._lock:
             if not force and not self._stale():
                 return self._compiled  # type: ignore[return-value]
-            compiled = compile_policy(self.repo, self.registry)
-            sel_match = compute_selector_matches(
-                jnp.asarray(compiled.id_bits),
-                jnp.asarray(compiled.conj_req),
-                jnp.asarray(compiled.conj_forbid),
-                jnp.asarray(compiled.conj_valid),
-                jnp.asarray(compiled.req_count),
-            )
-            self._device = DevicePolicy(
-                id_bits=jnp.asarray(compiled.id_bits),
-                sel_match=sel_match,
-                ingress=DeviceTables.from_host(compiled.ingress),
-                egress=DeviceTables.from_host(compiled.egress),
-            )
-            low = np.full(MAX_USER_IDENTITY + 1, -1, np.int32)
-            high: dict = {}
-            for ident, row in compiled.id_to_row.items():
-                if ident < low.size:
-                    low[ident] = row
-                else:
-                    high[ident] = row
-            self._low_rows = low
-            self._high_rows = high
-            self._compiled = compiled
-            return compiled
+            if force or self._compiled is None:
+                return self._full_refresh()
 
+            c = self._compiled
+            rule_ops = []
+            if c.revision != self.repo.revision:
+                rule_ops = self.repo.changes_since(c.revision)
+                if rule_ops is None or any(op != "add" for _, op, _ in rule_ops):
+                    return self._full_refresh()
+
+            if not self._apply_identity_delta():
+                return self._full_refresh()
+            for _rev, _op, payload in rule_ops:
+                # "add" payload is the tuple of rules added at that rev
+                if not self._apply_rule_append(list(payload)):
+                    return self._full_refresh()
+            c.revision = self.repo.revision
+            return c
+
+    def _full_refresh(self) -> CompiledPolicy:
+        compiled, state = compile_policy_state(self.repo, self.registry)
+        sel_match = compute_selector_matches(
+            jnp.asarray(compiled.id_bits),
+            jnp.asarray(compiled.conj_req),
+            jnp.asarray(compiled.conj_forbid),
+            jnp.asarray(compiled.conj_valid),
+            jnp.asarray(compiled.req_count),
+        )
+        self._device = DevicePolicy(
+            id_bits=jnp.asarray(compiled.id_bits),
+            sel_match=sel_match,
+            ingress=DeviceTables.from_host(compiled.ingress),
+            egress=DeviceTables.from_host(compiled.egress),
+        )
+        # np.array (copy): asarray on a device buffer is read-only and
+        # the incremental paths mutate this in place.
+        self._sel_match_host = np.array(sel_match)
+        low = np.full(MAX_USER_IDENTITY + 1, -1, np.int32)
+        high: dict = {}
+        for ident, row in compiled.id_to_row.items():
+            if ident < low.size:
+                low[ident] = row
+            else:
+                high[ident] = row
+        self._low_rows = low
+        self._high_rows = high
+        self._compiled = compiled
+        self._state = state
+        self._conj_unpacked = None
+        self._pending_idents.clear()
+        self._log_delta("full", ())
+        return compiled
+
+    # -- incremental paths ---------------------------------------------
+    def _apply_identity_delta(self) -> bool:
+        """Apply pending identity adds/releases as device row updates.
+        False → caller must full-rebuild."""
+        c = self._compiled
+        assert c is not None
+        target_version = self.registry.version
+        if c.identity_version == target_version:
+            return True
+        pend = list(self._pending_idents)
+        # The observer feed must cover exactly the version gap; if the
+        # engine attached late or events were lost, rebuild.
+        if len(pend) != target_version - c.identity_version:
+            return False
+        if self.registry.padded_rows() != c.id_bits.shape[0]:
+            return False  # row-capacity bucket crossed
+
+        vocab = self.registry.vocab
+        touched: List[int] = []
+        plans: List[Tuple[int, bool, object]] = []
+        for ident, added in pend:
+            row = self.registry.row(ident.id)
+            if row is None:
+                return False
+            if added:
+                bits = vocab.identity_bits(ident.labels)  # may grow vocab
+                plans.append((row, True, (ident, bits)))
+            else:
+                plans.append((row, False, ident))
+        if vocab.num_words > c.num_words:
+            return False  # new label words → conjunct arrays reshape
+
+        events: List[Tuple[int, int, bool]] = []
+        for row, added, info in plans:
+            if added:
+                ident, bits = info
+                c.id_bits[row] = vocab.pack(bits, c.num_words)
+                c.row_ids[row] = ident.id
+                c.row_live[row] = True
+                c.id_to_row[ident.id] = row
+                self._set_row_index(ident.id, row)
+                events.append((row, ident.id, True))
+            else:
+                ident = info
+                c.id_bits[row] = 0
+                c.row_live[row] = False
+                c.id_to_row.pop(ident.id, None)
+                self._set_row_index(ident.id, -1)
+                events.append((row, ident.id, False))
+            touched.append(row)
+
+        rows = sorted(set(touched))
+        idx = np.asarray(rows, np.int32)
+        # Recompute sel_match rows host-side (small [k, S] matmul);
+        # unpacked conjunct operands are cached across identity churn.
+        sub_bits = c.id_bits[idx]
+        if self._conj_unpacked is None:
+            self._conj_unpacked = unpack_conjuncts(c.conj_req, c.conj_forbid)
+        m = host_selector_matches(
+            sub_bits,
+            c.conj_req,
+            c.conj_forbid,
+            c.conj_valid,
+            c.req_count,
+            unpacked=self._conj_unpacked,
+        )  # [k, S]
+        words = _pack_match_words(m)
+        assert self._sel_match_host is not None
+        self._sel_match_host[idx] = words
+
+        device = self._device
+        assert device is not None
+        new_bits, new_match = _set_rows2(
+            device.id_bits,
+            device.sel_match,
+            jnp.asarray(idx),
+            jnp.asarray(sub_bits),
+            jnp.asarray(words),
+        )
+        self._device = DevicePolicy(
+            id_bits=new_bits,
+            sel_match=new_match,
+            ingress=device.ingress,
+            egress=device.egress,
+        )
+        # Only the processed prefix is consumed — events racing in during
+        # this delta stay queued and are covered by the next refresh.
+        c.identity_version = target_version
+        del self._pending_idents[: len(pend)]
+        # payload: (row, identity_id, live) events in apply order
+        self._log_delta("rows", tuple(events))
+        return True
+
+    @staticmethod
+    def _patch_tables(tables: DeviceTables, writes) -> DeviceTables:
+        """Apply a DirectionPacker write log as per-matrix scatters —
+        only the touched cells travel to the device, not the matrices.
+        Transposed fields (deny_t/allow_t/en_t/ee_t) swap indices."""
+        if not writes:
+            return tables
+        by_name: dict = {}
+        for name, i, j, v in writes:
+            by_name.setdefault(name, []).append((i, j, v))
+        transposed = {"deny": "deny_t", "allow": "allow_t", "en": "en_t", "ee": "ee_t"}
+        direct = {
+            "s1": "s1_mat", "p1": "p1_mat", "gpn": "gpn_mat", "gpe": "gpe_mat",
+            "s7": "s7_mat", "p7": "p7_mat", "g7": "g7_mat",
+        }
+        reps: dict = {}
+        for name, items in by_name.items():
+            ii = np.asarray([x[0] for x in items])
+            jj = np.asarray([x[1] for x in items])
+            if name in transposed:
+                field = transposed[name]
+                mat = getattr(tables, field)
+                reps[field] = mat.at[jj, ii].set(jnp.int8(1))
+            elif name in direct:
+                field = direct[name]
+                mat = getattr(tables, field)
+                reps[field] = mat.at[ii, jj].set(jnp.int8(1))
+            elif name == "group_no_peers":
+                reps["group_no_peers"] = tables.group_no_peers.at[ii].set(True)
+            elif name == "port_vocab":
+                # (pid, port, proto): jj = port, third = proto
+                vv = np.asarray([x[2] for x in items])
+                reps["ports"] = tables.ports.at[ii].set(jnp.asarray(jj, jnp.int32))
+                reps["protos"] = tables.protos.at[ii].set(jnp.asarray(vv, jnp.int32))
+            else:  # pragma: no cover - unknown write kind
+                raise KeyError(name)
+        return tables.replace(**reps)
+
+    def _apply_rule_append(self, rules) -> bool:
+        """Append a rule batch in place. False → full rebuild needed."""
+        c = self._compiled
+        assert c is not None and self._state is not None
+        res = try_append_rules(c, self._state, self.registry, rules, c.revision)
+        if res is None:
+            return False
+        self._conj_unpacked = None  # conjunct rows changed
+        old_s, new_s = res
+        if new_s > old_s:
+            # New selector columns: match against ALL identities, then
+            # OR the bits into the packed words (columns were zero).
+            m = host_selector_matches(
+                c.id_bits,
+                c.conj_req[old_s:new_s],
+                c.conj_forbid[old_s:new_s],
+                c.conj_valid[old_s:new_s],
+                c.req_count[old_s:new_s],
+            )  # [N, k]
+            sm = self._sel_match_host
+            assert sm is not None
+            for j, sid in enumerate(range(old_s, new_s)):
+                col = m[:, j]
+                if col.any():
+                    sm[:, sid >> 5] |= col.astype(np.uint32) << np.uint32(sid & 31)
+        device = self._device
+        assert device is not None
+        self._device = DevicePolicy(
+            id_bits=device.id_bits,
+            sel_match=(
+                jnp.asarray(self._sel_match_host)
+                if new_s > old_s
+                else device.sel_match
+            ),
+            ingress=self._patch_tables(
+                device.ingress, self._state.ingress.take_writes()
+            ),
+            egress=self._patch_tables(
+                device.egress, self._state.egress.take_writes()
+            ),
+        )
+        self._log_delta("rules", (tuple(rules),))
+        return True
+
+    def _set_row_index(self, ident_id: int, row: int) -> None:
+        assert self._low_rows is not None
+        if ident_id < self._low_rows.size:
+            self._low_rows[ident_id] = row
+        elif row < 0:
+            self._high_rows.pop(ident_id, None)
+        else:
+            self._high_rows[ident_id] = row
+
+    # ------------------------------------------------------------------
     @property
     def device_policy(self) -> DevicePolicy:
         self.refresh()
@@ -139,7 +426,8 @@ class PolicyEngine:
         self.refresh()
         with self._lock:
             device = self._device
-            low, high = self._low_rows, self._high_rows
+            low = self._low_rows.copy() if self._low_rows is not None else None
+            high = dict(self._high_rows)
         assert device is not None and low is not None
         n = len(subj_ids)
         hl4 = np.ones(n, dtype=bool) if has_l4 is None else np.asarray(has_l4, bool)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -73,6 +74,24 @@ def _endpoint_slots(compiled: CompiledPolicy, subj_sel_row: np.ndarray, ingress:
     return sorted(slots)
 
 
+@dataclasses.dataclass
+class MaterializedState:
+    """Host mirror of the realized policymap: unpacked column bitmaps +
+    metadata, enabling **row patches** for identity churn (the
+    incremental half of syncPolicyMap, pkg/endpoint/endpoint.go:2572)
+    without re-sweeping every (endpoint, identity) pair."""
+
+    tables: PolicymapTables
+    snapshots: List[EndpointPolicySnapshot]
+    ingress: bool
+    endpoint_identity_ids: List[int]
+    ep_rows: np.ndarray  # [E] int32
+    ep_slots: List[List[Tuple[int, int]]]
+    allow_nc: np.ndarray  # [N, C_pad] bool (host, mutable)
+    red_nc: np.ndarray  # [N, C_pad] bool
+    n_cols: int
+
+
 def materialize_endpoints(
     compiled: CompiledPolicy,
     device: DevicePolicy,
@@ -81,6 +100,20 @@ def materialize_endpoints(
     ingress: bool = True,
     block: int = 8192,
 ) -> Tuple[PolicymapTables, List[EndpointPolicySnapshot]]:
+    st = materialize_endpoints_state(
+        compiled, device, endpoint_identity_ids, ingress=ingress, block=block
+    )
+    return st.tables, st.snapshots
+
+
+def materialize_endpoints_state(
+    compiled: CompiledPolicy,
+    device: DevicePolicy,
+    endpoint_identity_ids: Sequence[int],
+    *,
+    ingress: bool = True,
+    block: int = 8192,
+) -> MaterializedState:
     n = compiled.id_bits.shape[0]
     ep_rows = compiled.rows_for(endpoint_identity_ids)
     sel_match_host = np.asarray(device.sel_match)
@@ -179,4 +212,156 @@ def materialize_endpoints(
         id_allow=pack_bool_bits(jnp.asarray(allow_nc)),
         id_redirect=pack_bool_bits(jnp.asarray(red_nc)),
     )
-    return tables, snapshots
+    return MaterializedState(
+        tables=tables,
+        snapshots=snapshots,
+        ingress=ingress,
+        endpoint_identity_ids=list(endpoint_identity_ids),
+        ep_rows=ep_rows,
+        ep_slots=ep_slots,
+        allow_nc=allow_nc,
+        red_nc=red_nc,
+        n_cols=c,
+    )
+
+
+@jax.jit
+def _patch_bitmap_rows(
+    id_allow: jnp.ndarray,
+    id_redirect: jnp.ndarray,
+    idx: jnp.ndarray,
+    allow_rows: jnp.ndarray,
+    red_rows: jnp.ndarray,
+):
+    return id_allow.at[idx].set(allow_rows), id_redirect.at[idx].set(red_rows)
+
+
+def patch_identity_rows(
+    state: MaterializedState,
+    compiled: CompiledPolicy,
+    device: DevicePolicy,
+    row_events: Sequence[Tuple[int, int, bool]],
+    *,
+    block: int = 8192,
+) -> None:
+    """Apply identity-churn row updates to a materialized policymap.
+
+    ``row_events``: (row, identity_id, live) in order. Dead rows zero
+    out; live rows get a fresh verdict sweep over every column segment
+    of every endpoint — n_seg × k flows instead of the full n_seg × N
+    re-materialization. Snapshots (host policymap dicts) are patched in
+    place, so fastpath caches holding references see the update."""
+    if not row_events:
+        return
+    direction = TRAFFIC_INGRESS if state.ingress else TRAFFIC_EGRESS
+    # last event per row wins for the verdict sweep; all ids seen on a
+    # row get their stale snapshot entries dropped
+    stale_ids = {int(ident) for _r, ident, _l in row_events}
+    final: Dict[int, Tuple[int, bool]] = {}
+    for row, ident, live in row_events:
+        final[int(row)] = (int(ident), bool(live))
+
+    for snap in state.snapshots:
+        for key in [k for k in snap.entries if k.identity in stale_ids]:
+            del snap.entries[key]
+
+    rows = sorted(final)
+    live_rows = [r for r in rows if final[r][1]]
+    if live_rows:
+        seg_subj: List[int] = []
+        seg_port: List[int] = []
+        seg_proto: List[int] = []
+        seg_l4: List[bool] = []
+        seg_col: List[int] = []
+        seg_ep: List[int] = []
+        col = 0
+        for e, ep_row in enumerate(state.ep_rows):
+            seg_subj.append(int(ep_row))
+            seg_port.append(0)
+            seg_proto.append(0)
+            seg_l4.append(False)
+            seg_col.append(col)
+            seg_ep.append(e)
+            col += 1
+            for port, proto in state.ep_slots[e]:
+                seg_subj.append(int(ep_row))
+                seg_port.append(port)
+                seg_proto.append(proto)
+                seg_l4.append(True)
+                seg_col.append(col)
+                seg_ep.append(e)
+                col += 1
+        n_seg = len(seg_subj)
+        k = len(live_rows)
+        peer = np.tile(np.asarray(live_rows, np.int32), n_seg)
+        v = verdict_batch(
+            device,
+            jnp.asarray(np.repeat(np.asarray(seg_subj, np.int32), k)),
+            jnp.asarray(peer),
+            jnp.asarray(np.repeat(np.asarray(seg_port, np.int32), k)),
+            jnp.asarray(np.repeat(np.asarray(seg_proto, np.int32), k)),
+            jnp.asarray(np.repeat(np.asarray(seg_l4, bool), k)),
+            ingress=state.ingress,
+            block=block,
+        )
+        dec = np.asarray(v.decision).reshape(n_seg, k)
+        l3d = np.asarray(v.l3).reshape(n_seg, k)
+        red = np.asarray(v.l7_redirect).reshape(n_seg, k)
+
+    for r in rows:
+        state.allow_nc[r] = False
+        state.red_nc[r] = False
+
+    if live_rows:
+        row_pos = {r: i for i, r in enumerate(live_rows)}
+        # per-endpoint L3 allow for the exact-entry condition
+        ep_l3 = {}
+        seg_i = 0
+        for e in range(len(state.ep_rows)):
+            ep_l3[e] = l3d[seg_i] == 1
+            seg_i += 1 + len(state.ep_slots[e])
+        seg_i = 0
+        for e in range(len(state.ep_rows)):
+            snap = state.snapshots[e]
+            l3_allow = ep_l3[e]
+            # L3 column
+            ci = seg_col[seg_i]
+            for r in live_rows:
+                i = row_pos[r]
+                allowed = l3_allow[i]
+                state.allow_nc[r, ci] = allowed
+                if allowed:
+                    ident = final[r][0]
+                    snap.entries[PolicyKey(ident, 0, 0, direction)] = 0
+            seg_i += 1
+            for port, proto in state.ep_slots[e]:
+                ci = seg_col[seg_i]
+                for r in live_rows:
+                    i = row_pos[r]
+                    allowed = dec[seg_i, i] == ALLOW
+                    redir = bool(red[seg_i, i])
+                    state.allow_nc[r, ci] = allowed
+                    state.red_nc[r, ci] = allowed and redir
+                    if allowed and (not l3_allow[i] or redir):
+                        ident = final[r][0]
+                        snap.entries[PolicyKey(ident, port, proto, direction)] = int(redir)
+                seg_i += 1
+
+    idx = np.asarray(rows, np.int32)
+    allow_rows = _pack_rows(state.allow_nc[idx])
+    red_rows = _pack_rows(state.red_nc[idx])
+    new_allow, new_red = _patch_bitmap_rows(
+        state.tables.id_allow,
+        state.tables.id_redirect,
+        jnp.asarray(idx),
+        jnp.asarray(allow_rows),
+        jnp.asarray(red_rows),
+    )
+    state.tables = state.tables.replace(id_allow=new_allow, id_redirect=new_red)
+
+
+def _pack_rows(rows_bool: np.ndarray) -> np.ndarray:
+    """[k, C_pad] bool → [k, C_pad/32] uint32 (C_pad is a multiple of
+    32 by construction)."""
+    packed = np.packbits(rows_bool, axis=1, bitorder="little")
+    return packed.view(np.uint32).reshape(rows_bool.shape[0], rows_bool.shape[1] // 32)

@@ -89,10 +89,17 @@ def _is_label_based_egress(r: EgressRule) -> bool:
 class Repository:
     """Ordered rule list with a monotonic revision counter."""
 
+    # Change-log ring: compilers consult changes_since(rev) to apply a
+    # pure-append delta instead of a full recompile (the incremental
+    # half of the reference's per-revision regeneration protocol,
+    # pkg/endpoint/policy.go:506-552).
+    LOG_CAP = 256
+
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self.rules: List[Rule] = []
         self._revision = 1
+        self._log: List[Tuple[int, str, tuple]] = []
 
     # ------------------------------------------------------------------
     @property
@@ -103,13 +110,35 @@ class Repository:
         self._revision += 1
         return self._revision
 
+    def _log_op(self, op: str, payload: tuple) -> None:
+        self._log.append((self._revision, op, payload))
+        if len(self._log) > self.LOG_CAP:
+            del self._log[: len(self._log) - self.LOG_CAP]
+
+    def changes_since(self, revision: int):
+        """Ops with revision > ``revision``, oldest first — or None when
+        the log no longer reaches back that far (caller must do a full
+        rebuild)."""
+        with self._lock:
+            if revision >= self._revision:
+                return []
+            # Every revision in the gap must be accounted for by a log
+            # entry — out-of-band bumps or a truncated ring mean the
+            # caller can't know what changed.
+            covered = {rev for rev, _, _ in self._log}
+            if not all(r in covered for r in range(revision + 1, self._revision + 1)):
+                return None
+            return [e for e in self._log if e[0] > revision]
+
     def add_list(self, rules: Sequence[Rule]) -> int:
         """Sanitize + append (repository.go AddListLocked:521)."""
         for r in rules:
             r.sanitize()
         with self._lock:
             self.rules.extend(rules)
-            return self._bump()
+            rev = self._bump()
+            self._log_op("add", tuple(rules))
+            return rev
 
     def delete_by_labels(self, labels: LabelArray) -> Tuple[int, int]:
         """Remove rules carrying every given label; returns (revision,
@@ -124,6 +153,7 @@ class Repository:
             self.rules = kept
             if deleted:
                 self._bump()
+                self._log_op("delete", (labels,))
             return self._revision, deleted
 
     def get_rules_matching(self, labels: LabelArray) -> Tuple[List[Rule], bool]:
