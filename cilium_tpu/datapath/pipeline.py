@@ -150,7 +150,7 @@ class DatapathPipeline:
             ep_sig = tuple(self._endpoints)
 
             mat_fresh = False
-            saw_release = False
+            saw_row_event = False
             if force or self._mat is None or self._mat_sig != ep_sig:
                 self._mat = materialize_endpoints_state(
                     compiled, device, self._endpoints
@@ -169,7 +169,12 @@ class DatapathPipeline:
                 else:
                     for _seq, _kind, events in deltas:
                         patch_identity_rows(self._mat, compiled, device, events)
-                        saw_release |= any(not live for _r, _i, live in events)
+                        # Any row event (add OR release) can change what an
+                        # ipcache entry resolves to — e.g. a released id
+                        # being re-allocated onto a tombstoned row, or an
+                        # add resolving a previously-unmapped entry — so
+                        # the tries must follow every row move.
+                        saw_row_event |= bool(events)
             self._mat_sig = ep_sig
             self._last_delta_seq = delta_target
 
@@ -181,7 +186,7 @@ class DatapathPipeline:
                 or self._tries is None
                 or trie_versions != self._trie_versions
                 or mat_fresh
-                or saw_release  # released identity may be referenced by tries
+                or saw_row_event  # any row move can re-point trie targets
                 or self._tables is None
             ):
                 pf_child4, pf_info4 = self.prefilter.build_device()[0]

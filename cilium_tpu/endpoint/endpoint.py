@@ -156,7 +156,13 @@ class Endpoint:
                         self._update_redirects(pipeline, proxy)
                     with stats.map_sync:
                         self.sync_policy_map(desired)
-                    self.policy_revision = pipeline.engine.repo.revision
+                    # Stamp the revision the engine actually compiled, not
+                    # a re-read of repo.revision: a rule batch landing
+                    # after the rebuild must not be reported as realized.
+                    compiled = pipeline.engine._compiled
+                    self.policy_revision = (
+                        compiled.revision if compiled is not None else 0
+                    )
                 ok = True
             finally:
                 stats.success = ok

@@ -147,11 +147,15 @@ class PolicyEngine:
 
             if not self._apply_identity_delta():
                 return self._full_refresh()
-            for _rev, _op, payload in rule_ops:
+            # Each applied op advances c.revision to ITS revision (never a
+            # re-read of repo.revision): a concurrent AddList landing
+            # between changes_since() and here must stay stale so the
+            # next refresh picks it up (otherwise its rules — including
+            # deny rules → fail-open — would never compile).
+            for rev, _op, payload in rule_ops:
                 # "add" payload is the tuple of rules added at that rev
-                if not self._apply_rule_append(list(payload)):
+                if not self._apply_rule_append(list(payload), rev):
                     return self._full_refresh()
-            c.revision = self.repo.revision
             return c
 
     def _full_refresh(self) -> CompiledPolicy:
@@ -319,11 +323,12 @@ class PolicyEngine:
                 raise KeyError(name)
         return tables.replace(**reps)
 
-    def _apply_rule_append(self, rules) -> bool:
-        """Append a rule batch in place. False → full rebuild needed."""
+    def _apply_rule_append(self, rules, revision: int) -> bool:
+        """Append a rule batch in place, advancing the compiled revision
+        to the op's own revision. False → full rebuild needed."""
         c = self._compiled
         assert c is not None and self._state is not None
-        res = try_append_rules(c, self._state, self.registry, rules, c.revision)
+        res = try_append_rules(c, self._state, self.registry, rules, revision)
         if res is None:
             return False
         self._conj_unpacked = None  # conjunct rows changed

@@ -220,3 +220,47 @@ class TestRuleDeltas:
         assert "rows" in kinds[1:] and "rules" in kinds[1:]
         assert "full" not in kinds[1:]
         _assert_parity(engine, repo, reg, idents + added)
+
+
+class TestConcurrentRevisionRace:
+    def test_add_during_refresh_window_not_skipped(self):
+        """A rule batch landing between changes_since() and the revision
+        update must stay stale and compile on the NEXT refresh (advisor
+        r2 high finding: fail-open if a deny rule lands in the window)."""
+        repo, reg, idents = _world(7)
+        engine = PolicyEngine(repo, reg)
+        engine.refresh()
+
+        late = rule(
+            ["k8s:app=a1"],
+            ingress=[
+                IngressRule(from_endpoints=(EndpointSelector.make(["k8s:app=a2"]),))
+            ],
+        )
+        orig = repo.changes_since
+        fired = {}
+
+        def racy_changes_since(revision):
+            ops = orig(revision)
+            if not fired:
+                fired["x"] = True
+                # concurrent AddList lands after the snapshot was taken
+                repo.add_list([late])
+            return ops
+
+        repo.changes_since = racy_changes_since
+        first = rule(
+            ["k8s:app=a0"],
+            ingress=[
+                IngressRule(from_endpoints=(EndpointSelector.make(["k8s:app=a3"]),))
+            ],
+        )
+        repo.add_list([first])
+        engine.refresh()
+        repo.changes_since = orig
+        # the late batch must still be pending…
+        assert engine._compiled.revision < repo.revision
+        # …and a second refresh must pick it up, ending in full parity
+        engine.refresh()
+        assert engine._compiled.revision == repo.revision
+        _assert_parity(engine, repo, reg, idents)
