@@ -90,6 +90,45 @@ class IdentityRegistry:
             self._insert(ident)
             return ident
 
+    def insert_global(self, num: int, labels: LabelArray) -> Identity:
+        """Insert (or ref) an identity under a *pre-assigned* global
+        number — the path taken when the kvstore allocator (local CAS
+        win or a remote node's allocation seen via watch) decides the
+        number instead of this registry. Keeps the local user-range
+        cursor ahead of every global number so a later local
+        ``allocate`` can never collide."""
+        with self._lock:
+            existing = self._by_id.get(num)
+            if existing is not None:
+                if existing.labels != labels:
+                    raise ValueError(
+                        f"identity {num} already bound to different labels"
+                    )
+                self._refcount[num] += 1
+                return existing
+            # Same labels under a different number is a split-brain
+            # signal; surface it to the caller, who decides (the watch
+            # pumps skip the event, keeping the existing binding).
+            stale = self._by_labels.get(labels)
+            if stale is not None and stale.id != num:
+                raise ValueError(
+                    f"labels already bound to identity {stale.id}, got {num}"
+                )
+            ident = Identity(num, labels)
+            if MIN_USER_IDENTITY <= num <= MAX_USER_IDENTITY:
+                self._next_user = max(self._next_user, num + 1)
+            self._insert(ident)
+            return ident
+
+    def release_by_id(self, num: int) -> bool:
+        """Release one reference of identity ``num`` (remote-deletion
+        path of the kvstore watch). True when freed."""
+        with self._lock:
+            ident = self._by_id.get(num)
+            if ident is None:
+                return False
+            return self.release(ident)
+
     def release(self, ident: Identity) -> bool:
         """Unref; True when the identity was freed. Freed identities keep
         their row (tombstoned) so device tensors never reshuffle rows."""

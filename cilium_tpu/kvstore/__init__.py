@@ -1,0 +1,39 @@
+"""Distributed-state fabric: kvstore backend, CAS allocator, shared
+store, clustermesh (reference: pkg/kvstore + pkg/kvstore/allocator +
+pkg/kvstore/store + pkg/clustermesh)."""
+
+from .backend import (
+    BackendOperations,
+    EventTypeCreate,
+    EventTypeDelete,
+    EventTypeListDone,
+    EventTypeModify,
+    InMemoryBackend,
+    InMemoryStore,
+    KVEvent,
+    KVLock,
+    LockTimeout,
+    Watcher,
+)
+from .allocator import Allocator, AllocatorError
+from .clustermesh import ClusterMesh, RemoteCluster
+from .store import SharedStore
+
+__all__ = [
+    "Allocator",
+    "AllocatorError",
+    "BackendOperations",
+    "ClusterMesh",
+    "EventTypeCreate",
+    "EventTypeDelete",
+    "EventTypeListDone",
+    "EventTypeModify",
+    "InMemoryBackend",
+    "InMemoryStore",
+    "KVEvent",
+    "KVLock",
+    "LockTimeout",
+    "RemoteCluster",
+    "SharedStore",
+    "Watcher",
+]

@@ -1,0 +1,17 @@
+"""Shared kvstore path constants + the identity key codec.
+
+Kept dependency-free so kvstore modules and identity/ipcache sync
+layers can share them without import cycles. Path layout mirrors the
+reference's stable kvstore schema (pkg/kvstore/allocator, pkg/node/
+store.go NodeStorePrefix, pkg/ipcache/kvstore.go IPIdentitiesPath).
+"""
+
+IDENTITIES_PATH = "cilium/state/identities/v1"
+NODES_PATH = "cilium/state/nodes/v1"
+IP_IDENTITIES_PATH = "cilium/state/ip/v1"
+
+
+def key_to_label_strings(key: str):
+    """Allocator key (LabelArray.sorted_key: ';'-joined labels) →
+    label strings."""
+    return [t for t in key.split(";") if t]

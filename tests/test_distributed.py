@@ -1,0 +1,352 @@
+"""Distributed convergence: two agents over one kvstore.
+
+The VERDICT-r2 acceptance test for the distributed-state layer:
+two full agents (Repository + IdentityRegistry + PolicyEngine +
+IPCache), each with its own kvstore client on a shared in-memory
+store, must converge — identical identity numbering, identical ipcache
+state, identical verdicts — purely via CAS allocation + watch events.
+Reference semantics: pkg/identity/allocator.go + pkg/ipcache/kvstore.go
++ pkg/node/store.go + pkg/clustermesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cilium_tpu.engine import PolicyEngine
+from cilium_tpu.identity import IdentityRegistry
+from cilium_tpu.identity.distributed import DistributedIdentityAllocator
+from cilium_tpu.ipcache.ipcache import IPCache
+from cilium_tpu.ipcache.kvstore_sync import IPIdentitySync
+from cilium_tpu.kvstore import ClusterMesh, InMemoryBackend, InMemoryStore
+from cilium_tpu.labels import parse_label_array
+from cilium_tpu.nodes import Node, NodeRegistry
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    rule,
+)
+from cilium_tpu.policy.repository import Repository
+
+
+def _policy_rules():
+    return [
+        rule(
+            ["k8s:app=web"],
+            ingress=[
+                IngressRule(
+                    from_endpoints=(EndpointSelector.make(["k8s:app=lb"]),),
+                    to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),)),),
+                )
+            ],
+        ),
+        rule(
+            ["k8s:app=db"],
+            ingress=[
+                IngressRule(from_endpoints=(EndpointSelector.make(["k8s:app=web"]),))
+            ],
+        ),
+    ]
+
+
+class Agent:
+    """A minimal per-node agent: engine + distributed identity alloc +
+    ipcache sync, all on one kvstore client."""
+
+    def __init__(self, store: InMemoryStore, name: str):
+        self.name = name
+        self.backend = InMemoryBackend(store, name)
+        self.repo = Repository()
+        self.repo.add_list(_policy_rules())
+        self.registry = IdentityRegistry()
+        self.ident = DistributedIdentityAllocator(self.backend, self.registry, name)
+        self.ipcache = IPCache()
+        self.ipsync = IPIdentitySync(self.backend, self.ipcache)
+        self.engine = PolicyEngine(self.repo, self.registry)
+
+    def pump(self):
+        self.ident.pump()
+        self.ipsync.pump()
+
+
+LBLS = {
+    "web": ["k8s:app=web"],
+    "db": ["k8s:app=db"],
+    "lb": ["k8s:app=lb"],
+    "other": ["k8s:app=other"],
+}
+
+
+class TestTwoAgentConvergence:
+    @pytest.fixture()
+    def agents(self):
+        store = InMemoryStore()
+        return store, Agent(store, "node-a"), Agent(store, "node-b")
+
+    def test_identity_numbering_converges(self, agents):
+        _store, a, b = agents
+        # interleaved allocation of overlapping label sets on both nodes
+        ia_web = a.ident.allocate(parse_label_array(LBLS["web"]))
+        ib_db = b.ident.allocate(parse_label_array(LBLS["db"]))
+        ib_web = b.ident.allocate(parse_label_array(LBLS["web"]))
+        ia_db = a.ident.allocate(parse_label_array(LBLS["db"]))
+        ia_lb = a.ident.allocate(parse_label_array(LBLS["lb"]))
+        a.pump(), b.pump()
+        assert ia_web.id == ib_web.id
+        assert ia_db.id == ib_db.id
+        # node-b never allocated lb, but sees it via watch
+        b.pump()
+        assert b.registry.get(ia_lb.id) is not None
+        assert b.registry.get(ia_lb.id).labels == ia_lb.labels
+        # numbering is dense from MIN_USER_IDENTITY
+        assert sorted([ia_web.id, ia_db.id, ia_lb.id]) == [256, 257, 258]
+
+    def test_verdicts_identical_across_agents(self, agents):
+        _store, a, b = agents
+        idents = {}
+        for k in ("web", "db", "lb", "other"):
+            idents[k] = a.ident.allocate(parse_label_array(LBLS[k])).id
+        b.pump()
+        assert {i.id for i in a.registry} == {i.id for i in b.registry}
+
+        cases = [
+            (idents["web"], idents["lb"], 80, True),   # allowed by rule 1
+            (idents["web"], idents["lb"], 443, True),
+            (idents["web"], idents["other"], 80, True),
+            (idents["db"], idents["web"], 0, False),   # L3 allow, rule 2
+            (idents["db"], idents["lb"], 0, False),
+        ]
+        for subj, peer, port, l4 in cases:
+            va = a.engine.verdict_one(subj, peer, port, ingress=True, l4=l4)
+            vb = b.engine.verdict_one(subj, peer, port, ingress=True, l4=l4)
+            assert va == vb, (subj, peer, port, va, vb)
+        # sanity: the policy actually differentiates
+        assert a.engine.verdict_one(idents["web"], idents["lb"], 80)[0] == 1
+        assert a.engine.verdict_one(idents["web"], idents["other"], 80)[0] == 2
+
+    def test_ipcache_converges(self, agents):
+        _store, a, b = agents
+        web = a.ident.allocate(parse_label_array(LBLS["web"]))
+        a.ipsync.announce("10.1.0.5", web.id, host_ip="192.168.0.1")
+        b.pump()
+        e = b.ipcache.lookup_by_ip("10.1.0.5")
+        assert e is not None and e.identity == web.id and e.host_ip == "192.168.0.1"
+        a.ipsync.withdraw("10.1.0.5")
+        b.pump()
+        assert b.ipcache.lookup_by_ip("10.1.0.5") is None
+
+    def test_lease_death_reallocation(self, agents):
+        store, a, b = agents
+        web = a.ident.allocate(parse_label_array(LBLS["web"]))
+        a.ipsync.announce("10.1.0.5", web.id)
+        b.pump()
+        # node-a dies: lease revoked → slave key + ip announcement gone
+        store.revoke_lease(a.backend.lease_id)
+        b.pump()
+        assert b.ipcache.lookup_by_ip("10.1.0.5") is None
+        # b's GC does NOT reap while... actually no slave keys remain:
+        reaped = b.ident.run_gc()
+        assert reaped == [web.id]
+        b.pump()
+        # b can now re-allocate the same labels — and because numbering
+        # restarts from the freed number, convergence is preserved
+        web_b = b.ident.allocate(parse_label_array(LBLS["web"]))
+        assert web_b.id == web.id
+
+    def test_lease_death_with_resync_protects(self, agents):
+        store, a, b = agents
+        web = a.ident.allocate(parse_label_array(LBLS["web"]))
+        store.revoke_lease(a.backend.lease_id)
+        # node-a restarts with a fresh client and resyncs its held keys
+        a.backend = InMemoryBackend(store, "node-a")
+        a.ident.alloc.backend = a.backend
+        assert a.ident.resync() >= 1
+        assert b.ident.run_gc() == []
+        b.pump()
+        assert b.registry.get(web.id) is not None
+
+
+class TestNodeRegistry:
+    def test_membership_and_death(self):
+        store = InMemoryStore()
+        b1 = InMemoryBackend(store, "n1")
+        b2 = InMemoryBackend(store, "n2")
+        events = []
+        r1 = NodeRegistry(b1, Node(name="n1", ipv4="10.0.0.1",
+                                   ipv4_alloc_cidr="10.1.0.0/24"))
+        r2 = NodeRegistry(b2, Node(name="n2", ipv4="10.0.0.2",
+                                   ipv4_alloc_cidr="10.2.0.0/24"))
+        r2.observe(lambda n, present: events.append((n.name, present)))
+        r1.pump(), r2.pump()
+        assert ("n1", True) in events
+        assert {n.name for n in r2.remote_nodes()} == {"n1"}
+        assert r2.get("default", "n1").ipv4_alloc_cidr == "10.1.0.0/24"
+        # n1 dies → n2 sees the delete
+        store.revoke_lease(b1.lease_id)
+        r2.pump()
+        assert ("n1", False) in events
+        assert r2.remote_nodes() == []
+
+
+class TestClusterMesh:
+    def test_remote_cluster_merge_and_remove(self):
+        # local cluster
+        local_store = InMemoryStore()
+        a = Agent(local_store, "node-a")
+        web = a.ident.allocate(parse_label_array(LBLS["web"]))
+
+        # remote cluster with its own kvstore and an agent announcing.
+        # It allocates "web" first, so the shared label set lands on the
+        # SAME number as locally (both clusters number from 256 in
+        # allocation order) and "lb" takes a fresh number.
+        remote_store = InMemoryStore()
+        remote = Agent(remote_store, "r-node-1")
+        remote.ident.allocate(parse_label_array(LBLS["web"]))
+        # remote cluster's ipcache announcements live under its own name
+        remote_sync = IPIdentitySync(remote.backend, remote.ipcache, cluster="east")
+        r_lb = remote.ident.allocate(parse_label_array(LBLS["lb"]))
+        remote_sync.announce("172.16.0.9", r_lb.id)
+        NodeRegistry(remote.backend, Node(name="r1", cluster="east", ipv4="10.9.9.9"))
+
+        nodes_seen = []
+        mesh = ClusterMesh(
+            a.registry, a.ipcache,
+            on_node=lambda c, n, p: nodes_seen.append((c, n.name, p)),
+        )
+        mesh.add_cluster("east", InMemoryBackend(remote_store, "node-a-mesh"))
+        mesh.pump()
+
+        # remote identity mirrored into the local registry
+        assert a.registry.get(r_lb.id) is not None
+        assert a.registry.get(r_lb.id).labels == r_lb.labels
+        # remote ip mapping merged into the local ipcache
+        e = a.ipcache.lookup_by_ip("172.16.0.9")
+        assert e is not None and e.identity == r_lb.id
+        assert ("east", "r1", True) in nodes_seen
+
+        # the verdict engine can now answer about remote peers: web
+        # ingress from remote lb on 80 is allowed by the local policy
+        assert a.engine.verdict_one(web.id, r_lb.id, 80)[0] == 1
+
+        # removing the cluster withdraws everything it contributed
+        mesh.remove_cluster("east")
+        assert a.ipcache.lookup_by_ip("172.16.0.9") is None
+        assert a.registry.get(r_lb.id) is None
+
+    def test_colliding_remote_identity_skipped_local_wins(self):
+        """Two clusters that allocated DIFFERENT labels under the same
+        number: the local binding wins and the remote one is skipped
+        (the reference logs-and-skips invalid remote cache entries,
+        allocator cache.go invalidKey)."""
+        local_store = InMemoryStore()
+        a = Agent(local_store, "node-a")
+        web = a.ident.allocate(parse_label_array(LBLS["web"]))  # 256 local
+
+        remote_store = InMemoryStore()
+        remote = Agent(remote_store, "r-node-1")
+        r_lb = remote.ident.allocate(parse_label_array(LBLS["lb"]))  # 256 remote
+        assert r_lb.id == web.id  # the collision under test
+
+        mesh = ClusterMesh(a.registry, a.ipcache)
+        mesh.add_cluster("east", InMemoryBackend(remote_store, "node-a-mesh"))
+        mesh.pump()
+        assert a.registry.get(web.id).labels == web.labels  # local binding intact
+        mesh.remove_cluster("east")
+        assert a.registry.get(web.id) is not None  # remove didn't release it
+
+    def test_live_remote_updates_flow_through_pump(self):
+        local_store = InMemoryStore()
+        a = Agent(local_store, "node-a")
+        remote_store = InMemoryStore()
+        remote = Agent(remote_store, "r-node-1")
+        mesh = ClusterMesh(a.registry, a.ipcache)
+        mesh.add_cluster("west", InMemoryBackend(remote_store, "node-a-mesh"))
+        mesh.pump()
+        # allocation happens AFTER the mesh connected
+        r_db = remote.ident.allocate(parse_label_array(LBLS["db"]))
+        mesh.pump()
+        assert a.registry.get(r_db.id) is not None
+
+
+class TestReviewRegressions:
+    """Regressions for the r3 review findings on the distributed layer."""
+
+    def test_local_release_after_remote_mirror_keeps_identity(self):
+        """Local allocate over an already-mirrored remote identity takes
+        its own ref: releasing locally must NOT drop the remote hold."""
+        store = InMemoryStore()
+        a, b = Agent(store, "node-a"), Agent(store, "node-b")
+        web = a.ident.allocate(parse_label_array(LBLS["web"]))
+        b.pump()  # b mirrors web as remote
+        assert b.registry.get(web.id) is not None
+        web_b = b.ident.allocate(parse_label_array(LBLS["web"]))  # local use on b
+        assert b.ident.release(web_b) is False
+        # still resolvable on b: the remote (node-a) allocation lives
+        assert b.registry.get(web.id) is not None
+
+    def test_local_release_remirrors_while_cluster_holds(self):
+        """Releasing the last LOCAL ref while another node still uses
+        the identity keeps a registry row until the master key dies."""
+        store = InMemoryStore()
+        a, b = Agent(store, "node-a"), Agent(store, "node-b")
+        web_a = a.ident.allocate(parse_label_array(LBLS["web"]))
+        web_b = b.ident.allocate(parse_label_array(LBLS["web"]))
+        assert web_a.id == web_b.id
+        a.ident.release(web_a)
+        # a still resolves the identity (b's slave key keeps it alive)
+        assert a.registry.get(web_a.id) is not None
+        # b releases too; GC reaps; delete event frees a's mirror
+        b.ident.release(web_b)
+        b.ident.run_gc()
+        a.pump()
+        assert a.registry.get(web_a.id) is None
+
+    def test_conflicting_watch_event_does_not_crash_pump(self):
+        """A labels-conflict arriving via watch is skipped, not raised."""
+        store = InMemoryStore()
+        a = Agent(store, "node-a")
+        # bind DIFFERENT labels locally OUTSIDE the kvstore path, taking
+        # the number the kvstore will hand out next (256)
+        local = a.registry.allocate(parse_label_array(LBLS["db"]))
+        b = Agent(store, "node-b")
+        remote = b.ident.allocate(parse_label_array(LBLS["web"]))
+        assert remote.id == local.id  # the conflict under test
+        a.pump()  # must not raise
+        assert a.registry.get(local.id).labels == local.labels
+
+    def test_ipsync_resync_after_lease_loss(self):
+        store = InMemoryStore()
+        a, b = Agent(store, "node-a"), Agent(store, "node-b")
+        web = a.ident.allocate(parse_label_array(LBLS["web"]))
+        a.ipsync.announce("10.1.0.5", web.id, host_ip="192.168.0.1")
+        store.revoke_lease(a.backend.lease_id)
+        b.pump()
+        assert b.ipcache.lookup_by_ip("10.1.0.5") is None
+        a.backend = InMemoryBackend(store, "node-a")
+        a.ipsync.backend = a.backend
+        assert a.ipsync.resync() == 1
+        b.pump()
+        e = b.ipcache.lookup_by_ip("10.1.0.5")
+        assert e is not None and e.identity == web.id
+
+    def test_adopt_race_with_gc_cannot_rebind(self):
+        """Adoption is serialized with GC via the per-key lock and the
+        slave key is conditioned on the master key, so an adopted id
+        can never be reaped-and-rebound underneath the adopter."""
+        from cilium_tpu.kvstore import Allocator
+
+        store = InMemoryStore()
+        a1 = Allocator(InMemoryBackend(store, "n1"), "alloc", suffix="n1", min_id=10)
+        id1, _ = a1.allocate("k")
+        a1.release("k")  # slave gone, master orphaned
+        a2 = Allocator(InMemoryBackend(store, "n2"), "alloc", suffix="n2", min_id=10)
+        # GC runs BEFORE n2 tries to adopt: master reaped → n2 must
+        # re-allocate fresh (same number, fresh master), not adopt a
+        # dangling id
+        assert a1.run_gc() == [id1]
+        id2, is_new = a2.allocate("k")
+        assert id2 == id1 and is_new
+        assert a1.run_gc() == []  # n2's slave protects it now

