@@ -1,0 +1,257 @@
+"""Vectorized flow conntrack for the batched datapath.
+
+The reference consults its conntrack tables on every packet before the
+policy stage (bpf/bpf_lxc.c:477 ct_lookup4 / bpf/lib/conntrack.h:103-205):
+an established or reply hit forwards without a policy verdict — that's
+what lets reply traffic flow without explicit rules and keeps the
+per-packet cost at one hash probe.
+
+TPU-first redesign: the table is a numpy open-addressing hash table
+probed with fully vectorized batch lookups, sitting IN FRONT of the
+device dispatch. Established-heavy batches shrink (often to zero) the
+flow set that pays the device round trip — the same economics as the
+kernel's CT fast path, moved to the batch level. Keys are three packed
+uint64 words so IPv4 and IPv6 share one table.
+
+Direction/reply semantics (conntrack.h tuple flip): an entry created
+for (peer, ep, sport, dport, dir) matches
+
+- the exact tuple again              → ESTABLISHED
+- (peer, ep, dport, sport, 1-dir)    → REPLY
+
+mirroring the kernel's forward/reverse tuple pair.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+CT_NEW = 0
+CT_ESTABLISHED = 1
+CT_REPLY = 2
+
+DEFAULT_LIFETIME_TCP = 21600.0  # CT_CONNECTION_LIFETIME_TCP (6h)
+DEFAULT_LIFETIME_OTHER = 60.0
+
+_EMPTY = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer — vectorized uint64 avalanche."""
+    with np.errstate(over="ignore"):
+        x = x.astype(np.uint64, copy=True)
+        x ^= x >> np.uint64(30)
+        x *= np.uint64(0xBF58476D1CE4E5B9)
+        x ^= x >> np.uint64(27)
+        x *= np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+    return x
+
+
+def pack_keys(
+    peer_hi: np.ndarray,  # [B] uint64 — high 64 bits of peer IP (0 for v4)
+    peer_lo: np.ndarray,  # [B] uint64 — low 64 bits (v4 address for v4)
+    ep_idx: np.ndarray,
+    sport: np.ndarray,
+    dport: np.ndarray,
+    proto: np.ndarray,
+    direction: np.ndarray,  # [B] 0 ingress / 1 egress
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """→ (ka, kb, kc) uint64 key words for the forward tuple."""
+    # bit layout of kc: ep[41..63] sport[25..40] dport[9..24]
+    # proto[1..8] dir[0]
+    ka = peer_hi.astype(np.uint64)
+    kb = peer_lo.astype(np.uint64)
+    kc = (
+        (ep_idx.astype(np.uint64) << np.uint64(41))
+        | (sport.astype(np.uint64) << np.uint64(25))
+        | (dport.astype(np.uint64) << np.uint64(9))
+        | (proto.astype(np.uint64) << np.uint64(1))
+        | direction.astype(np.uint64)
+    )
+    return ka, kb, kc
+
+
+def unpack_proto(kc: np.ndarray) -> np.ndarray:
+    return (kc >> np.uint64(1)) & np.uint64(0xFF)
+
+
+def flip_kc(kc: np.ndarray) -> np.ndarray:
+    """Reply tuple: swap sport/dport, flip direction, keep ep/proto."""
+    ep = kc >> np.uint64(41)
+    sport = (kc >> np.uint64(25)) & np.uint64(0xFFFF)
+    dport = (kc >> np.uint64(9)) & np.uint64(0xFFFF)
+    proto = unpack_proto(kc)
+    direction = kc & np.uint64(0x1)
+    return (
+        (ep << np.uint64(41))
+        | (dport << np.uint64(25))
+        | (sport << np.uint64(9))
+        | (proto << np.uint64(1))
+        | (np.uint64(1) - direction)
+    )
+
+
+class FlowConntrack:
+    """Open-addressing CT table with vectorized batch ops."""
+
+    def __init__(
+        self,
+        capacity_bits: int = 18,
+        # 16 linear probes: zero insert drops at load ≤0.25 (measured);
+        # drops only degrade to per-batch re-verdicts, but each CT miss
+        # tail costs a device dispatch, so placement robustness pays.
+        probes: int = 16,
+        tcp_lifetime: float = DEFAULT_LIFETIME_TCP,
+        other_lifetime: float = DEFAULT_LIFETIME_OTHER,
+    ) -> None:
+        self.capacity = 1 << capacity_bits
+        self.mask = np.uint64(self.capacity - 1)
+        self.probes = probes
+        self.tcp_lifetime = tcp_lifetime
+        self.other_lifetime = other_lifetime
+        self._lock = threading.Lock()
+        c = self.capacity
+        self.ka = np.full(c, _EMPTY, np.uint64)
+        self.kb = np.zeros(c, np.uint64)
+        self.kc = np.zeros(c, np.uint64)
+        self.valid = np.zeros(c, bool)
+        self.expires = np.zeros(c, np.float64)
+        self.packets = np.zeros(c, np.int64)
+        self.version = 0
+
+    # ------------------------------------------------------------------
+    def _hash(self, ka, kb, kc) -> np.ndarray:
+        with np.errstate(over="ignore"):
+            h = _mix64(ka ^ _mix64(kb ^ _mix64(kc)))
+        return h
+
+    def _probe_slots(self, ka, kb, kc) -> np.ndarray:
+        """[B, P] candidate slot indices (linear probing)."""
+        h = self._hash(ka, kb, kc)
+        with np.errstate(over="ignore"):
+            return (
+                (h[:, None] + np.arange(self.probes, dtype=np.uint64)[None, :])
+                & self.mask
+            ).astype(np.int64)
+
+    def _find(self, ka, kb, kc, now: float) -> np.ndarray:
+        """[B] slot of a live exact match, or -1."""
+        slots = self._probe_slots(ka, kb, kc)  # [B, P]
+        match = (
+            self.valid[slots]
+            & (self.ka[slots] == ka[:, None])
+            & (self.kb[slots] == kb[:, None])
+            & (self.kc[slots] == kc[:, None])
+            & (self.expires[slots] > now)
+        )
+        any_hit = match.any(axis=1)
+        first = match.argmax(axis=1)
+        return np.where(any_hit, slots[np.arange(len(ka)), first], -1)
+
+    # ------------------------------------------------------------------
+    def lookup_batch(
+        self, ka, kb, kc, *, refresh: bool = True
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """→ (state [B] uint8 CT_*, slot [B] int64). Established hits
+        optionally refresh lifetimes (the kernel updates ct lifetime on
+        every packet)."""
+        now = time.monotonic()
+        with self._lock:
+            slot = self._find(ka, kb, kc, now)
+            state = np.where(slot >= 0, CT_ESTABLISHED, CT_NEW).astype(np.uint8)
+            miss = slot < 0
+            if miss.any():
+                rslot = self._find(ka[miss], kb[miss], flip_kc(kc[miss]), now)
+                rhit = rslot >= 0
+                midx = np.nonzero(miss)[0]
+                state[midx[rhit]] = CT_REPLY
+                slot[midx] = np.where(rhit, rslot, -1)
+            live = slot >= 0
+            if refresh and live.any():
+                s = slot[live]
+                proto = unpack_proto(self.kc[s])
+                life = np.where(
+                    proto == 6, self.tcp_lifetime, self.other_lifetime
+                )
+                self.expires[s] = now + life
+                np.add.at(self.packets, s, 1)
+            return state, slot
+
+    def create_batch(self, ka, kb, kc) -> int:
+        """Insert forward-tuple entries (vectorized claim, P rounds of
+        first-writer-wins per slot). Duplicate keys in the batch are
+        deduped; full neighborhoods drop the insert (the kernel map
+        fails inserts when full — flow retries next batch). Returns the
+        number inserted."""
+        if len(ka) == 0:
+            return 0
+        now = time.monotonic()
+        with self._lock:
+            # dedupe within the batch
+            u, uidx = np.unique(
+                np.stack([ka, kb, kc], axis=1), axis=0, return_index=True
+            )
+            ka, kb, kc = ka[uidx], kb[uidx], kc[uidx]
+            # skip keys already present (established)
+            have = self._find(ka, kb, kc, now) >= 0
+            ka, kb, kc = ka[~have], kb[~have], kc[~have]
+            if len(ka) == 0:
+                return 0
+            slots = self._probe_slots(ka, kb, kc)  # [B, P]
+            proto = unpack_proto(kc)
+            life = np.where(proto == 6, self.tcp_lifetime, self.other_lifetime)
+            placed = np.zeros(len(ka), bool)
+            inserted = 0
+            for p in range(self.probes):
+                cand = slots[:, p]
+                free = (~self.valid[cand]) | (self.expires[cand] <= now)
+                want = (~placed) & free
+                if not want.any():
+                    continue
+                idx = np.nonzero(want)[0]
+                # first writer wins per slot within this round
+                _, first = np.unique(cand[idx], return_index=True)
+                win = idx[first]
+                s = cand[win]
+                self.ka[s] = ka[win]
+                self.kb[s] = kb[win]
+                self.kc[s] = kc[win]
+                self.valid[s] = True
+                self.expires[s] = now + life[win]
+                self.packets[s] = 1
+                placed[win] = True
+                inserted += len(win)
+                if placed.all():
+                    break
+            self.version += 1
+            return inserted
+
+    # -- maintenance ----------------------------------------------------
+    def gc(self) -> int:
+        """Invalidate expired entries (ctmap.go GC:345)."""
+        now = time.monotonic()
+        with self._lock:
+            stale = self.valid & (self.expires <= now)
+            n = int(stale.sum())
+            if n:
+                self.valid[stale] = False
+                self.ka[stale] = _EMPTY
+                self.version += 1
+            return n
+
+    def flush(self) -> int:
+        with self._lock:
+            n = int(self.valid.sum())
+            self.valid[:] = False
+            self.ka[:] = _EMPTY
+            self.version += 1
+            return n
+
+    def __len__(self) -> int:
+        now = time.monotonic()
+        return int((self.valid & (self.expires > now)).sum())

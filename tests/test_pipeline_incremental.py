@@ -156,7 +156,9 @@ class TestFastpath:
         import jax.numpy as jnp
         from cilium_tpu.ops.lookup import lookup_batch
 
-        t = pipe.rebuild()
+        from cilium_tpu.ops.materialize import TRAFFIC_INGRESS
+
+        t = pipe.rebuild()[(TRAFFIC_INGRESS, 4)]
         for _ in range(300):
             ep = int(rng.integers(0, 6))
             ident = idents[int(rng.integers(0, len(idents)))]
