@@ -1,0 +1,4 @@
+from .server import APIServer
+from .client import APIClient, APIError
+
+__all__ = ["APIServer", "APIClient", "APIError"]

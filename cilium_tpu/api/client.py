@@ -1,0 +1,101 @@
+"""Client for the unix-socket REST API (pkg/client analog)."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+from typing import Optional
+
+
+class APIError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class _UnixConnection(http.client.HTTPConnection):
+    def __init__(self, path: str, timeout: float = 30.0) -> None:
+        super().__init__("localhost", timeout=timeout)
+        self._path = path
+
+    def connect(self) -> None:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout)
+        s.connect(self._path)
+        self.sock = s
+
+
+class APIClient:
+    def __init__(self, socket_path: str, timeout: float = 30.0) -> None:
+        self.socket_path = socket_path
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        conn = _UnixConnection(self.socket_path, self.timeout)
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if payload else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read().decode()
+            ctype = resp.headers.get("Content-Type", "")
+            data = json.loads(raw) if "json" in ctype else raw
+            if resp.status >= 400:
+                msg = data.get("error", raw) if isinstance(data, dict) else raw
+                raise APIError(resp.status, msg)
+            return data
+        finally:
+            conn.close()
+
+    # -- typed wrappers -------------------------------------------------
+    def status(self):
+        return self._request("GET", "/status")
+
+    def metrics(self) -> str:
+        return self._request("GET", "/metrics")
+
+    def policy_get(self):
+        return self._request("GET", "/policy")
+
+    def policy_put(self, rules: list):
+        return self._request("PUT", "/policy", {"rules": rules})
+
+    def policy_delete(self, labels: list):
+        return self._request("DELETE", "/policy", {"labels": labels})
+
+    def policy_resolve(self, src, dst, dports=(), *, ingress=True, verbose=False):
+        return self._request("POST", "/policy/resolve", {
+            "src": list(src), "dst": list(dst), "dports": list(dports),
+            "ingress": ingress, "verbose": verbose,
+        })
+
+    def endpoint_list(self):
+        return self._request("GET", "/endpoint")
+
+    def endpoint_put(self, ep_id: int, labels, ipv4=None, ipv6=None):
+        return self._request("PUT", f"/endpoint/{ep_id}", {
+            "labels": list(labels), "ipv4": ipv4, "ipv6": ipv6,
+        })
+
+    def endpoint_delete(self, ep_id: int):
+        return self._request("DELETE", f"/endpoint/{ep_id}")
+
+    def policymap_get(self, ep_id: int, *, egress: bool = False):
+        d = "egress" if egress else "ingress"
+        return self._request("GET", f"/endpoint/{ep_id}/policymap?direction={d}")
+
+    def identity_list(self):
+        return self._request("GET", "/identity")
+
+    def identity_get(self, num: int):
+        return self._request("GET", f"/identity/{num}")
+
+    def prefilter_get(self):
+        return self._request("GET", "/prefilter")
+
+    def prefilter_patch(self, cidrs, revision=None):
+        body = {"cidrs": list(cidrs)}
+        if revision is not None:
+            body["revision"] = revision
+        return self._request("PATCH", "/prefilter", body)

@@ -1,0 +1,194 @@
+"""REST API over a unix socket — the daemon's wire surface.
+
+Re-design of the reference's swagger REST API
+(/root/reference/api/v1/openapi.yaml, handler wiring
+/root/reference/daemon/main.go:963-1035): same resource layout
+(/healthz /policy /policy/resolve /endpoint /identity /metrics
+/prefilter /status), JSON bodies, served over an AF_UNIX socket like
+the reference's cilium.sock. Implemented on http.server — the daemon
+is the backend, this layer only routes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..daemon import Daemon
+
+
+class _UnixHTTPServer(ThreadingHTTPServer):
+    address_family = socket.AF_UNIX
+    daemon_threads = True
+    allow_reuse_address = False
+
+    def server_bind(self):
+        path = self.server_address
+        if isinstance(path, str) and os.path.exists(path):
+            os.unlink(path)
+        self.socket.bind(path)
+
+    def server_activate(self):
+        self.socket.listen(64)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # BaseHTTPRequestHandler assumes AF_INET client addresses
+    def address_string(self) -> str:
+        return "unix"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    # -- helpers --------------------------------------------------------
+    def _json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _text(self, code: int, text: str) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b""
+        return json.loads(raw.decode()) if raw else {}
+
+    @property
+    def d(self) -> Daemon:
+        return self.server.daemon_obj  # type: ignore[attr-defined]
+
+    def _route(self, method: str) -> None:
+        parsed = urllib.parse.urlsplit(self.path)
+        path = parsed.path.rstrip("/") or "/"
+        q = urllib.parse.parse_qs(parsed.query)
+        try:
+            handled = self._dispatch(method, path, q)
+        except (ValueError, KeyError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        except Exception as e:  # surface daemon errors as 500s
+            self._json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        if not handled:
+            self._json(404, {"error": f"no route {method} {path}"})
+
+    def _dispatch(self, method: str, path: str, q) -> bool:
+        d = self.d
+        if method == "GET" and path == "/healthz":
+            self._json(200, d.status())
+        elif method == "GET" and path == "/status":
+            self._json(200, d.status())
+        elif method == "GET" and path == "/metrics":
+            self._text(200, d.metrics_text())
+        elif path == "/policy" and method == "GET":
+            self._json(200, d.policy_get(q.get("labels")))
+        elif path == "/policy" and method == "PUT":
+            body = self._body()
+            self._json(200, d.policy_add(json.dumps(body["rules"])))
+        elif path == "/policy" and method == "DELETE":
+            body = self._body()
+            self._json(200, d.policy_delete(body.get("labels", [])))
+        elif path == "/policy/resolve" and method == "POST":
+            body = self._body()
+            self._json(200, d.policy_resolve(
+                body.get("src", []), body.get("dst", []),
+                body.get("dports", []),
+                ingress=body.get("ingress", True),
+                verbose=body.get("verbose", False),
+            ))
+        elif path == "/endpoint" and method == "GET":
+            self._json(200, d.endpoint_list())
+        elif (m := re.fullmatch(r"/endpoint/(\d+)", path)):
+            ep_id = int(m.group(1))
+            if method == "PUT":
+                body = self._body()
+                self._json(201, d.endpoint_add(
+                    ep_id, body.get("labels", []),
+                    ipv4=body.get("ipv4"), ipv6=body.get("ipv6"),
+                    pod_name=body.get("pod_name", ""),
+                ))
+            elif method == "DELETE":
+                ok = d.endpoint_delete(ep_id)
+                self._json(200 if ok else 404, {"deleted": ok})
+            else:
+                return False
+        elif (m := re.fullmatch(r"/endpoint/(\d+)/policymap", path)) and method == "GET":
+            ingress = q.get("direction", ["ingress"])[0] != "egress"
+            self._json(200, d.policymap_dump(int(m.group(1)), ingress=ingress))
+        elif path == "/identity" and method == "GET":
+            self._json(200, d.identity_list())
+        elif (m := re.fullmatch(r"/identity/(\d+)", path)) and method == "GET":
+            ident = d.identity_get(int(m.group(1)))
+            if ident is None:
+                self._json(404, {"error": "identity not found"})
+            else:
+                self._json(200, ident)
+        elif path == "/prefilter" and method == "GET":
+            rev, cidrs = d.prefilter.dump()
+            self._json(200, {"revision": rev, "cidrs": cidrs})
+        elif path == "/prefilter" and method == "PATCH":
+            body = self._body()
+            rev = d.prefilter.insert(
+                body.get("revision", d.prefilter.revision),
+                body.get("cidrs", []),
+            )
+            self._json(200, {"revision": rev})
+        else:
+            return False
+        return True
+
+    def do_GET(self):
+        self._route("GET")
+
+    def do_PUT(self):
+        self._route("PUT")
+
+    def do_POST(self):
+        self._route("POST")
+
+    def do_DELETE(self):
+        self._route("DELETE")
+
+    def do_PATCH(self):
+        self._route("PATCH")
+
+
+class APIServer:
+    """Serves a Daemon on a unix socket (cilium.sock role)."""
+
+    def __init__(self, daemon: Daemon, socket_path: str) -> None:
+        self.daemon = daemon
+        self.socket_path = socket_path
+        self._server = _UnixHTTPServer(socket_path, _Handler)
+        self._server.daemon_obj = daemon  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)

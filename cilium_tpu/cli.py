@@ -1,0 +1,267 @@
+"""CLI — the `cilium` command surface (reference: /root/reference/
+cilium/cmd, 73 cobra commands; this implements the core operational
+set: policy import/get/delete/trace, endpoint list/add/delete,
+identity get/list, bpf policy get, prefilter, status, metrics, daemon).
+
+Two modes, decided per invocation:
+
+- **daemon mode**: if the API socket exists (``--socket`` /
+  ``$CILIUM_TPU_SOCK``), commands go over REST like the reference CLI
+  talks to cilium-agent.
+- **standalone mode**: otherwise an in-process Daemon is constructed
+  over the state dir (``--state`` / ``$CILIUM_TPU_STATE``), so `policy
+  trace` works offline against imported policy — the offline-verdict
+  flow of cilium/cmd/policy_trace.go.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+DEFAULT_SOCK = os.environ.get("CILIUM_TPU_SOCK", "/tmp/cilium_tpu.sock")
+DEFAULT_STATE = os.environ.get(
+    "CILIUM_TPU_STATE", os.path.expanduser("~/.cilium_tpu")
+)
+
+
+class _Surface:
+    """Uniform facade over APIClient (daemon mode) or Daemon
+    (standalone)."""
+
+    def __init__(self, socket_path: str, state_dir: str) -> None:
+        self._client = None
+        self._daemon = None
+        if os.path.exists(socket_path):
+            from .api.client import APIClient
+
+            self._client = APIClient(socket_path)
+        else:
+            from .daemon import Daemon
+
+            self._daemon = Daemon(state_dir=state_dir)
+
+    def __getattr__(self, name):
+        if self._client is not None:
+            return getattr(self._client, name)
+        return getattr(self, "_d_" + name)
+
+    # -- standalone adapters (mirror APIClient's surface) ---------------
+    def _d_status(self):
+        return self._daemon.status()
+
+    def _d_metrics(self):
+        return self._daemon.metrics_text()
+
+    def _d_policy_get(self):
+        return self._daemon.policy_get()
+
+    def _d_policy_put(self, rules):
+        return self._daemon.policy_add(json.dumps(rules))
+
+    def _d_policy_delete(self, labels):
+        return self._daemon.policy_delete(labels)
+
+    def _d_policy_resolve(self, src, dst, dports=(), *, ingress=True, verbose=False):
+        return self._daemon.policy_resolve(
+            src, dst, dports, ingress=ingress, verbose=verbose
+        )
+
+    def _d_endpoint_list(self):
+        return self._daemon.endpoint_list()
+
+    def _d_endpoint_put(self, ep_id, labels, ipv4=None, ipv6=None):
+        return self._daemon.endpoint_add(ep_id, labels, ipv4=ipv4, ipv6=ipv6)
+
+    def _d_endpoint_delete(self, ep_id):
+        return {"deleted": self._daemon.endpoint_delete(ep_id)}
+
+    def _d_policymap_get(self, ep_id, *, egress=False):
+        return self._daemon.policymap_dump(ep_id, ingress=not egress)
+
+    def _d_identity_list(self):
+        return self._daemon.identity_list()
+
+    def _d_identity_get(self, num):
+        out = self._daemon.identity_get(num)
+        if out is None:
+            raise SystemExit(f"identity {num} not found")
+        return out
+
+    def _d_prefilter_get(self):
+        rev, cidrs = self._daemon.prefilter.dump()
+        return {"revision": rev, "cidrs": cidrs}
+
+    def _d_prefilter_patch(self, cidrs, revision=None):
+        rev = self._daemon.prefilter.insert(
+            revision if revision is not None
+            else self._daemon.prefilter.revision,
+            cidrs,
+        )
+        return {"revision": rev}
+
+
+def _print(obj) -> None:
+    if isinstance(obj, str):
+        print(obj, end="" if obj.endswith("\n") else "\n")
+    else:
+        print(json.dumps(obj, indent=2))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cilium-tpu", description="TPU-native policy framework CLI"
+    )
+    p.add_argument("--socket", default=DEFAULT_SOCK,
+                   help="daemon API socket (used when it exists)")
+    p.add_argument("--state", default=DEFAULT_STATE,
+                   help="state dir for standalone mode")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    # daemon
+    d = sub.add_parser("daemon", help="run the agent + API server")
+    d.add_argument("--no-conntrack", action="store_true")
+
+    # status / metrics
+    sub.add_parser("status", help="agent status")
+    sub.add_parser("metrics", help="Prometheus metrics dump")
+
+    # policy
+    pol = sub.add_parser("policy", help="policy operations").add_subparsers(
+        dest="sub", required=True
+    )
+    imp = pol.add_parser("import", help="import rules from a JSON file")
+    imp.add_argument("file", help="rules JSON file ('-' = stdin)")
+    pol.add_parser("get", help="dump the repository")
+    dele = pol.add_parser("delete", help="delete rules by label")
+    dele.add_argument("labels", nargs="+", help="labels, e.g. k8s:policy=x")
+    tr = pol.add_parser("trace", help="offline verdict + trace log")
+    tr.add_argument("-s", "--src", action="append", required=True,
+                    help="source label (repeatable)")
+    tr.add_argument("-d", "--dst", action="append", required=True,
+                    help="destination label (repeatable)")
+    tr.add_argument("--dport", action="append", default=[],
+                    help="destination port 'port[/proto]' (repeatable)")
+    tr.add_argument("--egress", action="store_true",
+                    help="trace the egress direction")
+    tr.add_argument("-v", "--verbose", action="store_true")
+
+    # endpoint
+    ep = sub.add_parser("endpoint", help="endpoint operations").add_subparsers(
+        dest="sub", required=True
+    )
+    ep.add_parser("list", help="list endpoints")
+    epa = ep.add_parser("add", help="create an endpoint")
+    epa.add_argument("id", type=int)
+    epa.add_argument("-l", "--label", action="append", required=True)
+    epa.add_argument("--ipv4")
+    epa.add_argument("--ipv6")
+    epd = ep.add_parser("delete", help="remove an endpoint")
+    epd.add_argument("id", type=int)
+
+    # identity
+    idp = sub.add_parser("identity", help="identity operations").add_subparsers(
+        dest="sub", required=True
+    )
+    idp.add_parser("list", help="list identities")
+    idg = idp.add_parser("get", help="get one identity")
+    idg.add_argument("id", type=int)
+
+    # bpf policy get (map dump)
+    bpf = sub.add_parser("bpf", help="datapath map access").add_subparsers(
+        dest="sub", required=True
+    )
+    bp = bpf.add_parser("policy", help="policymap ops").add_subparsers(
+        dest="op", required=True
+    )
+    bpg = bp.add_parser("get", help="dump an endpoint's realized policymap")
+    bpg.add_argument("endpoint", type=int)
+    bpg.add_argument("--egress", action="store_true")
+
+    # prefilter
+    pf = sub.add_parser("prefilter", help="XDP deny-list").add_subparsers(
+        dest="sub", required=True
+    )
+    pf.add_parser("get", help="dump deny CIDRs")
+    pfu = pf.add_parser("update", help="insert deny CIDRs")
+    pfu.add_argument("cidrs", nargs="+")
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.cmd == "daemon":
+        from .api.server import APIServer
+        from .daemon import Daemon
+
+        daemon = Daemon(
+            state_dir=args.state, conntrack=not args.no_conntrack
+        )
+        server = APIServer(daemon, args.socket)
+        print(f"cilium-tpu daemon serving on {args.socket} "
+              f"(state: {args.state})")
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            server.stop()
+            daemon.shutdown()
+        return 0
+
+    s = _Surface(args.socket, args.state)
+
+    if args.cmd == "status":
+        _print(s.status())
+    elif args.cmd == "metrics":
+        _print(s.metrics())
+    elif args.cmd == "policy":
+        if args.sub == "import":
+            text = (sys.stdin.read() if args.file == "-"
+                    else open(args.file).read())
+            _print(s.policy_put(json.loads(text)))
+        elif args.sub == "get":
+            _print(s.policy_get())
+        elif args.sub == "delete":
+            _print(s.policy_delete(args.labels))
+        elif args.sub == "trace":
+            out = s.policy_resolve(
+                args.src, args.dst, args.dport,
+                ingress=not args.egress, verbose=args.verbose,
+            )
+            print(out["trace"], end="")
+            print(f"Final verdict: {out['verdict']}")
+            if not out["parity"]:
+                print("WARNING: device/oracle verdict mismatch "
+                      f"(device allowed={out['device_allowed']})",
+                      file=sys.stderr)
+                return 2
+            return 0 if out["allowed"] else 1
+    elif args.cmd == "endpoint":
+        if args.sub == "list":
+            _print(s.endpoint_list())
+        elif args.sub == "add":
+            _print(s.endpoint_put(args.id, args.label,
+                                  ipv4=args.ipv4, ipv6=args.ipv6))
+        elif args.sub == "delete":
+            _print(s.endpoint_delete(args.id))
+    elif args.cmd == "identity":
+        if args.sub == "list":
+            _print(s.identity_list())
+        else:
+            _print(s.identity_get(args.id))
+    elif args.cmd == "bpf":
+        _print(s.policymap_get(args.endpoint, egress=args.egress))
+    elif args.cmd == "prefilter":
+        if args.sub == "get":
+            _print(s.prefilter_get())
+        else:
+            _print(s.prefilter_patch(args.cidrs))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

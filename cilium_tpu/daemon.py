@@ -1,0 +1,336 @@
+"""Daemon core: the per-node agent object every surface talks to.
+
+Re-design of /root/reference/daemon/daemon.go (NewDaemon :1051) for the
+TPU framework: owns the policy repository, identity registry, ipcache,
+prefilter, conntrack, endpoint manager, and the device pipeline, and
+exposes the operations the REST API (/root/reference/api/v1, wiring
+daemon/main.go:963-1035) and CLI surface. No kernel writes — the
+"datapath" is the device pipeline; regeneration swaps device tables.
+
+State persistence: rules/endpoints/ipcache snapshot to a state dir
+(the role of /var/run/cilium endpoint dirs + restore,
+/root/reference/daemon/state.go:53,135).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import metrics
+from .datapath.conntrack import FlowConntrack
+from .datapath.pipeline import DatapathPipeline
+from .endpoint.endpoint import Endpoint, EndpointState
+from .endpoint.manager import EndpointManager
+from .engine import PolicyEngine
+from .identity import IdentityRegistry
+from .ipcache.ipcache import IPCache, SOURCE_AGENT
+from .ipcache.prefilter import PreFilter
+from .labels import parse_label_array
+from .ops.materialize import TRAFFIC_EGRESS, TRAFFIC_INGRESS
+from .policy.api.serialization import rule_from_dict, rule_to_dict, rules_from_json
+from .policy.repository import Repository
+from .policy.search import Decision, PortContext, SearchContext, Trace
+from .proxy.proxy import Proxy
+from . import u8proto
+
+
+def parse_dport(text: str) -> PortContext:
+    """'80/tcp' | '53/udp' | '80' → PortContext (cilium policy trace
+    --dport format, cilium/cmd/policy_trace.go)."""
+    if "/" in text:
+        port_s, proto_s = text.split("/", 1)
+        return PortContext(int(port_s), proto_s.upper())
+    return PortContext(int(text), "ANY")
+
+
+class Daemon:
+    """In-process agent (daemon/daemon.go Daemon struct)."""
+
+    def __init__(
+        self,
+        state_dir: Optional[str] = None,
+        *,
+        conntrack: bool = True,
+    ) -> None:
+        self.state_dir = state_dir
+        self.repo = Repository()
+        self.registry = IdentityRegistry()
+        self.ipcache = IPCache()
+        self.prefilter = PreFilter()
+        self.engine = PolicyEngine(self.repo, self.registry)
+        self.conntrack = FlowConntrack() if conntrack else None
+        self.pipeline = DatapathPipeline(
+            self.engine, self.ipcache, self.prefilter, conntrack=self.conntrack
+        )
+        self.endpoint_manager = EndpointManager()
+        self.proxy = Proxy()
+        self._lock = threading.RLock()
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+            self.restore_state()
+
+    # -- policy ---------------------------------------------------------
+    def policy_add(self, rules_json: str) -> Dict:
+        """PUT /policy (daemon/policy.go PolicyAdd:167)."""
+        rules = rules_from_json(rules_json)
+        rev = self.repo.add_list(rules)
+        self._regenerate("policy import")
+        self.save_state()
+        return {"revision": rev, "count": len(rules)}
+
+    def policy_get(self, labels: Optional[Sequence[str]] = None) -> Dict:
+        """GET /policy (daemon/policy.go getPolicy)."""
+        with self.repo._lock:
+            rules = list(self.repo.rules)
+        if labels:
+            sel = parse_label_array(labels)
+            rules = [
+                r for r in rules
+                if all(any(l == rl for rl in r.labels) for l in sel)
+            ]
+        return {
+            "revision": self.repo.revision,
+            "rules": [rule_to_dict(r) for r in rules],
+        }
+
+    def policy_delete(self, labels: Sequence[str]) -> Dict:
+        """DELETE /policy (daemon/policy.go PolicyDelete:253)."""
+        rev, n = self.repo.delete_by_labels(parse_label_array(labels))
+        self._regenerate("policy delete")
+        self.save_state()
+        return {"revision": rev, "deleted": n}
+
+    def policy_resolve(
+        self,
+        src_labels: Sequence[str],
+        dst_labels: Sequence[str],
+        dports: Sequence[str] = (),
+        *,
+        ingress: bool = True,
+        verbose: bool = False,
+    ) -> Dict:
+        """GET /policy/resolve — the `cilium policy trace` backend
+        (daemon/policy.go getPolicyResolve.Handle:66-126): runs the
+        traced host oracle AND the device engine, asserting parity so
+        every trace doubles as a device-correctness check."""
+        src = parse_label_array(src_labels)
+        dst = parse_label_array(dst_labels)
+        ports = tuple(parse_dport(p) for p in dports)
+        ctx = SearchContext(
+            src=src, dst=dst, dports=ports,
+            trace=Trace.VERBOSE if verbose else Trace.ENABLED,
+        )
+        oracle = (
+            self.repo.allows_ingress(ctx) if ingress
+            else self.repo.allows_egress(ctx)
+        )
+
+        # Device parity: identities for both label sets (ref-counted
+        # temporaries when not already allocated).
+        src_id = self.registry.lookup_by_labels(src)
+        dst_id = self.registry.lookup_by_labels(dst)
+        tmp = []
+        for have, lbls in ((src_id, src), (dst_id, dst)):
+            if have is None:
+                tmp.append(self.registry.allocate(lbls))
+        src_id = src_id or self.registry.lookup_by_labels(src)
+        dst_id = dst_id or self.registry.lookup_by_labels(dst)
+        subj, peer = (dst_id, src_id) if ingress else (src_id, dst_id)
+        if ports:
+            decs = [
+                self.engine.verdict_one(
+                    subj.id, peer.id, p.port,
+                    u8proto.from_name(p.protocol) if p.protocol not in ("ANY", "") else 6,
+                    ingress=ingress, l4=True,
+                )[0]
+                for p in ports
+            ]
+            device_allowed = all(d == 1 for d in decs)
+        else:
+            device_allowed = (
+                self.engine.verdict_one(
+                    subj.id, peer.id, 0, 6, ingress=ingress, l4=False
+                )[0] == 1
+            )
+        for ident in tmp:
+            self.registry.release(ident)
+
+        oracle_allowed = oracle == Decision.ALLOWED
+        return {
+            "verdict": str(oracle),
+            "allowed": oracle_allowed,
+            "device_allowed": device_allowed,
+            "parity": oracle_allowed == device_allowed,
+            "trace": ctx.log(),
+        }
+
+    # -- endpoints ------------------------------------------------------
+    def endpoint_add(
+        self,
+        endpoint_id: int,
+        labels: Sequence[str],
+        *,
+        ipv4: Optional[str] = None,
+        ipv6: Optional[str] = None,
+        pod_name: str = "",
+    ) -> Dict:
+        """PUT /endpoint/{id} (daemon/endpoint.go putEndpointID →
+        endpointmanager.Insert + AllocateIdentity + ipcache upsert +
+        regenerate)."""
+        with self._lock:
+            if self.endpoint_manager.lookup(endpoint_id) is not None:
+                raise ValueError(f"endpoint {endpoint_id} exists")
+            lbls = parse_label_array(labels)
+            ep = Endpoint(endpoint_id, lbls, ipv4=ipv4, ipv6=ipv6,
+                          pod_name=pod_name)
+            # CREATING → WAITING_FOR_IDENTITY → READY (endpoint.go
+            # lifecycle) so the first regeneration is legal.
+            ep.set_state(EndpointState.WAITING_FOR_IDENTITY)
+            ep.identity = self.registry.allocate(lbls)
+            ep.set_state(EndpointState.READY)
+            self.endpoint_manager.insert(ep)
+            if ipv4:
+                self.ipcache.upsert(f"{ipv4}/32", ep.identity.id,
+                                    source=SOURCE_AGENT)
+            if ipv6:
+                self.ipcache.upsert(f"{ipv6}/128", ep.identity.id,
+                                    source=SOURCE_AGENT)
+            self._sync_pipeline_endpoints()
+            ep.regenerate(self.pipeline, reason="endpoint create",
+                          proxy=self.proxy)
+        self.save_state()
+        return self._endpoint_model(ep)
+
+    def endpoint_delete(self, endpoint_id: int) -> bool:
+        with self._lock:
+            ep = self.endpoint_manager.lookup(endpoint_id)
+            if ep is None:
+                return False
+            self.endpoint_manager.remove(ep)
+            if ep.ipv4:
+                self.ipcache.delete(f"{ep.ipv4}/32", SOURCE_AGENT)
+            if ep.ipv6:
+                self.ipcache.delete(f"{ep.ipv6}/128", SOURCE_AGENT)
+            if ep.identity is not None:
+                self.registry.release(ep.identity)
+            self._sync_pipeline_endpoints()
+        self.save_state()
+        return True
+
+    def endpoint_list(self) -> List[Dict]:
+        return [self._endpoint_model(ep)
+                for ep in self.endpoint_manager.endpoints()]
+
+    def _endpoint_model(self, ep: Endpoint) -> Dict:
+        return {
+            "id": ep.id,
+            "labels": list(ep.labels.to_strings()),
+            "identity": ep.identity.id if ep.identity else None,
+            "ipv4": ep.ipv4,
+            "ipv6": ep.ipv6,
+            "state": str(ep.state.value),
+            "policy_revision": ep.policy_revision,
+        }
+
+    def _sync_pipeline_endpoints(self) -> None:
+        eps = self.endpoint_manager.endpoints()
+        self.pipeline.set_endpoints(
+            [(ep.id, ep.identity.id) for ep in eps if ep.identity]
+        )
+
+    def _regenerate(self, reason: str) -> None:
+        self.endpoint_manager.regenerate_all(self.pipeline, reason)
+
+    # -- map dumps ------------------------------------------------------
+    def policymap_dump(self, endpoint_id: int, *, ingress: bool = True) -> List[Dict]:
+        """`cilium bpf policy get <ep>` analog: the realized policymap
+        rows for one endpoint (pkg/maps/policymap DumpToSlice)."""
+        idx = self.pipeline.endpoint_index(endpoint_id)
+        if idx is None:
+            raise KeyError(f"endpoint {endpoint_id} not in datapath")
+        snaps = self.pipeline.snapshots(ingress=ingress)
+        out = []
+        for key, redirect in sorted(
+            snaps[idx].entries.items(),
+            key=lambda kv: (kv[0].identity, kv[0].dport, kv[0].nexthdr),
+        ):
+            out.append({
+                "identity": key.identity,
+                "dport": key.dport,
+                "proto": key.nexthdr,
+                "direction": "ingress" if key.direction == TRAFFIC_INGRESS
+                             else "egress",
+                "redirect": bool(redirect),
+            })
+        return out
+
+    # -- identities -----------------------------------------------------
+    def identity_list(self) -> List[Dict]:
+        return [
+            {"id": i.id, "labels": list(i.labels.to_strings())}
+            for i in sorted(self.registry, key=lambda i: i.id)
+        ]
+
+    def identity_get(self, num: int) -> Optional[Dict]:
+        ident = self.registry.get(num)
+        if ident is None:
+            return None
+        return {"id": ident.id, "labels": list(ident.labels.to_strings())}
+
+    # -- status ---------------------------------------------------------
+    def status(self) -> Dict:
+        return {
+            "policy_revision": self.repo.revision,
+            "rules": len(self.repo.rules),
+            "identities": len(self.registry),
+            "endpoints": len(self.endpoint_manager),
+            "ipcache_entries": len(self.ipcache),
+            "conntrack_entries": (
+                len(self.conntrack) if self.conntrack is not None else 0
+            ),
+            "prefilter_revision": self.prefilter.revision,
+        }
+
+    def metrics_text(self) -> str:
+        return metrics.registry.expose()
+
+    # -- state persistence (daemon/state.go role) ------------------------
+    def save_state(self) -> None:
+        if not self.state_dir:
+            return
+        with self.repo._lock:
+            rules = [rule_to_dict(r) for r in self.repo.rules]
+        eps = self.endpoint_list()
+        tmp = os.path.join(self.state_dir, ".state.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"rules": rules, "endpoints": eps}, f, indent=1)
+        os.replace(tmp, os.path.join(self.state_dir, "state.json"))
+
+    def restore_state(self) -> int:
+        """Parse the snapshot and rebuild live state (restoreOldEndpoints
+        + regenerateRestoredEndpoints, daemon/state.go:53,135)."""
+        path = os.path.join(self.state_dir or "", "state.json")
+        if not self.state_dir or not os.path.exists(path):
+            return 0
+        with open(path) as f:
+            snap = json.load(f)
+        rules = [rule_from_dict(d) for d in snap.get("rules", [])]
+        if rules:
+            self.repo.add_list(rules)
+        n = 0
+        for em in snap.get("endpoints", []):
+            try:
+                self.endpoint_add(
+                    em["id"], em["labels"], ipv4=em.get("ipv4"),
+                    ipv6=em.get("ipv6"),
+                )
+                n += 1
+            except ValueError:
+                pass
+        return n
+
+    def shutdown(self) -> None:
+        self.endpoint_manager.shutdown()

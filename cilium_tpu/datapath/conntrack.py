@@ -30,12 +30,11 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..maps.ctmap import DEFAULT_LIFETIME_OTHER, DEFAULT_LIFETIME_TCP
+
 CT_NEW = 0
 CT_ESTABLISHED = 1
 CT_REPLY = 2
-
-DEFAULT_LIFETIME_TCP = 21600.0  # CT_CONNECTION_LIFETIME_TCP (6h)
-DEFAULT_LIFETIME_OTHER = 60.0
 
 _EMPTY = np.uint64(0xFFFFFFFFFFFFFFFF)
 

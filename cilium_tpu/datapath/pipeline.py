@@ -150,7 +150,6 @@ class DatapathPipeline:
         self._mat_sig: Tuple = ()  # endpoint list the policymap was built for
         self._last_delta_seq = 0  # engine delta cursor
         self._trie_versions: Tuple = ()  # (ipcache.version, prefilter.revision)
-        self._ct_pf_rev: Optional[int] = None  # prefilter rev the CT was valid for
         self._tries: Optional[Tuple] = None  # ((pf4, ip4), (pf6, ip6), world_row)
         self.counters = np.zeros((0, 3), np.int64)
 
@@ -197,6 +196,9 @@ class DatapathPipeline:
             compiled, device = self.engine.snapshot()
             delta_target = max(delta_target, self.engine.delta_seq)
             ep_sig = tuple(self._endpoints)
+            # captured before the trie block updates _trie_versions;
+            # feeds the conntrack invalidation below
+            basis_moved = trie_versions != self._trie_versions
 
             mat_fresh = False
             saw_row_event = False
@@ -249,17 +251,22 @@ class DatapathPipeline:
                 )
                 self._trie_versions = trie_versions
 
-            # Prefilter updates must drop established flows too (the XDP
-            # stage runs before CT in the reference), so a revision move
-            # invalidates the CT table. Use the revision captured BEFORE
-            # the trie build: an insert landing mid-rebuild must flush on
-            # the NEXT rebuild (whose trie will include it), not be
-            # skipped because we advanced past it here.
-            if self.conntrack is not None:
-                pf_rev = trie_versions[1]
-                if self._ct_pf_rev is not None and self._ct_pf_rev != pf_rev:
-                    self.conntrack.flush()
-                self._ct_pf_rev = pf_rev
+            # Conntrack invalidation: established-flow bypass is only
+            # sound while the verdict basis that admitted the flow still
+            # holds. ANY basis move — policy re-materialization (rule
+            # changes, endpoint set), identity row churn, ipcache remap,
+            # prefilter revision — flushes the table, so revoked rules,
+            # remapped peer IPs, and new deny prefixes apply to
+            # established flows on their next packet (the reference
+            # scrubs CT after regeneration / ipcache changes; we take
+            # the conservative whole-table flush — one re-verdict per
+            # flow is a single batched dispatch). Uses the versions
+            # captured BEFORE the reads so a mutation landing mid-build
+            # flushes again on the next rebuild rather than slipping by.
+            if self.conntrack is not None and (
+                mat_fresh or saw_row_event or basis_moved
+            ):
+                self.conntrack.flush()
 
             assert self._tries is not None and self._mat
             v4, v6, world = self._tries

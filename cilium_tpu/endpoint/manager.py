@@ -89,7 +89,9 @@ class EndpointManager:
         return ok
 
     # -- conntrack GC ---------------------------------------------------
-    def enable_conntrack_gc(self, ctmap: ConntrackMap, interval: float = 60.0) -> None:
+    def enable_conntrack_gc(self, ctmap, interval: float = 60.0) -> None:
+        """Periodic CT reaping; accepts any table with a gc() method
+        (maps.ctmap.ConntrackMap or datapath.conntrack.FlowConntrack)."""
         self._controllers.update_controller(
             "ct-gc", lambda: ctmap.gc(), run_interval=interval
         )

@@ -17,6 +17,8 @@ from typing import Dict, Iterator, Optional, Tuple
 # (src_ip, dst_ip, sport, dport, proto, direction)
 FlowTuple = Tuple[int, int, int, int, int, int]
 
+# Single source of truth for CT lifetimes; datapath/conntrack.py (the
+# vectorized batch table) imports these.
 DEFAULT_LIFETIME_TCP = 21600.0  # CT_CONNECTION_LIFETIME_TCP (6h)
 DEFAULT_LIFETIME_OTHER = 60.0
 

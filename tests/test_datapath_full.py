@@ -64,6 +64,7 @@ def _world(with_ct: bool = False):
                     to_ports=(PortRule(ports=(PortProtocol(5432, "TCP"),)),),
                 )
             ],
+            labels=["k8s:policy=r0"],
         ),
     ])
     reg = IdentityRegistry()
@@ -397,3 +398,42 @@ class TestConntrackBypassSafety:
         assert len(pipe.conntrack) == 0
         v, _ = pipe.process(*args, ingress=True, sports=sp)
         assert int(v[0]) == DROP_POLICY  # no inherited bypass
+
+
+class TestConntrackInvalidation:
+    """CT bypass is only sound while the admitting verdict basis holds
+    (r3 review findings: revoked rules / remapped peer IPs must not be
+    bypassed by established flows)."""
+
+    def _establish(self, pipe):
+        args = (
+            _v4(["10.0.0.2"]), np.zeros(1, np.int32),
+            np.array([80], np.int32), np.full(1, 6, np.int32),
+        )
+        sp = np.array([40000], np.int64)
+        v, _ = pipe.process(*args, ingress=True, sports=sp)
+        assert int(v[0]) == FORWARD and len(pipe.conntrack) == 1
+        return args, sp
+
+    def test_rule_delete_drops_established_flows(self):
+        repo, reg, engine, cache, pipe, ids = _world(with_ct=True)
+        args, sp = self._establish(pipe)
+        _rev, n = repo.delete_by_labels(parse_label_array(["k8s:policy=r0"]))
+        assert n == 1
+        v, _ = pipe.process(*args, ingress=True, sports=sp)
+        assert int(v[0]) == DROP_POLICY, "revoked rule must not be CT-bypassed"
+
+    def test_ipcache_remap_drops_established_flows(self):
+        repo, reg, engine, cache, pipe, ids = _world(with_ct=True)
+        args, sp = self._establish(pipe)
+        # peer IP handed to an identity no rule allows
+        cache.upsert("10.0.0.2/32", ids["other"].id, source="agent")
+        v, _ = pipe.process(*args, ingress=True, sports=sp)
+        assert int(v[0]) == DROP_POLICY, "remapped peer must re-verdict"
+
+    def test_unrelated_batch_keeps_ct(self):
+        repo, reg, engine, cache, pipe, ids = _world(with_ct=True)
+        args, sp = self._establish(pipe)
+        # no control-plane movement: entry survives across batches
+        pipe.process(*args, ingress=True, sports=sp)
+        assert len(pipe.conntrack) == 1
