@@ -103,6 +103,15 @@ class Daemon:
         self.save_state()
         return {"revision": rev, "deleted": n}
 
+    def policy_translate(self, translator) -> Dict:
+        """Re-translate imported rules against changed external state
+        (k8s service churn; daemon/k8s_watcher.go → TranslateRules)."""
+        rev, n = self.repo.translate_rules(translator)
+        if n:
+            self._regenerate("policy translate")
+            self.save_state()
+        return {"revision": rev, "changed": n}
+
     def policy_resolve(
         self,
         src_labels: Sequence[str],

@@ -1,0 +1,143 @@
+"""k8s object watcher / dispatcher.
+
+Reference: daemon/k8s_watcher.go — the agent's single ingestion point
+for NetworkPolicy, CiliumNetworkPolicy, Service, Endpoints, Pod and
+Namespace events. There is no API server here; the watcher consumes
+decoded objects (dicts) pushed by whatever transport the deployment
+uses (file loads, tests, an external informer bridge) and applies them
+to the daemon: policies into the repository (keyed by provenance
+labels for deletion), services/endpoints into the ServiceRegistry
+(which re-triggers ToServices translation), pods into endpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Any, Dict, Iterable, List
+
+from ..labels import parse_label_array
+from ..policy.api.serialization import rule_from_dict, rules_to_json
+from .cnp import parse_cnp
+from .constants import extract_namespace, policy_labels
+from .network_policy import parse_network_policy
+from .pods import PodOrchestrator
+from .rule_translate import preprocess_rules
+from .service_registry import ServiceRegistry
+
+KIND_NETWORK_POLICY = "NetworkPolicy"
+KIND_CNP = "CiliumNetworkPolicy"
+KIND_SERVICE = "Service"
+KIND_ENDPOINTS = "Endpoints"
+KIND_POD = "Pod"
+KIND_NAMESPACE = "Namespace"
+
+
+def load_objects(path: str) -> List[Dict[str, Any]]:
+    """Decode a JSON or YAML file into a list of objects. YAML files
+    may hold multiple ``---`` documents; JSON may hold a list. A bare
+    rule list (no ``kind``) is returned as-is for `policy import`."""
+    p = pathlib.Path(path)
+    text = p.read_text()
+    if p.suffix in (".yaml", ".yml"):
+        import yaml
+
+        docs = [d for d in yaml.safe_load_all(text) if d]
+    else:
+        data = json.loads(text)
+        docs = data if isinstance(data, list) else [data]
+    # A document may itself be a list (a YAML/JSON rule array).
+    flat: List[Dict[str, Any]] = []
+    for d in docs:
+        flat.extend(d) if isinstance(d, list) else flat.append(d)
+    return flat
+
+
+def objects_to_rules(docs: Iterable[Dict[str, Any]]) -> list:
+    """Translate a mixed list of decoded objects into policy rules.
+    Bare rule dicts (no kind) pass through the native parser."""
+    rules = []
+    for obj in docs:
+        kind = obj.get("kind", "")
+        if kind == KIND_NETWORK_POLICY:
+            rules.extend(parse_network_policy(obj))
+        elif kind == KIND_CNP:
+            rules.extend(parse_cnp(obj))
+        elif kind in ("", None) or "endpointSelector" in obj:
+            r = rule_from_dict(obj)
+            r.sanitize()
+            rules.append(r)
+        # Non-policy kinds are skipped by this helper.
+    return rules
+
+
+class K8sWatcher:
+    """Applies k8s object events to a running Daemon."""
+
+    def __init__(self, daemon) -> None:
+        self.daemon = daemon
+        self.services = ServiceRegistry()
+        self.pods = PodOrchestrator(daemon)
+        self._namespace_labels: Dict[str, Dict[str, str]] = {}
+        self.pods.namespace_labels = self._namespace_labels
+        # Service churn retriggers ToServices translation of rules that
+        # are already imported (k8s_watcher.go serviceModFn →
+        # RuleTranslator over the repository).
+        self.services.observe(self._on_service_event)
+
+    # -- policy --------------------------------------------------------
+    def add_policy_object(self, obj: Dict[str, Any]) -> int:
+        rules = objects_to_rules([obj])
+        rules = preprocess_rules(rules, self.services)
+        return self.daemon.policy_add(rules_to_json(rules))["revision"]
+
+    def delete_policy_object(self, obj: Dict[str, Any]) -> int:
+        meta = obj.get("metadata") or {}
+        lbls = policy_labels(extract_namespace(meta), meta.get("name", ""))
+        return self.daemon.policy_delete(lbls)["revision"]
+
+    # -- services ------------------------------------------------------
+    def _on_service_event(self, event: str, sid) -> None:
+        from .rule_translate import RegistryTranslator
+
+        self.daemon.policy_translate(RegistryTranslator(self.services))
+
+    # -- dispatch ------------------------------------------------------
+    def apply(self, obj: Dict[str, Any]) -> None:
+        kind = obj.get("kind", "")
+        if kind in (KIND_NETWORK_POLICY, KIND_CNP):
+            self.add_policy_object(obj)
+        elif kind == KIND_SERVICE:
+            self.services.apply_service_object(obj)
+        elif kind == KIND_ENDPOINTS:
+            self.services.apply_endpoints_object(obj)
+        elif kind == KIND_POD:
+            self.pods.add_pod(obj)
+        elif kind == KIND_NAMESPACE:
+            meta = obj.get("metadata") or {}
+            self._namespace_labels[meta.get("name", "")] = dict(meta.get("labels") or {})
+        else:
+            raise ValueError(f"unsupported object kind {kind!r}")
+
+    def delete(self, obj: Dict[str, Any]) -> None:
+        kind = obj.get("kind", "")
+        if kind in (KIND_NETWORK_POLICY, KIND_CNP):
+            self.delete_policy_object(obj)
+        elif kind == KIND_SERVICE:
+            from .service_registry import ServiceID
+
+            meta = obj.get("metadata") or {}
+            self.services.delete_service(
+                ServiceID(meta.get("namespace") or "default", meta.get("name", ""))
+            )
+        elif kind == KIND_ENDPOINTS:
+            from .service_registry import ServiceID
+
+            meta = obj.get("metadata") or {}
+            self.services.delete_endpoints(
+                ServiceID(meta.get("namespace") or "default", meta.get("name", ""))
+            )
+        elif kind == KIND_POD:
+            self.pods.delete_pod(obj)
+        else:
+            raise ValueError(f"unsupported object kind {kind!r}")

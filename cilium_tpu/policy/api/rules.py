@@ -35,11 +35,13 @@ ENTITY_HOST = "host"
 ENTITY_WORLD = "world"
 ENTITY_CLUSTER = "cluster"
 ENTITY_ALL = "all"
+ENTITY_INIT = "init"  # initializing endpoints (entity.go:41)
 _ENTITY_SELECTORS = {
     ENTITY_HOST: EndpointSelector.make(["reserved:host"]),
     ENTITY_WORLD: EndpointSelector.make(["reserved:world"]),
     ENTITY_CLUSTER: EndpointSelector.make(["reserved:cluster"]),
     ENTITY_ALL: EndpointSelector.wildcard(),
+    ENTITY_INIT: EndpointSelector.make(["reserved:init"]),
 }
 
 
@@ -94,10 +96,14 @@ class PortRule:
 
 @dataclasses.dataclass(frozen=True)
 class CIDRRule:
-    """CIDR with carve-outs (cidr.go CIDRRule)."""
+    """CIDR with carve-outs (cidr.go CIDRRule). ``generated`` marks
+    entries synthesized by a translator (ToServices/ToFQDNs expansion,
+    rule_translate.go CIDRRule.Generated) so reverts only remove what
+    translation added."""
 
     cidr: str
     except_cidrs: Tuple[str, ...] = ()
+    generated: bool = False
 
     def sanitize(self) -> None:
         net = ipaddress.ip_network(self.cidr, strict=False)
@@ -178,11 +184,14 @@ class EgressRule:
 
 @dataclasses.dataclass(frozen=True)
 class ServiceSelector:
-    """k8s service reference (pkg/policy/api ServiceSelector); resolved
-    by the orchestrator layer into endpoint IPs → CIDR set."""
+    """k8s service reference (pkg/policy/api/service.go Service):
+    either a direct name+namespace (K8sService) or a label selector over
+    service labels (K8sServiceSelector). Resolved by the orchestrator
+    layer (k8s/rule_translate.py) into endpoint IPs → CIDR set."""
 
     name: str = ""
     namespace: str = ""
+    selector: Optional["EndpointSelector"] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -89,6 +89,39 @@ class EndpointSelector:
     def is_wildcard(self) -> bool:
         return not self.match_labels and not self.match_expressions
 
+    def has_key(self, key: str) -> bool:
+        """True if the selector matches on ``key`` (selector.go HasKey):
+        either a matchLabels entry or any matchExpression keyed on it."""
+        return any(k == key for k, _ in self.match_labels) or any(
+            e.key == key for e in self.match_expressions
+        )
+
+    def has_key_prefix(self, prefix: str) -> bool:
+        """True if any match key starts with ``prefix`` (HasKeyPrefix)."""
+        return any(k.startswith(prefix) for k, _ in self.match_labels) or any(
+            e.key.startswith(prefix) for e in self.match_expressions
+        )
+
+    def get_match(self, key: str) -> Optional[str]:
+        """Value matched for ``key`` in matchLabels, else None (GetMatch)."""
+        for k, v in self.match_labels:
+            if k == key:
+                return v
+        return None
+
+    def with_match(self, key: str, value: str) -> "EndpointSelector":
+        """New selector with ``key=value`` added to matchLabels
+        (selector.go AddMatch; immutable here)."""
+        if self.get_match(key) == value:
+            return self
+        pairs = tuple(sorted(dict(self.match_labels, **{key: value}).items()))
+        return EndpointSelector(pairs, self.match_expressions)
+
+    def with_expression(self, expr: MatchExpression) -> "EndpointSelector":
+        if expr in self.match_expressions:
+            return self
+        return EndpointSelector(self.match_labels, self.match_expressions + (expr,))
+
     # -- host-side evaluation (the oracle path) -------------------------
     def matches(self, labels: LabelArray) -> bool:
         for key, value in self.match_labels:

@@ -135,7 +135,11 @@ def _ports_to_dict(port_rules: Sequence[PortRule]) -> List[Dict[str, Any]]:
 
 def _cidr_set(entries: Iterable[Dict[str, Any]]) -> tuple:
     return tuple(
-        CIDRRule(cidr=c["cidr"], except_cidrs=tuple(c.get("except") or ()))
+        CIDRRule(
+            cidr=c["cidr"],
+            except_cidrs=tuple(c.get("except") or ()),
+            generated=bool(c.get("generated", False)),
+        )
         for c in entries or ()
     )
 
@@ -163,7 +167,13 @@ def rule_from_dict(d: Dict[str, Any]) -> Rule:
             to_services=tuple(
                 ServiceSelector(
                     name=(s.get("k8sService") or {}).get("serviceName", ""),
-                    namespace=(s.get("k8sService") or {}).get("namespace", ""),
+                    namespace=(s.get("k8sService") or {}).get("namespace", "")
+                    or (s.get("k8sServiceSelector") or {}).get("namespace", ""),
+                    selector=(
+                        _selector_from_dict((s.get("k8sServiceSelector") or {}).get("selector") or {})
+                        if s.get("k8sServiceSelector")
+                        else None
+                    ),
                 )
                 for s in r.get("toServices") or ()
             ),
@@ -175,9 +185,26 @@ def rule_from_dict(d: Dict[str, Any]) -> Rule:
         endpoint_selector=_selector_from_dict(d.get("endpointSelector") or {}),
         ingress=ingress,
         egress=egress,
-        labels=parse_label_array(d.get("labels") or []),
+        labels=parse_label_array(_label_strings(d.get("labels") or [])),
         description=d.get("description", ""),
     )
+
+
+def _label_strings(entries: Iterable[Any]) -> List[str]:
+    """Labels appear either as strings ("k8s:name=web") or as decoded
+    Label objects ({"key": ..., "value": ..., "source": ...} — the
+    reference's labels.Label JSON shape)."""
+    out: List[str] = []
+    for e in entries:
+        if isinstance(e, str):
+            out.append(e)
+        else:
+            src = e.get("source") or "unspec"
+            kv = e.get("key", "")
+            if e.get("value"):
+                kv = f"{kv}={e['value']}"
+            out.append(f"{src}:{kv}" if src != "unspec" else kv)
+    return out
 
 
 def rule_to_dict(r: Rule) -> Dict[str, Any]:
@@ -194,7 +221,11 @@ def rule_to_dict(r: Rule) -> Dict[str, Any]:
                 rd["fromCIDR"] = list(ing.from_cidr)
             if ing.from_cidr_set:
                 rd["fromCIDRSet"] = [
-                    {"cidr": c.cidr, **({"except": list(c.except_cidrs)} if c.except_cidrs else {})}
+                    {
+                        "cidr": c.cidr,
+                        **({"except": list(c.except_cidrs)} if c.except_cidrs else {}),
+                        **({"generated": True} if c.generated else {}),
+                    }
                     for c in ing.from_cidr_set
                 ]
             if ing.from_entities:
@@ -214,7 +245,11 @@ def rule_to_dict(r: Rule) -> Dict[str, Any]:
                 rd["toCIDR"] = list(eg.to_cidr)
             if eg.to_cidr_set:
                 rd["toCIDRSet"] = [
-                    {"cidr": c.cidr, **({"except": list(c.except_cidrs)} if c.except_cidrs else {})}
+                    {
+                        "cidr": c.cidr,
+                        **({"except": list(c.except_cidrs)} if c.except_cidrs else {}),
+                        **({"generated": True} if c.generated else {}),
+                    }
                     for c in eg.to_cidr_set
                 ]
             if eg.to_entities:
@@ -223,7 +258,16 @@ def rule_to_dict(r: Rule) -> Dict[str, Any]:
                 rd["toPorts"] = _ports_to_dict(eg.to_ports)
             if eg.to_services:
                 rd["toServices"] = [
-                    {"k8sService": {"serviceName": s.name, "namespace": s.namespace}}
+                    (
+                        {
+                            "k8sServiceSelector": {
+                                "selector": _selector_to_dict(s.selector),
+                                **({"namespace": s.namespace} if s.namespace else {}),
+                            }
+                        }
+                        if s.selector is not None
+                        else {"k8sService": {"serviceName": s.name, "namespace": s.namespace}}
+                    )
                     for s in eg.to_services
                 ]
             if eg.to_fqdns:

@@ -156,6 +156,26 @@ class Repository:
                 self._log_op("delete", (labels,))
             return self._revision, deleted
 
+    def translate_rules(self, translator) -> Tuple[int, int]:
+        """Run a rule translator (e.g. k8s ToServices→ToCIDR,
+        pkg/policy.Translator / repository.go TranslateRules) over every
+        rule. The translator's ``translate(rule) -> Rule`` must be pure;
+        changed rules are swapped in place. Returns (revision,
+        n_changed). Logged as a non-append op so incremental compilers
+        fall back to a full rebuild."""
+        with self._lock:
+            changed = 0
+            for i, r in enumerate(self.rules):
+                nr = translator.translate(r)
+                if nr is not r and nr != r:
+                    nr.sanitize()
+                    self.rules[i] = nr
+                    changed += 1
+            if changed:
+                self._bump()
+                self._log_op("translate", (changed,))
+            return self._revision, changed
+
     def get_rules_matching(self, labels: LabelArray) -> Tuple[List[Rule], bool]:
         """(rules selecting `labels`, any-match) — used for the
         enforcement pre-check (daemon/policy.go:85-93)."""
