@@ -91,6 +91,17 @@ class APIClient:
     def identity_get(self, num: int):
         return self._request("GET", f"/identity/{num}")
 
+    def service_list(self):
+        return self._request("GET", "/service")
+
+    def service_put(self, frontend: dict, backends: list):
+        return self._request(
+            "PUT", "/service", {"frontend": frontend, "backends": backends}
+        )
+
+    def service_delete(self, frontend: dict):
+        return self._request("DELETE", "/service", {"frontend": frontend})
+
     def prefilter_get(self):
         return self._request("GET", "/prefilter")
 

@@ -138,6 +138,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "identity not found"})
             else:
                 self._json(200, ident)
+        elif path == "/service" and method == "GET":
+            self._json(200, d.service_list())
+        elif path == "/service" and method == "PUT":
+            body = self._body()
+            self._json(201, d.service_upsert(
+                body["frontend"], body.get("backends", [])
+            ))
+        elif path == "/service" and method == "DELETE":
+            body = self._body()
+            ok = d.service_delete(body["frontend"])
+            self._json(200 if ok else 404, {"deleted": ok})
         elif path == "/prefilter" and method == "GET":
             rev, cidrs = d.prefilter.dump()
             self._json(200, {"revision": rev, "cidrs": cidrs})

@@ -91,6 +91,15 @@ class _Surface:
             raise SystemExit(f"identity {num} not found")
         return out
 
+    def _d_service_list(self):
+        return self._daemon.service_list()
+
+    def _d_service_put(self, frontend, backends):
+        return self._daemon.service_upsert(frontend, backends)
+
+    def _d_service_delete(self, frontend):
+        return {"deleted": self._daemon.service_delete(frontend)}
+
     def _d_prefilter_get(self):
         rev, cidrs = self._daemon.prefilter.dump()
         return {"revision": rev, "cidrs": cidrs}
@@ -102,6 +111,26 @@ class _Surface:
             cidrs,
         )
         return {"revision": rev}
+
+
+def _parse_frontend(text: str) -> dict:
+    """'10.96.0.10:80/TCP' → frontend dict (cilium service update
+    --frontend format, cilium/cmd/service_update.go)."""
+    proto = "TCP"
+    if "/" in text:
+        text, proto = text.rsplit("/", 1)
+    ip, port = text.rsplit(":", 1)
+    return {"ip": ip.strip("[]"), "port": int(port), "protocol": proto.upper()}
+
+
+def _parse_backend(text: str) -> dict:
+    """'10.0.0.3:8080[@weight]' → backend dict."""
+    weight = 1
+    if "@" in text:
+        text, w = text.rsplit("@", 1)
+        weight = int(w)
+    ip, port = text.rsplit(":", 1)
+    return {"ip": ip.strip("[]"), "port": int(port), "weight": weight}
 
 
 def _print(obj) -> None:
@@ -182,6 +211,18 @@ def build_parser() -> argparse.ArgumentParser:
     bpg.add_argument("--egress", action="store_true")
 
     # prefilter
+    svc = sub.add_parser("service", help="LB service operations").add_subparsers(
+        dest="sub", required=True
+    )
+    svc.add_parser("list", help="list services")
+    svu = svc.add_parser("update", help="create/update a service")
+    svu.add_argument("--frontend", required=True,
+                     help="VIP as ip:port[/proto], e.g. 10.96.0.10:80/TCP")
+    svu.add_argument("--backends", nargs="*", default=[],
+                     help="backends as ip:port[@weight]")
+    svd = svc.add_parser("delete", help="delete a service")
+    svd.add_argument("--frontend", required=True)
+
     pf = sub.add_parser("prefilter", help="XDP deny-list").add_subparsers(
         dest="sub", required=True
     )
@@ -255,6 +296,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             _print(s.identity_get(args.id))
     elif args.cmd == "bpf":
         _print(s.policymap_get(args.endpoint, egress=args.egress))
+    elif args.cmd == "service":
+        if args.sub == "list":
+            _print(s.service_list())
+        elif args.sub == "update":
+            _print(s.service_put(
+                _parse_frontend(args.frontend),
+                [_parse_backend(b) for b in args.backends],
+            ))
+        elif args.sub == "delete":
+            _print(s.service_delete(_parse_frontend(args.frontend)))
     elif args.cmd == "prefilter":
         if args.sub == "get":
             _print(s.prefilter_get())

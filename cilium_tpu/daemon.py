@@ -29,6 +29,7 @@ from .identity import IdentityRegistry
 from .ipcache.ipcache import IPCache, SOURCE_AGENT
 from .ipcache.prefilter import PreFilter
 from .labels import parse_label_array
+from .lb.service import Backend, L3n4Addr, ServiceManager
 from .ops.materialize import TRAFFIC_EGRESS, TRAFFIC_INGRESS
 from .policy.api.serialization import rule_from_dict, rule_to_dict, rules_from_json
 from .policy.repository import Repository
@@ -62,8 +63,10 @@ class Daemon:
         self.prefilter = PreFilter()
         self.engine = PolicyEngine(self.repo, self.registry)
         self.conntrack = FlowConntrack() if conntrack else None
+        self.services = ServiceManager()
         self.pipeline = DatapathPipeline(
-            self.engine, self.ipcache, self.prefilter, conntrack=self.conntrack
+            self.engine, self.ipcache, self.prefilter,
+            conntrack=self.conntrack, lb=self.services,
         )
         self.endpoint_manager = EndpointManager()
         self.proxy = Proxy()
@@ -289,6 +292,49 @@ class Daemon:
             return None
         return {"id": ident.id, "labels": list(ident.labels.to_strings())}
 
+    # -- services (daemon/loadbalancer.go PUT/GET/DELETE /service) -------
+    @staticmethod
+    def _frontend(fe: Dict) -> L3n4Addr:
+        return L3n4Addr(fe["ip"], int(fe["port"]),
+                        str(fe.get("protocol", "TCP")).upper())
+
+    @staticmethod
+    def _service_model(svc) -> Dict:
+        return {
+            "id": svc.id,
+            "frontend": {
+                "ip": svc.frontend.ip,
+                "port": svc.frontend.port,
+                "protocol": svc.frontend.protocol,
+            },
+            "backends": [
+                {"ip": b.ip, "port": b.port, "weight": b.weight}
+                for b in svc.backends
+            ],
+        }
+
+    def service_upsert(self, frontend: Dict, backends: Sequence[Dict]) -> Dict:
+        svc = self.services.upsert(
+            self._frontend(frontend),
+            [
+                Backend(b["ip"], int(b["port"]), int(b.get("weight", 1)))
+                for b in backends
+            ],
+        )
+        self._regenerate("service upsert")
+        self.save_state()
+        return self._service_model(svc)
+
+    def service_delete(self, frontend: Dict) -> bool:
+        ok = self.services.delete(self._frontend(frontend))
+        if ok:
+            self._regenerate("service delete")
+            self.save_state()
+        return ok
+
+    def service_list(self) -> List[Dict]:
+        return [self._service_model(s) for s in self.services.list()]
+
     # -- status ---------------------------------------------------------
     def status(self) -> Dict:
         return {
@@ -301,6 +347,7 @@ class Daemon:
                 len(self.conntrack) if self.conntrack is not None else 0
             ),
             "prefilter_revision": self.prefilter.revision,
+            "services": len(self.services.list()),
         }
 
     def metrics_text(self) -> str:
@@ -315,7 +362,15 @@ class Daemon:
         eps = self.endpoint_list()
         tmp = os.path.join(self.state_dir, ".state.tmp")
         with open(tmp, "w") as f:
-            json.dump({"rules": rules, "endpoints": eps}, f, indent=1)
+            json.dump(
+                {
+                    "rules": rules,
+                    "endpoints": eps,
+                    "services": self.service_list(),
+                },
+                f,
+                indent=1,
+            )
         os.replace(tmp, os.path.join(self.state_dir, "state.json"))
 
     def restore_state(self) -> int:
@@ -329,6 +384,15 @@ class Daemon:
         rules = [rule_from_dict(d) for d in snap.get("rules", [])]
         if rules:
             self.repo.add_list(rules)
+        for sm in snap.get("services", []):
+            self.services.restore(
+                self._frontend(sm["frontend"]),
+                [
+                    Backend(b["ip"], int(b["port"]), int(b.get("weight", 1)))
+                    for b in sm.get("backends", [])
+                ],
+                int(sm["id"]),
+            )
         n = 0
         for em in snap.get("endpoints", []):
             try:

@@ -121,6 +121,10 @@ class FlowConntrack:
         self.valid = np.zeros(c, bool)
         self.expires = np.zeros(c, np.float64)
         self.packets = np.zeros(c, np.int64)
+        # revNAT id recorded at creation (ct_entry.rev_nat_index,
+        # bpf/lib/common.h ct_entry) — lets reply traffic restore the
+        # original VIP after backend→client translation.
+        self.revnat = np.zeros(c, np.uint16)
         self.version = 0
 
     # ------------------------------------------------------------------
@@ -181,7 +185,15 @@ class FlowConntrack:
                 np.add.at(self.packets, s, 1)
             return state, slot
 
-    def create_batch(self, ka, kb, kc) -> int:
+    def revnat_of(self, slots: np.ndarray) -> np.ndarray:
+        """[B] uint16 revNAT id per CT slot (0 for misses / no NAT)."""
+        slots = np.asarray(slots)
+        out = np.zeros(slots.shape, np.uint16)
+        live = slots >= 0
+        out[live] = self.revnat[slots[live]]
+        return out
+
+    def create_batch(self, ka, kb, kc, revnat: Optional[np.ndarray] = None) -> int:
         """Insert forward-tuple entries (vectorized claim, P rounds of
         first-writer-wins per slot). Duplicate keys in the batch are
         deduped; full neighborhoods drop the insert (the kernel map
@@ -190,15 +202,17 @@ class FlowConntrack:
         if len(ka) == 0:
             return 0
         now = time.monotonic()
+        if revnat is None:
+            revnat = np.zeros(len(ka), np.uint16)
         with self._lock:
             # dedupe within the batch
             u, uidx = np.unique(
                 np.stack([ka, kb, kc], axis=1), axis=0, return_index=True
             )
-            ka, kb, kc = ka[uidx], kb[uidx], kc[uidx]
+            ka, kb, kc, revnat = ka[uidx], kb[uidx], kc[uidx], revnat[uidx]
             # skip keys already present (established)
             have = self._find(ka, kb, kc, now) >= 0
-            ka, kb, kc = ka[~have], kb[~have], kc[~have]
+            ka, kb, kc, revnat = ka[~have], kb[~have], kc[~have], revnat[~have]
             if len(ka) == 0:
                 return 0
             slots = self._probe_slots(ka, kb, kc)  # [B, P]
@@ -223,6 +237,7 @@ class FlowConntrack:
                 self.valid[s] = True
                 self.expires[s] = now + life[win]
                 self.packets[s] = 1
+                self.revnat[s] = revnat[win].astype(np.uint16)
                 placed[win] = True
                 inserted += len(win)
                 if placed.all():

@@ -2,6 +2,8 @@
 
 Mirrors the per-packet path of the reference, hoisted to batches:
 
+    bpf_lxc.c lb4_local (:444-455)    → device VIP→backend translate
+                                        (lb/device.py, egress only)
     bpf/lib/conntrack.h ct_lookup     → vectorized host CT pre-pass
                                         (established/reply bypass)
     bpf_xdp.c check_filters (:158)    → deny-trie LPM on peer address
@@ -43,11 +45,13 @@ from ..ops.materialize import (
     materialize_endpoints_state,
     patch_identity_rows,
 )
+from ..lb.device import flow_hash32, lb_translate
 from .conntrack import CT_NEW, FlowConntrack, pack_keys
 
 FORWARD = 1
 DROP_POLICY = 2
 DROP_PREFILTER = 3
+DROP_NO_SERVICE = 4  # frontend matched but zero backends (lb4_local)
 
 
 @chex.dataclass(frozen=True)
@@ -136,11 +140,15 @@ class DatapathPipeline:
         ipcache: IPCache,
         prefilter: Optional[PreFilter] = None,
         conntrack: Optional[FlowConntrack] = None,
+        lb=None,  # Optional[lb.service.ServiceManager]
     ) -> None:
         self.engine = engine
         self.ipcache = ipcache
         self.prefilter = prefilter or PreFilter()
         self.conntrack = conntrack
+        self.lb = lb
+        self._lb_tables: Dict[int, object] = {}
+        self._lb_version = -1
         self._lock = threading.Lock()
         self._endpoints: List[int] = []  # identity ids of local endpoints
         self._endpoint_ids: List[int] = []  # endpoint ids (same order)
@@ -268,6 +276,18 @@ class DatapathPipeline:
             ):
                 self.conntrack.flush()
 
+            # LB tables: deterministic per-flow backend selection means
+            # backend churn changes the translated CT key (natural
+            # miss), but entries created while a flow was NOT
+            # translated (pre-service, or post-delete) would bypass the
+            # new service table — so any LB move also flushes CT.
+            if self.lb is not None and self.lb.version != self._lb_version:
+                lb_ver = self.lb.version
+                self._lb_tables = self.lb.build_device()
+                self._lb_version = lb_ver
+                if self.conntrack is not None:
+                    self.conntrack.flush()
+
             assert self._tries is not None and self._mat
             v4, v6, world = self._tries
             # Build complete, then assign once: _dispatch reads
@@ -365,12 +385,39 @@ class DatapathPipeline:
         ingress: bool,
         family: int,
         peer_words: Optional[Tuple[np.ndarray, np.ndarray]] = None,
-    ) -> Tuple[np.ndarray, np.ndarray]:
+        want_rev_nat: bool = False,
+    ):
         self.rebuild()
         ep_idx = np.asarray(ep_idx, np.int32)
         dports = np.asarray(dports, np.int32)
         protos = np.asarray(protos, np.int32)
         b = peer_bytes.shape[0]
+
+        # --- LB stage (egress only): VIP→backend translate -------------
+        # bpf_lxc.c:444-455 — the service lookup precedes conntrack and
+        # the policy check, so CT tracks the backend tuple and policy
+        # sees the backend's identity, exactly like the kernel path.
+        svc_drop: Optional[np.ndarray] = None
+        revnat_vals: Optional[np.ndarray] = None
+        if not ingress and self.lb is not None:
+            lbt = self._lb_tables.get(family)
+            if lbt is not None:
+                fh = flow_hash32(peer_bytes, sports, dports, protos, ep_idx)
+                nb, npo, rv, ok, nobk = lb_translate(
+                    lbt,
+                    jnp.asarray(peer_bytes),
+                    jnp.asarray(dports),
+                    jnp.asarray(protos),
+                    jnp.asarray(fh),
+                )
+                ok = np.asarray(ok)
+                nobk = np.asarray(nobk)
+                if ok.any() or nobk.any():
+                    peer_bytes = np.asarray(nb)
+                    dports = np.asarray(npo, np.int32)
+                    revnat_vals = np.asarray(rv).astype(np.uint16)
+                    svc_drop = nobk
+                    peer_words = None  # address changed — repack for CT
 
         ct = self.conntrack
         if ct is None or sports is None:
@@ -378,9 +425,26 @@ class DatapathPipeline:
             v, red, counters = self._dispatch(
                 peer_bytes, ep_idx, dports, protos, ingress=ingress, family=family
             )
-            with self._lock:
-                if self.counters.shape == counters.shape:
-                    self.counters += counters
+            if svc_drop is not None and svc_drop.any():
+                v = v.copy()
+                red = red.copy()
+                v[svc_drop] = DROP_NO_SERVICE
+                red[svc_drop] = False
+                # device counters classified these flows pre-override —
+                # accumulate host-side instead for this batch
+                with self._lock:
+                    if self.counters.shape[0] == max(1, len(self._endpoints)):
+                        cls = np.select(
+                            [v == FORWARD, v == DROP_POLICY], [0, 1], default=2
+                        )
+                        np.add.at(self.counters, (ep_idx, cls), 1)
+            else:
+                with self._lock:
+                    if self.counters.shape == counters.shape:
+                        self.counters += counters
+            if want_rev_nat:
+                # no CT → replies can't be recognized → no NAT restore
+                return v, red, np.zeros(b, np.uint16)
             return v, red
 
         # --- conntrack pre-pass (vectorized host) ----------------------
@@ -405,7 +469,7 @@ class DatapathPipeline:
             peer_hi, peer_lo, ep_idx.astype(np.uint64), sports,
             dports.astype(np.uint64), protos.astype(np.uint64), direction,
         )
-        state, _slot = ct.lookup_batch(ka, kb, kc)
+        state, slot = ct.lookup_batch(ka, kb, kc)
         miss = state == CT_NEW
 
         verdict = np.full(b, FORWARD, np.int8)
@@ -421,6 +485,10 @@ class DatapathPipeline:
                 family=family,
                 pad_to=_bucket(len(midx)),
             )
+            if svc_drop is not None:
+                sd = svc_drop[midx]
+                v = np.where(sd, np.int8(DROP_NO_SERVICE), v)
+                red = red & ~sd
             verdict[midx] = v
             redirect[midx] = red
             # CT entries for newly-allowed flows (ct_create4,
@@ -432,7 +500,12 @@ class DatapathPipeline:
             ok = (v == FORWARD) & ~red
             if ok.any():
                 oidx = midx[ok]
-                ct.create_batch(ka[oidx], kb[oidx], kc[oidx])
+                ct.create_batch(
+                    ka[oidx],
+                    kb[oidx],
+                    kc[oidx],
+                    revnat=None if revnat_vals is None else revnat_vals[oidx],
+                )
 
         # host counter accumulation (CT hits included)
         with self._lock:
@@ -443,6 +516,17 @@ class DatapathPipeline:
                     default=2,
                 )
                 np.add.at(self.counters, (ep_idx, cls), 1)
+        if want_rev_nat:
+            # revNAT restore (bpf/lib/lb.h lb4_rev_nat via the CT
+            # entry's rev_nat_index): flows whose CT hit is in the
+            # REPLY direction carry the id of the service that
+            # translated the original request — the caller rewrites
+            # the reply source back to that VIP (rev_nat_frontend()).
+            from .conntrack import CT_REPLY
+
+            rev = ct.revnat_of(slot)
+            rev[state != CT_REPLY] = 0
+            return verdict, redirect, rev
         return verdict, redirect
 
     # ------------------------------------------------------------------
@@ -455,12 +539,16 @@ class DatapathPipeline:
         *,
         ingress: bool = True,
         sports: Optional[np.ndarray] = None,
-    ) -> Tuple[np.ndarray, np.ndarray]:
+        return_rev_nat: bool = False,
+    ):
         """IPv4 batch → (verdicts [B] int8, redirect [B] bool);
         accumulates the per-endpoint counters. ``src_ips`` is the peer
         address (source for ingress, destination for egress). Passing
         ``sports`` with a conntrack-enabled pipeline activates the CT
-        pre-pass (established/reply bypass + creation on allow)."""
+        pre-pass (established/reply bypass + creation on allow).
+        ``return_rev_nat`` appends a [B] uint16 array of revNAT ids for
+        reply-direction CT hits (0 otherwise) — resolve with
+        rev_nat_frontend() to restore the VIP on reply sources."""
         src = np.asarray(src_ips)
         peer_bytes = ipv4_to_bytes(src)
         return self._process(
@@ -470,6 +558,7 @@ class DatapathPipeline:
                 np.zeros(src.shape[0], np.uint64),
                 src.astype(np.uint64),
             ),
+            want_rev_nat=return_rev_nat,
         )
 
     def process_v6(
@@ -481,9 +570,17 @@ class DatapathPipeline:
         *,
         ingress: bool = True,
         sports: Optional[np.ndarray] = None,
-    ) -> Tuple[np.ndarray, np.ndarray]:
+        return_rev_nat: bool = False,
+    ):
         """IPv6 batch (16-level LPM walk, bpf_lxc.c:848 tail_ipv6_*)."""
         return self._process(
             np.asarray(peer_bytes, np.int32), ep_idx, dports, protos, sports,
-            ingress=ingress, family=6,
+            ingress=ingress, family=6, want_rev_nat=return_rev_nat,
         )
+
+    def rev_nat_frontend(self, revnat_id: int):
+        """revNAT id (from a return_rev_nat=True process call) → the
+        original frontend L3n4Addr, or None."""
+        if self.lb is None or not revnat_id:
+            return None
+        return self.lb.rev_nat(int(revnat_id))

@@ -1,0 +1,21 @@
+"""Load balancing: service tables, weighted backend selection, revNAT.
+
+The TPU-native stand-in for pkg/loadbalancer + pkg/maps/lbmap +
+bpf/lib/lb.h — VIP→backend translation runs as a device tensor stage
+ahead of the egress policy check.
+"""
+
+from .device import LBTables, MAX_SEQ, flow_hash32, lb_translate
+from .service import Backend, L3n4Addr, LBService, ServiceManager, build_selection_seq
+
+__all__ = [
+    "Backend",
+    "L3n4Addr",
+    "LBService",
+    "LBTables",
+    "MAX_SEQ",
+    "ServiceManager",
+    "build_selection_seq",
+    "flow_hash32",
+    "lb_translate",
+]

@@ -1,0 +1,309 @@
+"""Host service manager: frontends, weighted backends, revNAT records.
+
+Reference: pkg/loadbalancer (L3n4Addr/LBSVC types), pkg/maps/lbmap
+(service + backend + RR-sequence programming, lbmap.go:274,351), and
+pkg/service (kvstore-backed global service ID allocation,
+service.go). The manager owns the authoritative service table and
+emits immutable device snapshots (lb/device.py LBTables) for the
+pipeline's egress pre-policy stage — the lb4_lookup_service /
+lb4_local position of bpf/bpf_lxc.c:444-455.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import ipaddress
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import u8proto
+from .device import LBTables, MAX_SEQ
+
+SERVICES_ID_PATH = "cilium/state/services/v1/id"
+SERVICES_VALUE_PATH = "cilium/state/services/v1/value"
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class L3n4Addr:
+    """Frontend / backend address (pkg/loadbalancer L3n4Addr)."""
+
+    ip: str
+    port: int
+    protocol: str = "TCP"  # TCP | UDP | ANY
+
+    @property
+    def family(self) -> int:
+        return 6 if ipaddress.ip_address(self.ip).version == 6 else 4
+
+    @property
+    def proto_num(self) -> int:
+        return 0 if self.protocol.upper() in ("ANY", "NONE") else u8proto.from_name(
+            self.protocol
+        )
+
+    def __str__(self) -> str:
+        return f"{self.ip}:{self.port}/{self.protocol}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Backend:
+    """One backend with an RR weight (lbmap.go LBBackEnd)."""
+
+    ip: str
+    port: int
+    weight: int = 1
+
+
+@dataclasses.dataclass
+class LBService:
+    """A programmed service (pkg/loadbalancer LBSVC)."""
+
+    id: int  # global service / revNAT id
+    frontend: L3n4Addr
+    backends: Tuple[Backend, ...]
+
+
+def _addr_bytes(ip: str, length: int) -> List[int]:
+    return list(ipaddress.ip_address(ip).packed.rjust(length, b"\x00"))[-length:]
+
+
+def build_selection_seq(backends: Sequence[Backend]) -> List[int]:
+    """Backend indices repeated by weight — the weighted-RR sequence of
+    lbmap.go:351 (generateWrrSeq). Capped at MAX_SEQ slots: when
+    weights overflow the cap they are rescaled with every backend
+    guaranteed ≥ 1 slot; when the backend COUNT itself exceeds MAX_SEQ
+    only the first MAX_SEQ backends receive slots (deterministic
+    truncation — the reference's slave-slot maps have the same kind of
+    hard capacity, bpf/lib/lb.h LB_MAX)."""
+    if not backends:
+        return []
+    backends = list(backends)[:MAX_SEQ]
+    weights = [max(0, b.weight) for b in backends]
+    total = sum(weights)
+    if total == 0:  # all-zero weights degrade to equal shares
+        weights = [1] * len(backends)
+        total = len(backends)
+    if total <= MAX_SEQ:
+        reps = weights
+    else:
+        # everyone gets 1 slot; the remaining slots go by largest
+        # weight remainder so the scaled shares stay proportional
+        n = len(backends)
+        reps = [1] * n
+        spare = MAX_SEQ - n
+        shares = [w * spare / total for w in weights]
+        reps = [r + int(s) for r, s in zip(reps, shares)]
+        spare -= sum(int(s) for s in shares)
+        order = sorted(range(n), key=lambda i: shares[i] - int(shares[i]),
+                       reverse=True)
+        for i in order[:spare]:
+            reps[i] += 1
+    seq: List[int] = []
+    # interleave round-robin style so short prefixes are still mixed
+    counts = list(reps)
+    while any(c > 0 for c in counts):
+        for i, c in enumerate(counts):
+            if c > 0:
+                seq.append(i)
+                counts[i] -= 1
+    return seq[:MAX_SEQ]
+
+
+class ServiceManager:
+    """Thread-safe service table with device snapshot builds.
+
+    Service IDs double as revNAT ids (the reference allocates one
+    ID per frontend, pkg/service/service.go). With a kvstore backend
+    the allocation is a cluster-global CAS (create_only on the
+    frontend's value key); standalone it is a local counter.
+    """
+
+    def __init__(self, kvstore=None) -> None:
+        self._lock = threading.RLock()
+        self._services: Dict[L3n4Addr, LBService] = {}
+        self._next_id = 1
+        self._kv = kvstore
+        self.version = 0
+        self._synced_frontends: set = set()  # frontends owned by k8s sync
+
+    # -- id allocation --------------------------------------------------
+    def _allocate_id(self, frontend: L3n4Addr) -> int:
+        if self._kv is None:
+            sid = self._next_id
+            self._next_id += 1
+            return sid
+        key = f"{SERVICES_VALUE_PATH}/{frontend}"
+        existing = self._kv.get(key)
+        if existing is not None:
+            return int(existing.decode())
+        while True:
+            candidate = self._next_id
+            self._next_id += 1
+            if self._kv.create_only(
+                f"{SERVICES_ID_PATH}/{candidate}", str(frontend).encode()
+            ):
+                self._kv.set(key, str(candidate).encode())
+                return candidate
+
+    # -- mutation -------------------------------------------------------
+    @staticmethod
+    def _validate(frontend: L3n4Addr, backends: Sequence[Backend]) -> None:
+        """Reject malformed addresses BEFORE mutating the table: a bad
+        entry would otherwise poison every later build_device() (and,
+        via the daemon's state snapshot, survive restarts)."""
+        ipaddress.ip_address(frontend.ip)  # raises ValueError if bad
+        frontend.proto_num  # raises on unknown protocol names
+        if not 0 < frontend.port < 65536:
+            raise ValueError(f"frontend port out of range: {frontend.port}")
+        for b in backends:
+            ipaddress.ip_address(b.ip)
+            if not 0 < b.port < 65536:
+                raise ValueError(f"backend port out of range: {b.port}")
+
+    def upsert(
+        self, frontend: L3n4Addr, backends: Sequence[Backend]
+    ) -> LBService:
+        self._validate(frontend, backends)
+        with self._lock:
+            existing = self._services.get(frontend)
+            sid = existing.id if existing else self._allocate_id(frontend)
+            svc = LBService(id=sid, frontend=frontend, backends=tuple(backends))
+            self._services[frontend] = svc
+            self.version += 1
+            return svc
+
+    def restore(
+        self, frontend: L3n4Addr, backends: Sequence[Backend], sid: int
+    ) -> LBService:
+        """Re-install a service keeping its persisted id (daemon
+        restart must not renumber services: revNAT ids are API-visible
+        and recorded in snapshots)."""
+        self._validate(frontend, backends)
+        with self._lock:
+            svc = LBService(id=sid, frontend=frontend, backends=tuple(backends))
+            self._services[frontend] = svc
+            self._next_id = max(self._next_id, sid + 1)
+            self.version += 1
+            return svc
+
+    def delete(self, frontend: L3n4Addr) -> bool:
+        with self._lock:
+            if self._services.pop(frontend, None) is None:
+                return False
+            self.version += 1
+            return True
+
+    # -- queries --------------------------------------------------------
+    def get(self, frontend: L3n4Addr) -> Optional[LBService]:
+        with self._lock:
+            return self._services.get(frontend)
+
+    def list(self) -> List[LBService]:
+        with self._lock:
+            return sorted(self._services.values(), key=lambda s: s.id)
+
+    def rev_nat(self, revnat_id: int) -> Optional[L3n4Addr]:
+        """revNAT id → original frontend (the cilium_lb4_reverse_nat
+        role): rewrites reply source back to the VIP."""
+        with self._lock:
+            for svc in self._services.values():
+                if svc.id == revnat_id:
+                    return svc.frontend
+        return None
+
+    # -- k8s bridge -----------------------------------------------------
+    def sync_from_registry(self, registry) -> int:
+        """Full resync from a k8s ServiceRegistry: every ClusterIP
+        service port becomes a frontend; backends come from the
+        Endpoints object's matching port name (daemon/k8s_watcher.go
+        addK8sSVCs). Frontends previously created by sync but gone from
+        the registry are deleted. Returns the live frontend count."""
+        desired: Dict[L3n4Addr, List[Backend]] = {}
+        with registry._lock:
+            services = dict(registry.services)
+            endpoints = dict(registry.endpoints)
+        for sid, info in services.items():
+            if not info.cluster_ip or info.is_headless:
+                continue
+            ep = endpoints.get(sid)
+            for pname, sp in info.ports.items():
+                fe = L3n4Addr(info.cluster_ip, sp.port, sp.protocol)
+                backs: List[Backend] = []
+                if ep is not None:
+                    tgt = ep.ports.get(pname) or ep.ports.get(str(sp.port))
+                    if tgt is not None:
+                        backs = [Backend(ip, tgt.port) for ip in ep.backend_ips]
+                desired[fe] = backs
+        with self._lock:
+            for fe in self._synced_frontends - set(desired):
+                self.delete(fe)
+            synced = set()
+            for fe, backs in desired.items():
+                try:
+                    cur = self._services.get(fe)
+                    if cur is None or cur.backends != tuple(backs):
+                        self.upsert(fe, backs)
+                    synced.add(fe)
+                except ValueError:
+                    # malformed registry data (bad IP/port) — skip the
+                    # one service rather than abort the sync
+                    continue
+            self._synced_frontends = synced
+        return len(synced)
+
+    # -- device snapshot ------------------------------------------------
+    def build_device(self) -> Dict[int, Optional[LBTables]]:
+        """→ {4: LBTables|None, 6: LBTables|None} (None = no frontends
+        of that family; the pipeline skips the stage entirely)."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            services = sorted(self._services.values(), key=lambda s: s.id)
+        out: Dict[int, Optional[LBTables]] = {4: None, 6: None}
+        for family, length in ((4, 4), (6, 16)):
+            fam = [s for s in services if s.frontend.family == family]
+            if not fam:
+                continue
+            nf = max(1, len(fam))
+            fe_bytes = np.zeros((nf, length), np.int32)
+            fe_port = np.full(nf, -1, np.int32)
+            fe_proto = np.zeros(nf, np.int32)
+            fe_seq = np.zeros((nf, MAX_SEQ), np.int32)
+            fe_seq_len = np.zeros(nf, np.int32)
+            fe_revnat = np.zeros(nf, np.int32)
+            be_rows: List[Tuple[List[int], int]] = []
+            for i, svc in enumerate(fam):
+                fe_bytes[i] = _addr_bytes(svc.frontend.ip, length)
+                fe_port[i] = svc.frontend.port
+                fe_proto[i] = svc.frontend.proto_num
+                fe_revnat[i] = svc.id
+                base = len(be_rows)
+                live = [
+                    b for b in svc.backends
+                    if ipaddress.ip_address(b.ip).version == (6 if family == 6 else 4)
+                ]
+                for b in live:
+                    be_rows.append((_addr_bytes(b.ip, length), b.port))
+                seq = build_selection_seq(live)
+                fe_seq_len[i] = len(seq)
+                for j, rel in enumerate(seq):
+                    fe_seq[i, j] = base + rel
+            nb = max(1, len(be_rows))
+            be_bytes = np.zeros((nb, length), np.int32)
+            be_port = np.zeros(nb, np.int32)
+            for r, (byts, port) in enumerate(be_rows):
+                be_bytes[r] = byts
+                be_port[r] = port
+            out[family] = LBTables(
+                fe_bytes=jnp.asarray(fe_bytes),
+                fe_port=jnp.asarray(fe_port),
+                fe_proto=jnp.asarray(fe_proto),
+                fe_seq=jnp.asarray(fe_seq),
+                fe_seq_len=jnp.asarray(fe_seq_len),
+                fe_revnat=jnp.asarray(fe_revnat),
+                be_bytes=jnp.asarray(be_bytes),
+                be_port=jnp.asarray(be_port),
+            )
+        return out

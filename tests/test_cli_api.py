@@ -146,6 +146,16 @@ class TestRESTAPI:
         with pytest.raises(APIError):
             server.endpoint_put(7, ["k8s:app=web"])  # duplicate
 
+    def test_services_rest(self, server):
+        fe = {"ip": "10.96.0.10", "port": 80, "protocol": "TCP"}
+        out = server.service_put(
+            fe, [{"ip": "10.0.0.3", "port": 8080, "weight": 2}]
+        )
+        assert out["id"] >= 1 and out["backends"][0]["weight"] == 2
+        assert len(server.service_list()) == 1
+        assert server.service_delete(fe)["deleted"]
+        assert server.service_list() == []
+
     def test_status_metrics_prefilter(self, server):
         assert server.status()["endpoints"] == 0
         assert "cilium_tpu_" in server.metrics()

@@ -1,0 +1,300 @@
+"""Service/LB stage: VIP→backend selection, revNAT, pipeline wiring.
+
+Reference analogs: bpf/lib/lb.h:36-83 (service/backend/rr-seq maps),
+bpf_lxc.c:444-455 (lb4_local precedes conntrack and the egress policy
+check), pkg/maps/lbmap/lbmap.go:274,351 (weighted-RR sequence),
+pkg/service (global service IDs).
+"""
+
+from __future__ import annotations
+
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cilium_tpu.datapath.conntrack import FlowConntrack
+from cilium_tpu.datapath.pipeline import (
+    DROP_NO_SERVICE,
+    DROP_POLICY,
+    FORWARD,
+    DatapathPipeline,
+)
+from cilium_tpu.engine import PolicyEngine
+from cilium_tpu.identity import IdentityRegistry
+from cilium_tpu.ipcache.ipcache import IPCache
+from cilium_tpu.ipcache.prefilter import PreFilter
+from cilium_tpu.kvstore import InMemoryBackend, InMemoryStore
+from cilium_tpu.labels import parse_label_array
+from cilium_tpu.lb import (
+    Backend,
+    L3n4Addr,
+    ServiceManager,
+    build_selection_seq,
+    flow_hash32,
+    lb_translate,
+)
+from cilium_tpu.ops.lpm import ip_strings_to_u32
+from cilium_tpu.policy.api import (
+    EgressRule,
+    EndpointSelector,
+    PortProtocol,
+    PortRule,
+    rule,
+)
+from cilium_tpu.policy.repository import Repository
+
+
+def test_selection_seq_weights():
+    seq = build_selection_seq([Backend("1.1.1.1", 80, weight=1),
+                               Backend("2.2.2.2", 80, weight=3)])
+    counts = collections.Counter(seq)
+    assert counts[1] == 3 * counts[0]
+    # cap: huge weights still fit MAX_SEQ with every backend present
+    seq = build_selection_seq(
+        [Backend(f"10.0.0.{i}", 80, weight=1000 * (i + 1)) for i in range(5)]
+    )
+    assert len(seq) <= 64 and set(seq) == set(range(5))
+
+
+def test_selection_seq_zero_weights():
+    seq = build_selection_seq([Backend("1.1.1.1", 80, weight=0),
+                               Backend("2.2.2.2", 80, weight=0)])
+    assert sorted(set(seq)) == [0, 1]  # degrade to equal shares
+
+
+def _manager():
+    m = ServiceManager()
+    m.upsert(
+        L3n4Addr("10.96.0.10", 80, "TCP"),
+        [Backend("10.0.0.3", 8080), Backend("10.0.0.4", 8080)],
+    )
+    m.upsert(L3n4Addr("10.96.0.99", 53, "UDP"), [])  # no backends
+    return m
+
+
+def test_lb_translate_device():
+    m = _manager()
+    t = m.build_device()[4]
+    peer = np.array(
+        [[10, 96, 0, 10], [10, 96, 0, 10], [10, 96, 0, 99], [8, 8, 8, 8]],
+        np.int32,
+    )
+    dport = np.array([80, 81, 53, 80], np.int32)
+    proto = np.array([6, 6, 17, 6], np.int32)
+    fh = np.array([0, 0, 0, 0], np.int32)
+    nb, npo, rv, ok, nobk = lb_translate(
+        t, jnp.asarray(peer), jnp.asarray(dport), jnp.asarray(proto),
+        jnp.asarray(fh),
+    )
+    nb, npo, rv = np.asarray(nb), np.asarray(npo), np.asarray(rv)
+    ok, nobk = np.asarray(ok), np.asarray(nobk)
+    assert ok.tolist() == [True, False, False, False]
+    assert nobk.tolist() == [False, False, True, False]
+    assert nb[0].tolist() == [10, 0, 0, 3] and npo[0] == 8080
+    assert rv[0] > 0 and rv[2] > 0  # revNAT ids recorded on any fe hit
+    assert nb[1].tolist() == [10, 96, 0, 10] and npo[1] == 81  # port miss
+    assert nb[3].tolist() == [8, 8, 8, 8]  # address miss: passthrough
+
+
+def test_backend_distribution_weighted():
+    m = ServiceManager()
+    m.upsert(
+        L3n4Addr("10.96.0.10", 80, "TCP"),
+        [Backend("10.0.0.3", 80, weight=1), Backend("10.0.0.4", 80, weight=3)],
+    )
+    t = m.build_device()[4]
+    n = 4000
+    peer = np.tile(np.array([[10, 96, 0, 10]], np.int32), (n, 1))
+    dport = np.full(n, 80, np.int32)
+    proto = np.full(n, 6, np.int32)
+    sports = np.arange(n) + 1024
+    fh = flow_hash32(peer, sports, dport, proto, np.zeros(n, np.int32))
+    nb, *_ = lb_translate(
+        t, jnp.asarray(peer), jnp.asarray(dport), jnp.asarray(proto),
+        jnp.asarray(fh),
+    )
+    last = np.asarray(nb)[:, 3]
+    frac4 = (last == 4).mean()
+    assert 0.65 < frac4 < 0.85  # weight 3:1 ⇒ ~0.75
+    # determinism: same flows re-hash to the same backends
+    fh2 = flow_hash32(peer, sports, dport, proto, np.zeros(n, np.int32))
+    nb2, *_ = lb_translate(
+        t, jnp.asarray(peer), jnp.asarray(dport), jnp.asarray(proto),
+        jnp.asarray(fh2),
+    )
+    assert np.array_equal(np.asarray(nb), np.asarray(nb2))
+
+
+def _egress_world(with_ct: bool = False, kvstore=None):
+    """web endpoint allowed egress only to db:8080; db sits behind a
+    ClusterIP VIP."""
+    repo = Repository()
+    repo.add_list([
+        rule(
+            ["k8s:app=web"],
+            egress=[
+                EgressRule(
+                    to_endpoints=(EndpointSelector.make(["k8s:app=db"]),),
+                    to_ports=(PortRule(ports=(PortProtocol(8080, "TCP"),)),),
+                )
+            ],
+            labels=["k8s:policy=lb0"],
+        ),
+    ])
+    reg = IdentityRegistry()
+    web = reg.allocate(parse_label_array(["k8s:app=web"]))
+    db = reg.allocate(parse_label_array(["k8s:app=db"]))
+    other = reg.allocate(parse_label_array(["k8s:app=other"]))
+    engine = PolicyEngine(repo, reg)
+    cache = IPCache()
+    cache.upsert("10.0.0.3/32", db.id, source="k8s")
+    cache.upsert("10.0.0.4/32", other.id, source="k8s")
+    lbm = ServiceManager(kvstore=kvstore)
+    lbm.upsert(L3n4Addr("10.96.0.10", 80, "TCP"), [Backend("10.0.0.3", 8080)])
+    ct = FlowConntrack(capacity_bits=16) if with_ct else None
+    pipe = DatapathPipeline(engine, cache, PreFilter(), conntrack=ct, lb=lbm)
+    pipe.set_endpoints([web.id])
+    return pipe, lbm, dict(web=web, db=db, other=other)
+
+
+def test_pipeline_egress_vip_translation():
+    pipe, lbm, ids = _egress_world()
+    # three egress flows from web: VIP:80 (→ db:8080, allowed),
+    # other:8080 (denied — wrong identity), db:8080 direct (allowed)
+    dst = ip_strings_to_u32(["10.96.0.10", "10.0.0.4", "10.0.0.3"])
+    v, red = pipe.process(
+        dst, np.zeros(3, np.int32),
+        np.array([80, 8080, 8080]), np.array([6, 6, 6]),
+        ingress=False,
+    )
+    assert v.tolist() == [FORWARD, DROP_POLICY, FORWARD]
+
+
+def test_pipeline_no_backend_drop():
+    pipe, lbm, ids = _egress_world()
+    lbm.upsert(L3n4Addr("10.96.0.10", 80, "TCP"), [])  # drain backends
+    dst = ip_strings_to_u32(["10.96.0.10"])
+    v, _ = pipe.process(
+        dst, np.zeros(1, np.int32), np.array([80]), np.array([6]),
+        ingress=False,
+    )
+    assert v.tolist() == [DROP_NO_SERVICE]
+
+
+def test_pipeline_ct_revnat_and_bypass():
+    pipe, lbm, ids = _egress_world(with_ct=True)
+    ct = pipe.conntrack
+    dst = ip_strings_to_u32(["10.96.0.10"])
+    args = (dst, np.zeros(1, np.int32), np.array([80]), np.array([6]))
+    v, _ = pipe.process(*args, ingress=False, sports=np.array([3333]))
+    assert v.tolist() == [FORWARD]
+    assert len(ct) == 1
+    # the CT entry carries the service's revNAT id → frontend restore
+    slot = np.nonzero(ct.valid)[0]
+    rev = int(ct.revnat[slot[0]])
+    svc = lbm.get(L3n4Addr("10.96.0.10", 80, "TCP"))
+    assert rev == svc.id
+    assert lbm.rev_nat(rev) == L3n4Addr("10.96.0.10", 80, "TCP")
+    # second packet of the flow: CT hit (no device dispatch needed);
+    # same deterministic backend pick ⇒ same key
+    v2, _ = pipe.process(*args, ingress=False, sports=np.array([3333]))
+    assert v2.tolist() == [FORWARD] and len(ct) == 1
+    # reply from the backend (ingress, flipped ports): forwarded on
+    # the CT REPLY bypass (no ingress allow rule exists!) and carries
+    # the revNAT id → the caller restores the VIP on the reply source
+    # (lb4_rev_nat via ct_entry.rev_nat_index)
+    vr, _, revs = pipe.process(
+        ip_strings_to_u32(["10.0.0.3"]), np.zeros(1, np.int32),
+        np.array([3333]), np.array([6]),
+        ingress=True, sports=np.array([8080]), return_rev_nat=True,
+    )
+    assert vr.tolist() == [FORWARD]
+    assert int(revs[0]) == svc.id
+    assert pipe.rev_nat_frontend(revs[0]) == L3n4Addr("10.96.0.10", 80, "TCP")
+    # backend churn flushes CT so stale bypasses cannot survive
+    lbm.upsert(L3n4Addr("10.96.0.10", 80, "TCP"), [Backend("10.0.0.4", 8080)])
+    pipe.rebuild()
+    assert len(ct) == 0
+    # and the new backend identity (other) is NOT allowed ⇒ deny now
+    v3, _ = pipe.process(*args, ingress=False, sports=np.array([3333]))
+    assert v3.tolist() == [DROP_POLICY]
+
+
+def test_sync_from_registry():
+    from cilium_tpu.k8s.service_registry import ServiceRegistry
+
+    reg = ServiceRegistry()
+    reg.apply_service_object({
+        "metadata": {"namespace": "default", "name": "web"},
+        "spec": {
+            "clusterIP": "10.96.0.20",
+            "selector": {"app": "web"},
+            "ports": [{"name": "http", "port": 80, "protocol": "TCP"}],
+        },
+    })
+    reg.apply_endpoints_object({
+        "metadata": {"namespace": "default", "name": "web"},
+        "subsets": [{
+            "addresses": [{"ip": "10.0.1.1"}, {"ip": "10.0.1.2"}],
+            "ports": [{"name": "http", "port": 8080, "protocol": "TCP"}],
+        }],
+    })
+    m = ServiceManager()
+    assert m.sync_from_registry(reg) == 1
+    svc = m.get(L3n4Addr("10.96.0.20", 80, "TCP"))
+    assert svc is not None
+    assert {b.ip for b in svc.backends} == {"10.0.1.1", "10.0.1.2"}
+    assert all(b.port == 8080 for b in svc.backends)
+    # service deletion removes the synced frontend
+    reg.delete_service(next(iter(reg.endpoints)))
+    reg.services.clear()
+    m.sync_from_registry(reg)
+    assert m.get(L3n4Addr("10.96.0.20", 80, "TCP")) is None
+
+
+def test_upsert_validation():
+    m = ServiceManager()
+    for bad in (
+        (L3n4Addr("foo", 80, "TCP"), []),
+        (L3n4Addr("10.0.0.1", 80, "BOGUS"), []),
+        (L3n4Addr("10.0.0.1", 0, "TCP"), []),
+        (L3n4Addr("10.0.0.1", 80, "TCP"), [Backend("bad", 80)]),
+    ):
+        with pytest.raises(ValueError):
+            m.upsert(*bad)
+    assert m.list() == []  # failed upserts never mutate the table
+
+
+def test_restore_preserves_ids():
+    m = ServiceManager()
+    m.upsert(L3n4Addr("10.96.0.1", 80, "TCP"), [])
+    b = m.upsert(L3n4Addr("10.96.0.2", 80, "TCP"), [])
+    # restart: restore must keep persisted ids, and later allocations
+    # must not collide with them
+    m2 = ServiceManager()
+    m2.restore(L3n4Addr("10.96.0.2", 80, "TCP"), [], b.id)
+    assert m2.get(L3n4Addr("10.96.0.2", 80, "TCP")).id == b.id
+    c = m2.upsert(L3n4Addr("10.96.0.3", 80, "TCP"), [])
+    assert c.id == b.id + 1
+
+
+def test_selection_seq_backend_count_over_cap():
+    seq = build_selection_seq(
+        [Backend(f"10.0.{i // 256}.{i % 256}", 80) for i in range(100)]
+    )
+    # deterministic truncation: first MAX_SEQ backends, one slot each
+    assert len(seq) == 64 and set(seq) == set(range(64))
+
+
+def test_service_ids_global_via_kvstore():
+    store = InMemoryStore()
+    m1 = ServiceManager(kvstore=InMemoryBackend(store, "n1"))
+    m2 = ServiceManager(kvstore=InMemoryBackend(store, "n2"))
+    fe = L3n4Addr("10.96.0.10", 80, "TCP")
+    s1 = m1.upsert(fe, [Backend("10.0.0.3", 80)])
+    s2 = m2.upsert(fe, [Backend("10.0.0.3", 80)])
+    assert s1.id == s2.id  # same frontend ⇒ same cluster-global id
+    s3 = m2.upsert(L3n4Addr("10.96.0.11", 80, "TCP"), [])
+    assert s3.id != s1.id  # distinct frontends never collide
