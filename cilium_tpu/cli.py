@@ -150,6 +150,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="state dir for standalone mode")
     sub = p.add_subparsers(dest="cmd", required=True)
 
+    mon = sub.add_parser("monitor", help="stream datapath/agent events")
+    mon.add_argument("--json", action="store_true", help="print raw events")
+    mon.add_argument("--timeout", type=float, default=None,
+                     help="stop after N idle seconds (default: run forever)")
+
     # daemon
     d = sub.add_parser("daemon", help="run the agent + API server")
     d.add_argument("--no-conntrack", action="store_true")
@@ -239,18 +244,46 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "daemon":
         from .api.server import APIServer
         from .daemon import Daemon
+        from .monitor.server import MonitorServer
 
         daemon = Daemon(
             state_dir=args.state, conntrack=not args.no_conntrack
         )
         server = APIServer(daemon, args.socket)
+        monitor = MonitorServer(daemon.monitor, args.socket + ".monitor")
+        monitor.start()
         print(f"cilium-tpu daemon serving on {args.socket} "
-              f"(state: {args.state})")
+              f"(monitor: {args.socket}.monitor, state: {args.state})")
         try:
             server.serve_forever()
         except KeyboardInterrupt:
+            monitor.stop()
             server.stop()
             daemon.shutdown()
+        return 0
+
+    if args.cmd == "monitor":
+        import dataclasses
+
+        from .monitor.server import monitor_stream
+
+        path = args.socket + ".monitor"
+        if not os.path.exists(path):
+            print(f"no monitor socket at {path} (is the daemon running?)",
+                  file=sys.stderr)
+            return 1
+        print(f"Listening for events on {path}...", file=sys.stderr)
+        try:
+            for ev in monitor_stream(path, timeout=args.timeout):
+                if args.json:
+                    d = dataclasses.asdict(ev)
+                    if isinstance(d.get("peer_addr"), bytes):
+                        d["peer_addr"] = d["peer_addr"].hex()
+                    print(json.dumps(d))
+                else:
+                    print(ev.summary())
+        except KeyboardInterrupt:
+            pass
         return 0
 
     s = _Surface(args.socket, args.state)

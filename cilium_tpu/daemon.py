@@ -30,6 +30,8 @@ from .ipcache.ipcache import IPCache, SOURCE_AGENT
 from .ipcache.prefilter import PreFilter
 from .labels import parse_label_array
 from .lb.service import Backend, L3n4Addr, ServiceManager
+from .monitor.events import AgentNotify, L7Notify
+from .monitor.hub import MonitorHub
 from .ops.materialize import TRAFFIC_EGRESS, TRAFFIC_INGRESS
 from .policy.api.serialization import rule_from_dict, rule_to_dict, rules_from_json
 from .policy.repository import Repository
@@ -64,12 +66,24 @@ class Daemon:
         self.engine = PolicyEngine(self.repo, self.registry)
         self.conntrack = FlowConntrack() if conntrack else None
         self.services = ServiceManager()
+        self.monitor = MonitorHub()
         self.pipeline = DatapathPipeline(
             self.engine, self.ipcache, self.prefilter,
             conntrack=self.conntrack, lb=self.services,
+            monitor=self.monitor,
         )
         self.endpoint_manager = EndpointManager()
         self.proxy = Proxy()
+        # L7 access-log records surface on the monitor stream the way
+        # the reference forwards proxy logs as monitor agent events
+        # (pkg/proxy/logger → monitor).
+        self.proxy.accesslog.subscribe(
+            lambda r: self.monitor.publish(
+                L7Notify(verdict=r.verdict, detail=json.dumps(r.to_dict()))
+            )
+            if self.monitor.active
+            else None
+        )
         self._lock = threading.RLock()
         if state_dir:
             os.makedirs(state_dir, exist_ok=True)
@@ -214,6 +228,7 @@ class Daemon:
             ep.regenerate(self.pipeline, reason="endpoint create",
                           proxy=self.proxy)
         self.save_state()
+        self.notify_agent("endpoint-created", f"endpoint {endpoint_id}")
         return self._endpoint_model(ep)
 
     def endpoint_delete(self, endpoint_id: int) -> bool:
@@ -230,6 +245,7 @@ class Daemon:
                 self.registry.release(ep.identity)
             self._sync_pipeline_endpoints()
         self.save_state()
+        self.notify_agent("endpoint-deleted", f"endpoint {endpoint_id}")
         return True
 
     def endpoint_list(self) -> List[Dict]:
@@ -253,8 +269,14 @@ class Daemon:
             [(ep.id, ep.identity.id) for ep in eps if ep.identity]
         )
 
+    def notify_agent(self, kind: str, message: str) -> None:
+        """AgentNotify on the monitor stream (pkg/monitor/agent.go)."""
+        if self.monitor.active:
+            self.monitor.publish(AgentNotify(kind=kind, message=message))
+
     def _regenerate(self, reason: str) -> None:
         self.endpoint_manager.regenerate_all(self.pipeline, reason)
+        self.notify_agent("regenerate", reason)
 
     # -- map dumps ------------------------------------------------------
     def policymap_dump(self, endpoint_id: int, *, ingress: bool = True) -> List[Dict]:

@@ -141,12 +141,18 @@ class DatapathPipeline:
         prefilter: Optional[PreFilter] = None,
         conntrack: Optional[FlowConntrack] = None,
         lb=None,  # Optional[lb.service.ServiceManager]
+        monitor=None,  # Optional[monitor.hub.MonitorHub]
     ) -> None:
         self.engine = engine
         self.ipcache = ipcache
         self.prefilter = prefilter or PreFilter()
         self.conntrack = conntrack
         self.lb = lb
+        self.monitor = monitor
+        # TraceNotify for forwarded flows is opt-in (the reference
+        # gates trace events behind the TraceNotify endpoint option);
+        # DropNotify is always emitted while a listener is attached.
+        self.trace_enabled = False
         self._lb_tables: Dict[int, object] = {}
         self._lb_version = -1
         self._lock = threading.Lock()
@@ -337,6 +343,90 @@ class DatapathPipeline:
         )
 
     # ------------------------------------------------------------------
+    def _emit_flow_events(
+        self,
+        peer_bytes: np.ndarray,
+        ep_idx: np.ndarray,
+        dports: np.ndarray,
+        protos: np.ndarray,
+        verdict: np.ndarray,
+        *,
+        ingress: bool,
+        family: int,
+        redirect: Optional[np.ndarray] = None,
+    ) -> None:
+        """DropNotify per dropped flow (+ TraceNotify per forwarded
+        flow when trace_enabled). Cold path: runs only while a monitor
+        listener is attached (hub.active), and drops are normally the
+        small tail of a batch. Peer identity is resolved host-side via
+        the ipcache (the event consumer wants labels/identity, the
+        datapath only knows rows)."""
+        hub = self.monitor
+        if hub is None or not hub.active:
+            return
+        from ..monitor.events import (
+            REASON_NO_SERVICE,
+            REASON_POLICY,
+            REASON_PREFILTER,
+            TRACE_TO_ENDPOINT,
+            TRACE_TO_PROXY,
+            DropNotify,
+            TraceNotify,
+        )
+        import ipaddress as _ipa
+
+        reason_of = {
+            DROP_POLICY: REASON_POLICY,
+            DROP_PREFILTER: REASON_PREFILTER,
+            DROP_NO_SERVICE: REASON_NO_SERVICE,
+        }
+        events = []
+
+        def _identity(addr: bytes) -> int:
+            e = self.ipcache.lookup_by_ip(str(_ipa.ip_address(addr)))
+            return 0 if e is None else e.identity
+
+        def _ep(i: int) -> int:
+            idx = int(ep_idx[i])
+            return (
+                self._endpoint_ids[idx]
+                if 0 <= idx < len(self._endpoint_ids)
+                else idx
+            )
+
+        for i in np.nonzero(verdict >= DROP_POLICY)[0]:
+            addr = bytes(int(b) & 0xFF for b in peer_bytes[i])
+            events.append(
+                DropNotify(
+                    reason=reason_of.get(int(verdict[i]), 0),
+                    endpoint=_ep(i),
+                    src_identity=_identity(addr),
+                    family=family,
+                    peer_addr=addr,
+                    dport=int(dports[i]),
+                    proto=int(protos[i]),
+                    ingress=ingress,
+                )
+            )
+        if self.trace_enabled:
+            for i in np.nonzero(verdict == FORWARD)[0]:
+                addr = bytes(int(b) & 0xFF for b in peer_bytes[i])
+                to_proxy = redirect is not None and bool(redirect[i])
+                events.append(
+                    TraceNotify(
+                        obs_point=TRACE_TO_PROXY if to_proxy else TRACE_TO_ENDPOINT,
+                        endpoint=_ep(i),
+                        src_identity=_identity(addr),
+                        family=family,
+                        peer_addr=addr,
+                        dport=int(dports[i]),
+                        proto=int(protos[i]),
+                        ingress=ingress,
+                    )
+                )
+        if events:
+            hub.publish_many(events)
+
     def _dispatch(
         self,
         peer_bytes: np.ndarray,
@@ -402,7 +492,15 @@ class DatapathPipeline:
         if not ingress and self.lb is not None:
             lbt = self._lb_tables.get(family)
             if lbt is not None:
-                fh = flow_hash32(peer_bytes, sports, dports, protos, ep_idx)
+                # hash over STABLE endpoint ids so unrelated endpoint
+                # churn cannot re-select backends for established flows
+                if self._endpoint_ids:
+                    ep_ids = np.asarray(self._endpoint_ids, np.int64)[
+                        np.clip(ep_idx, 0, len(self._endpoint_ids) - 1)
+                    ]
+                else:
+                    ep_ids = ep_idx
+                fh = flow_hash32(peer_bytes, sports, dports, protos, ep_ids)
                 nb, npo, rv, ok, nobk = lb_translate(
                     lbt,
                     jnp.asarray(peer_bytes),
@@ -442,6 +540,10 @@ class DatapathPipeline:
                 with self._lock:
                     if self.counters.shape == counters.shape:
                         self.counters += counters
+            self._emit_flow_events(
+                peer_bytes, ep_idx, dports, protos, v,
+                ingress=ingress, family=family, redirect=red,
+            )
             if want_rev_nat:
                 # no CT → replies can't be recognized → no NAT restore
                 return v, red, np.zeros(b, np.uint16)
@@ -516,6 +618,10 @@ class DatapathPipeline:
                     default=2,
                 )
                 np.add.at(self.counters, (ep_idx, cls), 1)
+        self._emit_flow_events(
+            peer_bytes, ep_idx, dports, protos, verdict,
+            ingress=ingress, family=family, redirect=redirect,
+        )
         if want_rev_nat:
             # revNAT restore (bpf/lib/lb.h lb4_rev_nat via the CT
             # entry's rev_nat_index): flows whose CT hit is in the

@@ -83,13 +83,16 @@ def flow_hash32(
     sports: Optional[np.ndarray],
     dports: np.ndarray,
     protos: np.ndarray,
-    ep_idx: np.ndarray,
+    ep_ids: np.ndarray,  # [B] STABLE endpoint ids (not list indices)
 ) -> np.ndarray:
     """[B] int32 ≥ 0 deterministic per-flow hash (the skb flow-hash
     role). Determinism matters beyond affinity: the conntrack key of a
     load-balanced flow embeds the *translated* backend tuple, so the
     same packet must keep selecting the same backend for the
-    established-flow bypass to hit."""
+    established-flow bypass to hit. The endpoint contribution must be
+    the endpoint's stable ID — a positional index would re-select
+    backends for every established flow whenever an unrelated endpoint
+    joins or leaves the list."""
     b = peer_bytes.shape[0]
     x = np.zeros(b, np.uint32)
     with np.errstate(over="ignore"):
@@ -99,7 +102,7 @@ def flow_hash32(
             x ^= np.asarray(sports, np.uint32) << np.uint32(16)
         x ^= np.asarray(dports, np.uint32)
         x ^= np.asarray(protos, np.uint32) << np.uint32(8)
-        x ^= np.asarray(ep_idx, np.uint32) << np.uint32(24)
+        x ^= np.asarray(ep_ids, np.uint32) << np.uint32(24)
         # final avalanche (murmur3 fmix32)
         x ^= x >> np.uint32(16)
         x *= np.uint32(0x85EBCA6B)

@@ -79,22 +79,27 @@ def build_selection_seq(backends: Sequence[Backend]) -> List[int]:
     hard capacity, bpf/lib/lb.h LB_MAX)."""
     if not backends:
         return []
-    backends = list(backends)[:MAX_SEQ]
-    weights = [max(0, b.weight) for b in backends]
+    # weight 0 means "no traffic" in BOTH paths; all-zero degrades to
+    # equal shares (the reference treats weightless services as plain
+    # round-robin)
+    live = [(i, max(0, b.weight)) for i, b in enumerate(backends)]
+    if all(w == 0 for _, w in live):
+        live = [(i, 1) for i, _ in live]
+    else:
+        live = [(i, w) for i, w in live if w > 0]
+    live = live[:MAX_SEQ]
+    idxs = [i for i, _ in live]
+    weights = [w for _, w in live]
     total = sum(weights)
-    if total == 0:  # all-zero weights degrade to equal shares
-        weights = [1] * len(backends)
-        total = len(backends)
     if total <= MAX_SEQ:
         reps = weights
     else:
-        # everyone gets 1 slot; the remaining slots go by largest
-        # weight remainder so the scaled shares stay proportional
-        n = len(backends)
-        reps = [1] * n
+        # every positive-weight backend gets 1 slot; remaining slots
+        # go by largest weight remainder so shares stay proportional
+        n = len(live)
         spare = MAX_SEQ - n
         shares = [w * spare / total for w in weights]
-        reps = [r + int(s) for r, s in zip(reps, shares)]
+        reps = [1 + int(s) for s in shares]
         spare -= sum(int(s) for s in shares)
         order = sorted(range(n), key=lambda i: shares[i] - int(shares[i]),
                        reverse=True)
@@ -104,10 +109,10 @@ def build_selection_seq(backends: Sequence[Backend]) -> List[int]:
     # interleave round-robin style so short prefixes are still mixed
     counts = list(reps)
     while any(c > 0 for c in counts):
-        for i, c in enumerate(counts):
+        for k, c in enumerate(counts):
             if c > 0:
-                seq.append(i)
-                counts[i] -= 1
+                seq.append(idxs[k])
+                counts[k] -= 1
     return seq[:MAX_SEQ]
 
 

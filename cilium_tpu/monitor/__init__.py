@@ -1,0 +1,43 @@
+"""Observability event stream: drop/trace/agent/L7 notifications,
+lossy multicast hub, and the monitor socket protocol (the roles of
+monitor/ + pkg/monitor in the reference)."""
+
+from .events import (
+    EVENT_AGENT,
+    EVENT_DROP,
+    EVENT_L7,
+    EVENT_TRACE,
+    REASON_NO_SERVICE,
+    REASON_POLICY,
+    REASON_PREFILTER,
+    AgentNotify,
+    DropNotify,
+    L7Notify,
+    TraceNotify,
+    decode,
+    encode,
+    reason_name,
+)
+from .hub import MonitorHub, Subscription
+from .server import MonitorServer, monitor_stream
+
+__all__ = [
+    "AgentNotify",
+    "DropNotify",
+    "EVENT_AGENT",
+    "EVENT_DROP",
+    "EVENT_L7",
+    "EVENT_TRACE",
+    "L7Notify",
+    "MonitorHub",
+    "MonitorServer",
+    "REASON_NO_SERVICE",
+    "REASON_POLICY",
+    "REASON_PREFILTER",
+    "Subscription",
+    "TraceNotify",
+    "decode",
+    "encode",
+    "monitor_stream",
+    "reason_name",
+]

@@ -1,0 +1,204 @@
+"""Datapath event model + binary codecs.
+
+Reference: pkg/monitor/datapath_drop.go:28 (DropNotify), pkg/monitor/
+datapath_trace.go:28 (TraceNotify), pkg/monitor/agent.go (agent
+notifications), and the notify event types of bpf/lib/common.h:209.
+The kernel emits fixed-layout C structs into the perf ring; here the
+pipeline emits typed events whose wire form is a fixed-layout struct
+too (monitor/server.py frames them onto the monitor socket), so
+external consumers get the same "binary payload protocol" boundary
+the reference's monitor daemon speaks (monitor/monitor.go:184,301).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import time
+from typing import Optional, Tuple
+
+# event types (common.h:209 CILIUM_NOTIFY_*)
+EVENT_DROP = 1
+EVENT_TRACE = 2
+EVENT_AGENT = 3
+EVENT_L7 = 4
+
+# drop reasons (bpf/lib/common.h DROP_* / pkg/monitor/api errors)
+REASON_POLICY = 133  # DROP_POLICY
+REASON_PREFILTER = 144  # prefilter deny (XDP)
+REASON_NO_SERVICE = 146  # lb4_local: frontend without backends
+REASON_CT_MAP_FULL = 135
+REASON_UNKNOWN = 0
+
+_REASON_NAMES = {
+    REASON_POLICY: "Policy denied",
+    REASON_PREFILTER: "Prefilter denied",
+    REASON_NO_SERVICE: "No service backend",
+    REASON_CT_MAP_FULL: "CT map insertion failed",
+    REASON_UNKNOWN: "Unknown",
+}
+
+# trace observation points (pkg/monitor/datapath_trace.go TraceTo*)
+TRACE_TO_ENDPOINT = 1
+TRACE_FROM_ENDPOINT = 2
+TRACE_TO_PROXY = 3
+
+_TRACE_NAMES = {
+    TRACE_TO_ENDPOINT: "to-endpoint",
+    TRACE_FROM_ENDPOINT: "from-endpoint",
+    TRACE_TO_PROXY: "to-proxy",
+}
+
+
+def reason_name(code: int) -> str:
+    return _REASON_NAMES.get(code, f"reason-{code}")
+
+
+@dataclasses.dataclass(frozen=True)
+class DropNotify:
+    """One dropped flow (DropNotify, datapath_drop.go:28)."""
+
+    reason: int
+    endpoint: int  # local endpoint id
+    src_identity: int  # peer identity row's identity (0 if unknown)
+    family: int  # 4 | 6
+    peer_addr: bytes  # 4 or 16 address bytes (the REMOTE address)
+    dport: int
+    proto: int
+    ingress: bool
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+    @property
+    def type(self) -> int:
+        return EVENT_DROP
+
+    def summary(self) -> str:
+        d = "ingress" if self.ingress else "egress"
+        import ipaddress
+
+        ip = ipaddress.ip_address(self.peer_addr)
+        return (
+            f"xx drop ({reason_name(self.reason)}) {d} ep {self.endpoint} "
+            f"peer {ip} identity {self.src_identity} "
+            f"dport {self.dport} proto {self.proto}"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceNotify:
+    """One forwarded flow (TraceNotify, datapath_trace.go:28)."""
+
+    obs_point: int
+    endpoint: int
+    src_identity: int
+    family: int
+    peer_addr: bytes
+    dport: int
+    proto: int
+    ingress: bool
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+    @property
+    def type(self) -> int:
+        return EVENT_TRACE
+
+    def summary(self) -> str:
+        import ipaddress
+
+        ip = ipaddress.ip_address(self.peer_addr)
+        return (
+            f"-> {_TRACE_NAMES.get(self.obs_point, self.obs_point)} "
+            f"ep {self.endpoint} peer {ip} identity {self.src_identity} "
+            f"dport {self.dport} proto {self.proto}"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class AgentNotify:
+    """Control-plane event (pkg/monitor/agent.go AgentNotify):
+    policy imports, endpoint lifecycle, regenerations."""
+
+    kind: str  # "policy-updated" | "endpoint-created" | ...
+    message: str
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+    @property
+    def type(self) -> int:
+        return EVENT_AGENT
+
+    def summary(self) -> str:
+        return f">> agent {self.kind}: {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class L7Notify:
+    """L7 access-log record surfaced on the monitor stream
+    (pkg/proxy/logger → monitor agent events)."""
+
+    verdict: str
+    detail: str
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+    @property
+    def type(self) -> int:
+        return EVENT_L7
+
+    def summary(self) -> str:
+        return f"L7 {self.verdict}: {self.detail}"
+
+
+# ---------------------------------------------------------------------
+# Binary wire codec — fixed little-endian layouts, one per event type.
+# Flow events: type u8, sub u8 (reason/obs), flags u8 (bit0 ingress,
+# bit1 family==6), proto u8, endpoint u32, identity u32, dport u16,
+# pad u16, timestamp f64, addr 16s (v4 left-aligned, zero-padded).
+_FLOW_FMT = "<BBBBIIHHd16s"
+_FLOW_LEN = struct.calcsize(_FLOW_FMT)
+
+
+def encode(ev) -> bytes:
+    t = ev.type
+    if t in (EVENT_DROP, EVENT_TRACE):
+        sub = ev.reason if t == EVENT_DROP else ev.obs_point
+        flags = (1 if ev.ingress else 0) | (2 if ev.family == 6 else 0)
+        return struct.pack(
+            _FLOW_FMT, t, sub, flags, ev.proto, ev.endpoint,
+            ev.src_identity, ev.dport, 0, ev.timestamp,
+            bytes(ev.peer_addr).ljust(16, b"\x00"),
+        )
+    if t == EVENT_AGENT:
+        kind = ev.kind.encode()
+        msg = ev.message.encode()
+        return struct.pack("<BHH", t, len(kind), len(msg)) + kind + msg + struct.pack("<d", ev.timestamp)
+    if t == EVENT_L7:
+        v = ev.verdict.encode()
+        d = ev.detail.encode()
+        return struct.pack("<BHH", t, len(v), len(d)) + v + d + struct.pack("<d", ev.timestamp)
+    raise ValueError(f"unknown event type {t}")
+
+
+def decode(buf: bytes):
+    t = buf[0]
+    if t in (EVENT_DROP, EVENT_TRACE):
+        (t, sub, flags, proto, ep, ident, dport, _pad, ts, addr) = struct.unpack(
+            _FLOW_FMT, buf[:_FLOW_LEN]
+        )
+        family = 6 if flags & 2 else 4
+        peer = addr[:16] if family == 6 else addr[:4]
+        cls = DropNotify if t == EVENT_DROP else TraceNotify
+        kw = dict(
+            endpoint=ep, src_identity=ident, family=family, peer_addr=peer,
+            dport=dport, proto=proto, ingress=bool(flags & 1), timestamp=ts,
+        )
+        if t == EVENT_DROP:
+            return DropNotify(reason=sub, **kw)
+        return TraceNotify(obs_point=sub, **kw)
+    if t in (EVENT_AGENT, EVENT_L7):
+        _, la, lb = struct.unpack("<BHH", buf[:5])
+        a = buf[5:5 + la].decode()
+        b = buf[5 + la:5 + la + lb].decode()
+        (ts,) = struct.unpack("<d", buf[5 + la + lb:5 + la + lb + 8])
+        if t == EVENT_AGENT:
+            return AgentNotify(kind=a, message=b, timestamp=ts)
+        return L7Notify(verdict=a, detail=b, timestamp=ts)
+    raise ValueError(f"unknown event type {t}")

@@ -64,6 +64,16 @@ def test_selection_seq_zero_weights():
     assert sorted(set(seq)) == [0, 1]  # degrade to equal shares
 
 
+def test_selection_seq_zero_weight_gets_no_slots():
+    # in BOTH the exact and the overflow-rescale path
+    seq = build_selection_seq([Backend("1.1.1.1", 80, weight=0),
+                               Backend("2.2.2.2", 80, weight=10)])
+    assert set(seq) == {1}
+    seq = build_selection_seq([Backend("1.1.1.1", 80, weight=0),
+                               Backend("2.2.2.2", 80, weight=1000)])
+    assert set(seq) == {1}
+
+
 def _manager():
     m = ServiceManager()
     m.upsert(

@@ -1,0 +1,228 @@
+"""Event stream: drop/trace notifications, hub fan-out, socket protocol.
+
+Reference analogs: pkg/monitor/datapath_drop.go:28 (DropNotify),
+datapath_trace.go:28 (TraceNotify), monitor/monitor.go:184,301 (lossy
+multicast + payload protocol), pkg/monitor/agent.go (agent events).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from cilium_tpu.datapath.pipeline import DatapathPipeline
+from cilium_tpu.engine import PolicyEngine
+from cilium_tpu.identity import IdentityRegistry
+from cilium_tpu.ipcache.ipcache import IPCache
+from cilium_tpu.ipcache.prefilter import PreFilter
+from cilium_tpu.labels import parse_label_array
+from cilium_tpu.monitor import (
+    EVENT_DROP,
+    REASON_POLICY,
+    REASON_PREFILTER,
+    AgentNotify,
+    DropNotify,
+    L7Notify,
+    MonitorHub,
+    MonitorServer,
+    TraceNotify,
+    decode,
+    encode,
+    monitor_stream,
+)
+from cilium_tpu.ops.lpm import ip_strings_to_u32
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    rule,
+)
+from cilium_tpu.policy.repository import Repository
+
+
+class TestCodec:
+    def test_drop_roundtrip(self):
+        ev = DropNotify(
+            reason=REASON_POLICY, endpoint=7, src_identity=1002, family=4,
+            peer_addr=bytes([10, 0, 0, 9]), dport=443, proto=6, ingress=True,
+        )
+        out = decode(encode(ev))
+        assert out == ev
+        assert "Policy denied" in out.summary() and "10.0.0.9" in out.summary()
+
+    def test_trace_roundtrip_v6(self):
+        ev = TraceNotify(
+            obs_point=1, endpoint=3, src_identity=5, family=6,
+            peer_addr=bytes(range(16)), dport=80, proto=6, ingress=False,
+        )
+        assert decode(encode(ev)) == ev
+
+    def test_agent_and_l7_roundtrip(self):
+        a = AgentNotify(kind="policy-updated", message="rev 7")
+        assert decode(encode(a)) == a
+        l7 = L7Notify(verdict="Denied", detail='{"path": "/admin"}')
+        assert decode(encode(l7)) == l7
+
+
+class TestHub:
+    def test_fanout_and_loss(self):
+        hub = MonitorHub()
+        assert not hub.active
+        s1 = hub.subscribe(capacity=4)
+        s2 = hub.subscribe(capacity=100)
+        assert hub.active
+        for i in range(10):
+            hub.publish(AgentNotify(kind="k", message=str(i)))
+        assert s1.lost == 6 and len(s1.drain()) == 4
+        assert s2.lost == 0 and len(s2.drain()) == 10
+        s1.close()
+        s2.close()
+        assert not hub.active
+
+    def test_next_blocking(self):
+        hub = MonitorHub()
+        sub = hub.subscribe()
+        out = []
+        t = threading.Thread(target=lambda: out.append(sub.next(timeout=5)))
+        t.start()
+        hub.publish(AgentNotify(kind="x", message="y"))
+        t.join(timeout=5)
+        assert out and out[0].kind == "x"
+
+
+def _pipeline(with_monitor=True):
+    repo = Repository()
+    repo.add_list([
+        rule(
+            ["k8s:app=web"],
+            ingress=[IngressRule(
+                from_endpoints=(EndpointSelector.make(["k8s:app=lb"]),),
+                to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),)),),
+            )],
+            labels=["k8s:policy=m0"],
+        ),
+    ])
+    reg = IdentityRegistry()
+    web = reg.allocate(parse_label_array(["k8s:app=web"]))
+    lb = reg.allocate(parse_label_array(["k8s:app=lb"]))
+    other = reg.allocate(parse_label_array(["k8s:app=other"]))
+    cache = IPCache()
+    cache.upsert("10.0.0.2/32", lb.id, source="k8s")
+    cache.upsert("10.0.0.4/32", other.id, source="k8s")
+    hub = MonitorHub() if with_monitor else None
+    pf = PreFilter()
+    pf.insert(pf.revision, ["192.0.2.0/24"])
+    pipe = DatapathPipeline(
+        PolicyEngine(repo, reg), cache, pf, monitor=hub
+    )
+    pipe.set_endpoints([(7, web.id)])
+    return pipe, hub, dict(web=web, lb=lb, other=other)
+
+
+class TestPipelineEmission:
+    def test_drop_events_with_reasons_and_identity(self):
+        pipe, hub, ids = _pipeline()
+        sub = hub.subscribe()
+        src = ip_strings_to_u32(["10.0.0.2", "10.0.0.4", "192.0.2.7"])
+        v, _ = pipe.process(
+            src, np.zeros(3, np.int32),
+            np.array([80, 80, 80]), np.array([6, 6, 6]),
+        )
+        events = sub.drain()
+        # two drops: policy (identity 'other') and prefilter
+        assert len(events) == 2
+        by_reason = {e.reason: e for e in events}
+        pol = by_reason[REASON_POLICY]
+        assert pol.endpoint == 7  # endpoint ID, not index
+        assert pol.src_identity == ids["other"].id
+        assert pol.peer_addr == bytes([10, 0, 0, 4])
+        assert REASON_PREFILTER in by_reason
+
+    def test_trace_events_opt_in(self):
+        pipe, hub, ids = _pipeline()
+        sub = hub.subscribe()
+        src = ip_strings_to_u32(["10.0.0.2"])
+        args = (src, np.zeros(1, np.int32), np.array([80]), np.array([6]))
+        pipe.process(*args)
+        assert sub.drain() == []  # forwarded + trace off ⇒ silence
+        pipe.trace_enabled = True
+        pipe.process(*args)
+        evs = sub.drain()
+        assert len(evs) == 1 and isinstance(evs[0], TraceNotify)
+        assert evs[0].src_identity == ids["lb"].id
+        assert "to-endpoint" in evs[0].summary()
+
+    def test_no_subscriber_no_events(self):
+        pipe, hub, _ = _pipeline()
+        src = ip_strings_to_u32(["10.0.0.4"])
+        pipe.process(src, np.zeros(1, np.int32), np.array([80]), np.array([6]))
+        assert hub.published == 0  # hub.active gate short-circuits
+
+
+class TestMonitorSocket:
+    def test_stream_over_unix_socket(self, tmp_path):
+        hub = MonitorHub()
+        srv = MonitorServer(hub, str(tmp_path / "mon.sock"))
+        srv.start()
+        try:
+            got = []
+            done = threading.Event()
+
+            def reader():
+                for ev in monitor_stream(str(tmp_path / "mon.sock"),
+                                         timeout=3.0):
+                    got.append(ev)
+                    if len(got) == 3:
+                        break
+                done.set()
+
+            t = threading.Thread(target=reader)
+            t.start()
+            # wait until the server registered the subscription
+            for _ in range(100):
+                if hub.active:
+                    break
+                import time
+                time.sleep(0.02)
+            hub.publish(AgentNotify(kind="policy-updated", message="rev 3"))
+            hub.publish(DropNotify(
+                reason=REASON_POLICY, endpoint=1, src_identity=2, family=4,
+                peer_addr=b"\x0a\x00\x00\x01", dport=80, proto=6,
+                ingress=True,
+            ))
+            hub.publish(L7Notify(verdict="Denied", detail="GET /admin"))
+            assert done.wait(5)
+            assert [e.type for e in got] == [3, EVENT_DROP, 4]
+            assert got[1].peer_addr == b"\x0a\x00\x00\x01"
+        finally:
+            srv.stop()
+
+
+class TestDaemonIntegration:
+    def test_agent_and_l7_bridge(self):
+        from cilium_tpu.daemon import Daemon
+
+        d = Daemon()
+        sub = d.monitor.subscribe()
+        d.policy_add('[{"endpointSelector": {"matchLabels": '
+                     '{"k8s:app": "web"}}, "labels": ["k8s:policy=x"]}]')
+        d.endpoint_add(9, ["k8s:app=web"], ipv4="10.1.0.9")
+        kinds = [e.kind for e in sub.drain() if isinstance(e, AgentNotify)]
+        assert "regenerate" in kinds and "endpoint-created" in kinds
+        # L7 access-log records bridge onto the stream
+        from cilium_tpu.proxy.accesslog import (
+            LogRecord,
+            TYPE_REQUEST,
+            VERDICT_DENIED,
+        )
+
+        d.proxy.accesslog.log(LogRecord(
+            type=TYPE_REQUEST, verdict=VERDICT_DENIED, timestamp=0.0,
+            http={"method": "GET", "path": "/admin"},
+        ))
+        l7 = [e for e in sub.drain() if isinstance(e, L7Notify)]
+        assert len(l7) == 1 and l7[0].verdict == VERDICT_DENIED
+        d.shutdown()
