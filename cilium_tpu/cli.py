@@ -252,6 +252,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         server = APIServer(daemon, args.socket)
         monitor = MonitorServer(daemon.monitor, args.socket + ".monitor")
         monitor.start()
+        daemon.fqdn_start()  # ToFQDNs DNS poll loop (daemon/main.go:808)
         print(f"cilium-tpu daemon serving on {args.socket} "
               f"(monitor: {args.socket}.monitor, state: {args.state})")
         try:

@@ -24,6 +24,7 @@ from .datapath.conntrack import FlowConntrack
 from .datapath.pipeline import DatapathPipeline
 from .endpoint.endpoint import Endpoint, EndpointState
 from .endpoint.manager import EndpointManager
+from .fqdn import DNSPoller, system_resolver
 from .engine import PolicyEngine
 from .identity import IdentityRegistry
 from .ipcache.ipcache import IPCache, SOURCE_AGENT
@@ -57,6 +58,7 @@ class Daemon:
         state_dir: Optional[str] = None,
         *,
         conntrack: bool = True,
+        dns_resolver=None,
     ) -> None:
         self.state_dir = state_dir
         self.repo = Repository()
@@ -74,6 +76,16 @@ class Daemon:
         )
         self.endpoint_manager = EndpointManager()
         self.proxy = Proxy()
+        # ToFQDNs poller (fqdn.StartDNSPoller, daemon/main.go:808 —
+        # started lazily via fqdn_start(); tests drive fqdn_poll())
+        self.fqdn = DNSPoller(
+            self.repo,
+            resolver=dns_resolver or system_resolver,
+            on_change=lambda rev: (
+                self._regenerate("fqdn update"),
+                self.save_state(),
+            ),
+        )
         # L7 access-log records surface on the monitor stream the way
         # the reference forwards proxy logs as monitor agent events
         # (pkg/proxy/logger → monitor).
@@ -357,6 +369,19 @@ class Daemon:
     def service_list(self) -> List[Dict]:
         return [self._service_model(s) for s in self.services.list()]
 
+    # -- fqdn -----------------------------------------------------------
+    def fqdn_poll(self) -> Dict:
+        """One DNS resolution sweep (the 5s tick of dnspoller.go:78)."""
+        changed = self.fqdn.poll_once()
+        return {
+            "names": self.fqdn.tracked_names(),
+            "rules_changed": changed,
+            "revision": self.repo.revision,
+        }
+
+    def fqdn_start(self, interval: float = 5.0) -> None:
+        self.fqdn.start(interval)
+
     # -- status ---------------------------------------------------------
     def status(self) -> Dict:
         return {
@@ -428,4 +453,5 @@ class Daemon:
         return n
 
     def shutdown(self) -> None:
+        self.fqdn.stop()
         self.endpoint_manager.shutdown()

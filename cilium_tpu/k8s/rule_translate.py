@@ -17,6 +17,7 @@ import ipaddress
 from typing import Dict, Iterable, Optional, Tuple
 
 from ..policy.api import CIDRRule, EgressRule, Rule, ServiceSelector
+from ..policy.api.rules import host_cidr as _host_cidr
 from .service_registry import ServiceEndpoint, ServiceID, ServiceInfo, ServiceRegistry
 
 
@@ -34,11 +35,6 @@ def _service_matches(
     return sel.name == sid.name and sel.namespace in ("", sid.namespace)
 
 
-def _host_cidr(ip: str) -> str:
-    addr = ipaddress.ip_address(ip)
-    return f"{ip}/{32 if addr.version == 4 else 128}"
-
-
 def _populate(egress: EgressRule, endpoint: ServiceEndpoint) -> EgressRule:
     """Add one-address generated CIDRs for every backend not already
     covered (generateToCidrFromEndpoint, rule_translate.go:113-160)."""
@@ -48,7 +44,9 @@ def _populate(egress: EgressRule, endpoint: ServiceEndpoint) -> EgressRule:
         addr = ipaddress.ip_address(ip)
         if any(addr in net for net in existing):
             continue
-        added.append(CIDRRule(cidr=_host_cidr(ip), generated=True))
+        added.append(
+            CIDRRule(cidr=_host_cidr(ip), generated=True, generated_by="service")
+        )
         existing.append(ipaddress.ip_network(_host_cidr(ip), strict=False))
     return dataclasses.replace(egress, to_cidr_set=tuple(added))
 
@@ -60,7 +58,9 @@ def _depopulate(egress: EgressRule, endpoint: ServiceEndpoint) -> EgressRule:
     kept = tuple(
         c
         for c in egress.to_cidr_set
-        if not c.generated
+        # only entries THIS translator generated are eligible for
+        # removal — fqdn-generated entries belong to the DNS poller
+        if not (c.generated and c.generated_by == "service")
         or not any(
             b in ipaddress.ip_network(c.cidr, strict=False) for b in backends
         )
@@ -123,7 +123,11 @@ class RegistryTranslator:
                 new_egress.append(er)
                 continue
             base = dataclasses.replace(
-                er, to_cidr_set=tuple(c for c in er.to_cidr_set if not c.generated)
+                er,
+                to_cidr_set=tuple(
+                    c for c in er.to_cidr_set
+                    if not (c.generated and c.generated_by == "service")
+                ),
             )
             for sid, svc, ep in self.registry.external_services():
                 if any(

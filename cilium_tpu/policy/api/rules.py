@@ -94,6 +94,14 @@ class PortRule:
                     raise ValueError("L7 rules require a concrete port")
 
 
+def host_cidr(ip: str) -> str:
+    """ip → its single-address CIDR (/32 or /128) — shared by the
+    translators that synthesize per-address CIDRRules (ToServices,
+    ToFQDNs) so their generated entries stay mutually comparable."""
+    addr = ipaddress.ip_address(ip)
+    return f"{ip}/{32 if addr.version == 4 else 128}"
+
+
 @dataclasses.dataclass(frozen=True)
 class CIDRRule:
     """CIDR with carve-outs (cidr.go CIDRRule). ``generated`` marks
@@ -104,6 +112,9 @@ class CIDRRule:
     cidr: str
     except_cidrs: Tuple[str, ...] = ()
     generated: bool = False
+    # which translator synthesized this entry ("fqdn", "service", "")
+    # — each translator replaces only its own entries on re-translate
+    generated_by: str = ""
 
     def sanitize(self) -> None:
         net = ipaddress.ip_network(self.cidr, strict=False)

@@ -139,6 +139,7 @@ def _cidr_set(entries: Iterable[Dict[str, Any]]) -> tuple:
             cidr=c["cidr"],
             except_cidrs=tuple(c.get("except") or ()),
             generated=bool(c.get("generated", False)),
+            generated_by=str(c.get("generatedBy", "")),
         )
         for c in entries or ()
     )
@@ -225,6 +226,7 @@ def rule_to_dict(r: Rule) -> Dict[str, Any]:
                         "cidr": c.cidr,
                         **({"except": list(c.except_cidrs)} if c.except_cidrs else {}),
                         **({"generated": True} if c.generated else {}),
+                        **({"generatedBy": c.generated_by} if c.generated_by else {}),
                     }
                     for c in ing.from_cidr_set
                 ]
@@ -249,6 +251,7 @@ def rule_to_dict(r: Rule) -> Dict[str, Any]:
                         "cidr": c.cidr,
                         **({"except": list(c.except_cidrs)} if c.except_cidrs else {}),
                         **({"generated": True} if c.generated else {}),
+                        **({"generatedBy": c.generated_by} if c.generated_by else {}),
                     }
                     for c in eg.to_cidr_set
                 ]

@@ -1,0 +1,186 @@
+"""FQDN policy: DNS cache TTLs, rule translation, poller + verdict flip.
+
+Reference analogs: pkg/fqdn/cache.go (TTL cache),
+pkg/fqdn/dnspoller.go:78,260,384 (poll loop, change detection,
+generated ToCIDRSet injection via the repository).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from cilium_tpu.fqdn import DNSCache, DNSPoller, FQDNTranslator
+from cilium_tpu.labels import LabelArray, parse_label_array
+from cilium_tpu.labels.cidr import cidr_labels
+from cilium_tpu.policy.api import CIDRRule, EgressRule, rule
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.search import Decision, SearchContext
+
+
+class TestDNSCache:
+    def test_update_lookup_expire(self):
+        c = DNSCache(min_ttl=0)
+        assert c.update("db.example.com", ["10.0.0.1"], ttl=10, now=100.0)
+        assert c.lookup("db.example.com", now=105.0) == ["10.0.0.1"]
+        # same set again: no change signal
+        assert not c.update("db.example.com", ["10.0.0.1"], ttl=10, now=105.0)
+        # new IP alone: change signal; the OLD entry keeps its own TTL
+        assert c.update("db.example.com", ["10.0.0.2"], ttl=10, now=105.0)
+        assert c.lookup("db.example.com", now=106.0) == ["10.0.0.1", "10.0.0.2"]
+        # both were refreshed at 105 → both expire at 115
+        assert c.lookup("db.example.com", now=114.0) == ["10.0.0.1", "10.0.0.2"]
+        changed = c.expire(now=120.0)
+        assert changed == ["db.example.com"]
+        assert c.lookup("db.example.com", now=120.0) == []
+
+    def test_min_ttl_floor(self):
+        c = DNSCache(min_ttl=60)
+        c.update("x.io", ["1.1.1.1"], ttl=1, now=0.0)
+        assert c.lookup("x.io", now=30.0) == ["1.1.1.1"]  # floored to 60s
+
+
+def _fqdn_rule():
+    return rule(
+        ["k8s:app=web"],
+        egress=[EgressRule(
+            to_fqdns=("db.example.com",),
+            to_cidr_set=(CIDRRule("203.0.113.0/24"),),  # user-written
+        )],
+        labels=["k8s:policy=fq0"],
+    )
+
+
+class TestTranslator:
+    def test_generated_entries_replace_only_fqdn_ones(self):
+        cache = DNSCache(min_ttl=0)
+        cache.update("db.example.com", ["10.9.0.5"], ttl=100, now=0.0)
+        tr = FQDNTranslator(cache, now=1.0)
+        r = tr.translate(_fqdn_rule())
+        cs = r.egress[0].to_cidr_set
+        assert [c.cidr for c in cs] == ["203.0.113.0/24", "10.9.0.5/32"]
+        assert cs[1].generated and cs[1].generated_by == "fqdn"
+        # IP set changes → fqdn entries swapped, user entry kept
+        cache.update("db.example.com", ["10.9.0.6"], ttl=100, now=200.0)
+        cache.expire(now=200.0)
+        r2 = FQDNTranslator(cache, now=200.0).translate(r)
+        assert [c.cidr for c in r2.egress[0].to_cidr_set] == [
+            "203.0.113.0/24", "10.9.0.6/32",
+        ]
+
+    def test_rule_without_fqdns_untouched(self):
+        r = rule(["k8s:app=web"], egress=[EgressRule(to_cidr=("10.0.0.0/8",))])
+        assert FQDNTranslator(DNSCache(), now=0.0).translate(r) is r
+
+
+class TestPoller:
+    def test_poll_injects_rules_and_flips_verdict(self):
+        repo = Repository()
+        repo.add_list([_fqdn_rule()])
+        answers = {"db.example.com": (["10.9.0.5"], 300.0)}
+        revs = []
+        poller = DNSPoller(
+            repo,
+            resolver=lambda name: answers.get(name, ([], 0.0)),
+            on_change=lambda rev: revs.append(rev),
+        )
+        assert poller.tracked_names() == ["db.example.com"]
+
+        web = parse_label_array(["k8s:app=web"])
+        dst = LabelArray(cidr_labels("10.9.0.5/32"))
+        ctx = SearchContext(src=web, dst=dst)
+        # before resolution: the DNS name grants nothing
+        assert repo.allows_egress(ctx) == Decision.DENIED
+
+        r0 = repo.revision
+        assert poller.poll_once(now=0.0) == 1  # one rule re-generated
+        assert repo.revision > r0 and revs  # revision bump + callback
+        assert repo.allows_egress(ctx) == Decision.ALLOWED  # verdict flip
+
+        # steady state: same answers → no further bumps
+        r1 = repo.revision
+        assert poller.poll_once(now=1.0) == 0
+        assert repo.revision == r1
+
+        # DNS moves → old IP denied, new IP allowed
+        answers["db.example.com"] = (["10.9.0.6"], 300.0)
+        assert poller.poll_once(now=1000.0) == 1
+        assert repo.allows_egress(ctx) == Decision.DENIED
+        ctx6 = SearchContext(src=web, dst=LabelArray(cidr_labels("10.9.0.6/32")))
+        assert repo.allows_egress(ctx6) == Decision.ALLOWED
+
+    def test_resolver_failure_keeps_cached_ips(self):
+        repo = Repository()
+        repo.add_list([_fqdn_rule()])
+        answers = {"db.example.com": (["10.9.0.5"], 300.0)}
+        poller = DNSPoller(repo, resolver=lambda n: answers[n])
+        poller.poll_once(now=0.0)
+        # resolver starts failing — cached IPs stay live until TTL
+        answers["db.example.com"] = ([], 0.0)
+        assert poller.poll_once(now=10.0) == 0
+        web = parse_label_array(["k8s:app=web"])
+        ctx = SearchContext(src=web, dst=LabelArray(cidr_labels("10.9.0.5/32")))
+        assert repo.allows_egress(ctx) == Decision.ALLOWED
+        # ...and expire once the TTL passes
+        assert poller.poll_once(now=1000.0) == 1
+        assert repo.allows_egress(ctx) == Decision.DENIED
+
+
+def test_fqdn_and_service_translators_coexist():
+    """ToServices re-translation must not strip fqdn-generated entries
+    (per-translator ownership via generated_by)."""
+    from cilium_tpu.k8s.rule_translate import RegistryTranslator
+    from cilium_tpu.k8s.service_registry import (
+        ServiceEndpoint,
+        ServiceID,
+        ServiceInfo,
+        ServiceRegistry,
+    )
+    from cilium_tpu.policy.api import ServiceSelector
+
+    reg = ServiceRegistry()
+    sid = ServiceID("default", "ext")
+    reg.upsert_service(sid, ServiceInfo(cluster_ip=""))  # external
+    reg.upsert_endpoints(sid, ServiceEndpoint(backend_ips=("192.0.2.8",)))
+    repo = Repository()
+    repo.add_list([
+        rule(
+            ["k8s:app=web"],
+            egress=[EgressRule(
+                to_services=(ServiceSelector(name="ext", namespace="default"),),
+                to_fqdns=("db.example.com",),
+            )],
+            labels=["k8s:policy=mix"],
+        ),
+    ])
+    cache = DNSCache(min_ttl=0)
+    cache.update("db.example.com", ["10.9.0.5"], ttl=1000, now=0.0)
+    DNSPoller(repo, resolver=lambda n: (["10.9.0.5"], 1000.0),
+              cache=cache).poll_once(now=0.0)
+    repo.translate_rules(RegistryTranslator(reg))
+    cidrs = {
+        (c.cidr, c.generated_by) for c in repo.rules[0].egress[0].to_cidr_set
+    }
+    assert ("10.9.0.5/32", "fqdn") in cidrs  # fqdn entry survived
+    assert ("192.0.2.8/32", "service") in cidrs  # service entry added
+
+
+class TestDaemonFQDN:
+    def test_daemon_fqdn_poll(self):
+        from cilium_tpu.daemon import Daemon
+
+        answers = {"api.example.com": (["198.51.100.9"], 120.0)}
+        d = Daemon(dns_resolver=lambda n: answers.get(n, ([], 0.0)))
+        d.policy_add(
+            '[{"endpointSelector": {"matchLabels": {"k8s:app": "web"}},'
+            ' "egress": [{"toFQDNs": [{"matchName": "api.example.com"}]}],'
+            ' "labels": ["k8s:policy=fq1"]}]'
+        )
+        out = d.fqdn_poll()
+        assert out["names"] == ["api.example.com"]
+        assert out["rules_changed"] == 1
+        got = d.policy_get()["rules"]
+        fq = [r for r in got if "k8s:policy=fq1" in r.get("labels", [])][0]
+        cs = fq["egress"][0]["toCIDRSet"]
+        assert cs[0]["cidr"] == "198.51.100.9/32"
+        assert cs[0]["generated"] and cs[0]["generatedBy"] == "fqdn"
+        d.shutdown()
