@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import tempfile
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -76,6 +77,9 @@ class Daemon:
         )
         self.endpoint_manager = EndpointManager()
         self.proxy = Proxy()
+        # serializes snapshot writers: API threads AND the background
+        # DNS poller both reach save_state
+        self._save_lock = threading.Lock()
         # ToFQDNs poller (fqdn.StartDNSPoller, daemon/main.go:808 —
         # started lazily via fqdn_start(); tests drive fqdn_poll())
         self.fqdn = DNSPoller(
@@ -407,18 +411,31 @@ class Daemon:
         with self.repo._lock:
             rules = [rule_to_dict(r) for r in self.repo.rules]
         eps = self.endpoint_list()
-        tmp = os.path.join(self.state_dir, ".state.tmp")
-        with open(tmp, "w") as f:
-            json.dump(
-                {
-                    "rules": rules,
-                    "endpoints": eps,
-                    "services": self.service_list(),
-                },
-                f,
-                indent=1,
+        # unique tmp per call + a writer lock: the fqdn poller thread
+        # and API threads may snapshot concurrently, and two writers
+        # sharing one tmp path would interleave into invalid JSON
+        with self._save_lock:
+            fd, tmp = tempfile.mkstemp(
+                dir=self.state_dir, prefix=".state.", suffix=".tmp"
             )
-        os.replace(tmp, os.path.join(self.state_dir, "state.json"))
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(
+                        {
+                            "rules": rules,
+                            "endpoints": eps,
+                            "services": self.service_list(),
+                        },
+                        f,
+                        indent=1,
+                    )
+                os.replace(tmp, os.path.join(self.state_dir, "state.json"))
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
 
     def restore_state(self) -> int:
         """Parse the snapshot and rebuild live state (restoreOldEndpoints

@@ -13,6 +13,7 @@ lookup function, dnspoller.go LookupDNSNames).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
@@ -108,6 +109,7 @@ class DNSPoller:
         self.on_change = on_change
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.failures = 0  # consecutive poll failures (operator signal)
 
     # -- name tracking (MarkToFQDNRules role) ---------------------------
     def tracked_names(self) -> List[str]:
@@ -143,14 +145,20 @@ class DNSPoller:
         if self._thread is not None:
             return
 
+        log = logging.getLogger("cilium_tpu.fqdn")
+
         def loop():
             while not self._stop.wait(interval):
                 try:
                     self.poll_once()
+                    self.failures = 0
                 except Exception:
-                    # poller must survive resolver hiccups (the
-                    # reference logs and keeps polling)
-                    pass
+                    # poller must survive resolver hiccups — log and
+                    # keep polling (dnspoller.go does the same); the
+                    # failure counter gives status surfaces a signal
+                    self.failures += 1
+                    log.warning("fqdn poll failed (%d consecutive)",
+                                self.failures, exc_info=True)
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

@@ -35,10 +35,27 @@ def _service_matches(
     return sel.name == sid.name and sel.namespace in ("", sid.namespace)
 
 
+def _service_owned(c: CIDRRule) -> bool:
+    """Entries this translator may add/remove. generated_by == "" is
+    included for backward compatibility: snapshots written before the
+    ownership tag existed serialized service-generated entries as bare
+    {generated: true} — treating them as service-owned lets the next
+    translation clean them up instead of orphaning them forever."""
+    return c.generated and c.generated_by in ("service", "")
+
+
 def _populate(egress: EgressRule, endpoint: ServiceEndpoint) -> EgressRule:
     """Add one-address generated CIDRs for every backend not already
-    covered (generateToCidrFromEndpoint, rule_translate.go:113-160)."""
-    existing = [ipaddress.ip_network(c.cidr, strict=False) for c in egress.to_cidr_set]
+    covered (generateToCidrFromEndpoint, rule_translate.go:113-160).
+    Coverage counts only user-written and service-owned entries: an
+    fqdn-generated /32 that happens to equal a backend today will be
+    withdrawn when DNS moves, so it must not suppress the
+    service-owned entry that keeps the backend reachable."""
+    existing = [
+        ipaddress.ip_network(c.cidr, strict=False)
+        for c in egress.to_cidr_set
+        if _service_owned(c) or not c.generated
+    ]
     added = list(egress.to_cidr_set)
     for ip in endpoint.backend_ips:
         addr = ipaddress.ip_address(ip)
@@ -60,7 +77,7 @@ def _depopulate(egress: EgressRule, endpoint: ServiceEndpoint) -> EgressRule:
         for c in egress.to_cidr_set
         # only entries THIS translator generated are eligible for
         # removal — fqdn-generated entries belong to the DNS poller
-        if not (c.generated and c.generated_by == "service")
+        if not _service_owned(c)
         or not any(
             b in ipaddress.ip_network(c.cidr, strict=False) for b in backends
         )
@@ -125,8 +142,7 @@ class RegistryTranslator:
             base = dataclasses.replace(
                 er,
                 to_cidr_set=tuple(
-                    c for c in er.to_cidr_set
-                    if not (c.generated and c.generated_by == "service")
+                    c for c in er.to_cidr_set if not _service_owned(c)
                 ),
             )
             for sid, svc, ep in self.registry.external_services():

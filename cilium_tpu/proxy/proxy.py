@@ -147,6 +147,42 @@ class Proxy:
             )
         return allows
 
+    def handle_kafka_bytes(
+        self, redirect: Redirect, data: bytes, src_identity: int = 0
+    ):
+        """Byte-level ingestion boundary (the transparent TCP proxy of
+        pkg/proxy/kafka.go handleRequest): parse one request frame,
+        ACL-check every topic (a request passes only if ALL its topics
+        pass — pkg/kafka/policy.go iterates GetTopics), and return
+        (forward, reply_bytes): forward=True ⇒ reply_bytes is the
+        original frame to send upstream; forward=False ⇒ reply_bytes
+        is the synthesized reject response for the client (empty for
+        unparseable input, which the reference drops)."""
+        from ..l7.kafka_wire import (
+            KafkaParseError,
+            parse_request,
+            reject_response,
+        )
+
+        try:
+            parsed = parse_request(data)
+        except KafkaParseError:
+            return False, b""
+        reqs = [
+            KafkaRequest(
+                api_key=parsed.api_key,
+                api_version=parsed.api_version,
+                client_id=parsed.client_id,
+                topic=t,
+                src_identity=src_identity,
+            )
+            for t in (parsed.topics or ("",))
+        ]
+        allows = self.check_kafka(redirect, reqs)
+        if all(bool(a) for a in allows):
+            return True, parsed.raw
+        return False, reject_response(parsed)
+
     def check_kafka(self, redirect: Redirect, requests: Sequence[KafkaRequest]):
         acl = redirect.kafka_acl
         allows = (

@@ -164,6 +164,74 @@ def test_fqdn_and_service_translators_coexist():
     assert ("192.0.2.8/32", "service") in cidrs  # service entry added
 
 
+def test_service_backend_survives_dns_move():
+    """An fqdn /32 equal to a service backend must not suppress the
+    service-owned entry — when DNS moves away, the backend stays
+    reachable."""
+    from cilium_tpu.k8s.rule_translate import RegistryTranslator
+    from cilium_tpu.k8s.service_registry import (
+        ServiceEndpoint,
+        ServiceID,
+        ServiceInfo,
+        ServiceRegistry,
+    )
+    from cilium_tpu.policy.api import ServiceSelector
+
+    reg = ServiceRegistry()
+    sid = ServiceID("default", "ext")
+    reg.upsert_service(sid, ServiceInfo(cluster_ip=""))
+    reg.upsert_endpoints(sid, ServiceEndpoint(backend_ips=("10.9.0.5",)))
+    repo = Repository()
+    repo.add_list([rule(
+        ["k8s:app=web"],
+        egress=[EgressRule(
+            to_services=(ServiceSelector(name="ext", namespace="default"),),
+            to_fqdns=("db.example.com",),
+        )],
+        labels=["k8s:policy=mix2"],
+    )])
+    cache = DNSCache(min_ttl=0)
+    poller = DNSPoller(repo, resolver=lambda n: ([], 0.0), cache=cache)
+    # DNS currently points AT the backend IP; fqdn translates first
+    cache.update("db.example.com", ["10.9.0.5"], ttl=100, now=0.0)
+    poller.poll_once(now=0.0)
+    repo.translate_rules(RegistryTranslator(reg))
+    owners = {(c.cidr, c.generated_by)
+              for c in repo.rules[0].egress[0].to_cidr_set}
+    assert ("10.9.0.5/32", "service") in owners  # service entry NOT suppressed
+    # DNS moves away; fqdn withdraws its entry — service entry remains
+    cache.update("db.example.com", ["10.9.0.77"], ttl=100, now=300.0)
+    cache.expire(now=300.0)
+    poller.poll_once(now=300.0)
+    owners = {(c.cidr, c.generated_by)
+              for c in repo.rules[0].egress[0].to_cidr_set}
+    assert ("10.9.0.5/32", "service") in owners
+    assert ("10.9.0.77/32", "fqdn") in owners
+    assert ("10.9.0.5/32", "fqdn") not in owners
+
+
+def test_legacy_untagged_generated_entries_are_service_owned():
+    """Snapshots written before generated_by existed serialize service
+    entries as bare {generated: true}; the service translator must
+    still clean them up (not orphan them forever)."""
+    from cilium_tpu.k8s.rule_translate import RegistryTranslator
+    from cilium_tpu.k8s.service_registry import ServiceRegistry
+    from cilium_tpu.policy.api import ServiceSelector
+
+    repo = Repository()
+    repo.add_list([rule(
+        ["k8s:app=web"],
+        egress=[EgressRule(
+            to_services=(ServiceSelector(name="gone", namespace="default"),),
+            to_cidr_set=(CIDRRule("192.0.2.8/32", generated=True),),  # legacy
+        )],
+        labels=["k8s:policy=legacy"],
+    )])
+    # empty registry: the service no longer exists → entry removed
+    repo.translate_rules(RegistryTranslator(ServiceRegistry()))
+    assert repo.rules[0].egress[0].to_cidr_set == ()
+
+
 class TestDaemonFQDN:
     def test_daemon_fqdn_poll(self):
         from cilium_tpu.daemon import Daemon

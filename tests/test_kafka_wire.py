@@ -1,0 +1,221 @@
+"""Kafka wire protocol: byte-level parse, reject synthesis, correlation.
+
+Reference analogs: pkg/kafka/request.go:30 (ReadRequest), :186
+(GetTopics), :158 (CreateResponse error synthesis),
+pkg/kafka/correlation_cache.go.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import pytest
+
+from cilium_tpu.l7.kafka_policy import KafkaACL
+from cilium_tpu.l7.kafka_wire import (
+    API_FETCH,
+    API_METADATA,
+    API_OFFSET_FETCH,
+    API_PRODUCE,
+    ERR_TOPIC_AUTHORIZATION_FAILED,
+    CorrelationCache,
+    KafkaParseError,
+    parse_request,
+    reject_response,
+)
+from cilium_tpu.policy.api import KafkaRule
+
+
+def _s(s: str) -> bytes:
+    return struct.pack(">h", len(s)) + s.encode()
+
+
+def _frame(body: bytes) -> bytes:
+    return struct.pack(">i", len(body)) + body
+
+
+def produce_req(cid=7, client="cli", topics=(("orders", (0, 1)),), version=0):
+    body = struct.pack(">hhi", API_PRODUCE, version, cid) + _s(client)
+    body += struct.pack(">hi", 1, 30000)  # acks, timeout
+    body += struct.pack(">i", len(topics))
+    for t, parts in topics:
+        body += _s(t) + struct.pack(">i", len(parts))
+        for p in parts:
+            msgset = b"\x00" * 10
+            body += struct.pack(">ii", p, len(msgset)) + msgset
+    return _frame(body)
+
+
+def fetch_req(cid=9, client="cons", topics=(("logs", (0,)),), version=0):
+    body = struct.pack(">hhi", API_FETCH, version, cid) + _s(client)
+    body += struct.pack(">iii", -1, 500, 1)  # replica, max_wait, min_bytes
+    body += struct.pack(">i", len(topics))
+    for t, parts in topics:
+        body += _s(t) + struct.pack(">i", len(parts))
+        for p in parts:
+            body += struct.pack(">iqi", p, 0, 1 << 20)  # offset, max_bytes
+    return _frame(body)
+
+
+def metadata_req(cid=3, topics=("orders", "logs"), version=1):
+    body = struct.pack(">hhi", API_METADATA, version, cid) + _s("adm")
+    body += struct.pack(">i", len(topics))
+    for t in topics:
+        body += _s(t)
+    return _frame(body)
+
+
+def offset_fetch_req(cid=4, group="g1", topics=(("logs", (0, 2)),)):
+    body = struct.pack(">hhi", API_OFFSET_FETCH, 0, cid) + _s("c") + _s(group)
+    body += struct.pack(">i", len(topics))
+    for t, parts in topics:
+        body += _s(t) + struct.pack(">i", len(parts))
+        for p in parts:
+            body += struct.pack(">i", p)
+    return _frame(body)
+
+
+class TestParse:
+    def test_produce(self):
+        req = parse_request(produce_req())
+        assert req.api_key == API_PRODUCE and req.api_version == 0
+        assert req.correlation_id == 7 and req.client_id == "cli"
+        assert req.topics == ("orders",)
+        assert req.partitions["orders"] == (0, 1)
+
+    def test_fetch_and_metadata(self):
+        req = parse_request(fetch_req())
+        assert req.topics == ("logs",) and req.partitions["logs"] == (0,)
+        req = parse_request(metadata_req())
+        assert set(req.topics) == {"orders", "logs"}
+
+    def test_offset_fetch(self):
+        req = parse_request(offset_fetch_req())
+        assert req.topics == ("logs",) and req.partitions["logs"] == (0, 2)
+
+    def test_truncated_raises(self):
+        data = produce_req()
+        with pytest.raises(KafkaParseError):
+            parse_request(data[:10])
+        with pytest.raises(KafkaParseError):
+            parse_request(b"\x00\x00")
+
+    def test_implausible_count_raises(self):
+        body = struct.pack(">hhi", API_METADATA, 0, 1) + _s("x")
+        body += struct.pack(">i", 2_000_000)
+        with pytest.raises(KafkaParseError):
+            parse_request(_frame(body))
+
+    def test_raw_is_exact_frame(self):
+        data = produce_req()
+        assert parse_request(data + b"extra").raw == data
+
+
+class TestReject:
+    def test_produce_reject_frames_every_partition(self):
+        req = parse_request(produce_req(cid=42, topics=(("orders", (0, 1)),)))
+        resp = reject_response(req)
+        (size,) = struct.unpack(">i", resp[:4])
+        assert size == len(resp) - 4  # correctly framed
+        (cid,) = struct.unpack(">i", resp[4:8])
+        assert cid == 42  # correlation preserved
+        # body: topic array of 1, 'orders', 2 partitions, each err 29
+        off = 8
+        (ntop,) = struct.unpack(">i", resp[off:off + 4]); off += 4
+        assert ntop == 1
+        (tlen,) = struct.unpack(">h", resp[off:off + 2]); off += 2
+        assert resp[off:off + tlen] == b"orders"; off += tlen
+        (nparts,) = struct.unpack(">i", resp[off:off + 4]); off += 4
+        assert nparts == 2
+        for want_p in (0, 1):
+            p, err, base = struct.unpack(">ihq", resp[off:off + 14]); off += 14
+            assert p == want_p and err == ERR_TOPIC_AUTHORIZATION_FAILED
+        assert off == len(resp)
+
+    def test_fetch_reject_v1_has_throttle(self):
+        req = parse_request(fetch_req(version=1))
+        # v1 parse path == v0 body; synthesize v1 reject
+        resp = reject_response(req)
+        (throttle,) = struct.unpack(">i", resp[8:12])
+        assert throttle == 0
+
+    def test_metadata_reject_marks_topics(self):
+        req = parse_request(metadata_req(version=1, topics=("secret",)))
+        resp = reject_response(req)
+        off = 8
+        (nbrokers,) = struct.unpack(">i", resp[off:off + 4]); off += 4
+        assert nbrokers == 0
+        off += 4  # controller id (v1)
+        (ntop,) = struct.unpack(">i", resp[off:off + 4]); off += 4
+        (err,) = struct.unpack(">h", resp[off:off + 2]); off += 2
+        assert ntop == 1 and err == ERR_TOPIC_AUTHORIZATION_FAILED
+
+    def test_unknown_api_key_header_only(self):
+        body = struct.pack(">hhi", 18, 0, 77) + _s("x")  # ApiVersions
+        resp = reject_response(parse_request(_frame(body)))
+        assert resp == struct.pack(">ii", 4, 77)
+
+
+class TestCorrelation:
+    def test_forward_and_correlate(self):
+        cache = CorrelationCache()
+        req = parse_request(produce_req(cid=1000))
+        fwd = cache.forward(req)
+        # frame rewritten with proxy cid at bytes 8..12
+        (pcid,) = struct.unpack(">i", fwd[8:12])
+        assert pcid != 1000 and fwd[:8] == req.raw[:8] and fwd[12:] == req.raw[12:]
+        assert len(cache) == 1
+        # upstream responds with the proxy cid → rewritten back
+        resp = struct.pack(">ii", 8, pcid) + b"\x00" * 4
+        back = cache.correlate(resp)
+        (cid,) = struct.unpack(">i", back[4:8])
+        assert cid == 1000 and len(cache) == 0
+        # unknown cid dropped
+        assert cache.correlate(resp) is None
+
+    def test_capacity(self):
+        cache = CorrelationCache(capacity=2)
+        req = parse_request(produce_req())
+        cache.forward(req)
+        cache.forward(req)
+        with pytest.raises(KafkaParseError):
+            cache.forward(req)
+
+
+class TestProxyByteBoundary:
+    def _proxy(self):
+        from cilium_tpu.proxy.proxy import PARSER_KAFKA, Proxy
+
+        proxy = Proxy()
+        red = proxy.create_or_update_redirect(
+            1, 9092, PARSER_KAFKA,
+            kafka_acl=KafkaACL([(KafkaRule(role="produce", topic="orders"),
+                                 None)]),
+        )
+        return proxy, red
+
+    def test_allowed_forwarded_verbatim(self):
+        proxy, red = self._proxy()
+        data = produce_req(topics=(("orders", (0,)),))
+        ok, out = proxy.handle_kafka_bytes(red, data)
+        assert ok and out == data
+
+    def test_denied_gets_reject_bytes(self):
+        proxy, red = self._proxy()
+        data = produce_req(cid=55, topics=(("secret", (3,)),))
+        ok, out = proxy.handle_kafka_bytes(red, data)
+        assert not ok
+        (cid,) = struct.unpack(">i", out[4:8])
+        assert cid == 55
+        assert struct.unpack(">h", out[-10:-8])[0] == ERR_TOPIC_AUTHORIZATION_FAILED
+
+    def test_mixed_topics_all_must_pass(self):
+        proxy, red = self._proxy()
+        data = produce_req(topics=(("orders", (0,)), ("secret", (0,))))
+        ok, _ = proxy.handle_kafka_bytes(red, data)
+        assert not ok
+
+    def test_garbage_dropped(self):
+        proxy, red = self._proxy()
+        ok, out = proxy.handle_kafka_bytes(red, b"\xff\xff\xff\xff\x00")
+        assert not ok and out == b""
