@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from .. import metrics
 from ..ops.dfa import match_patterns
 from ..policy.api import HTTPRule
 from .regex_compile import MultiDFA, RegexError, compile_patterns
@@ -39,14 +40,21 @@ class HTTPRequest:
 
 
 class _PatternSet:
-    """Interned patterns for one field + its compiled DFA (None when any
-    pattern overflowed the state cap → host fallback)."""
+    """Interned patterns for one field + its compiled DFA.
+
+    Compile failure is isolated PER PATTERN: a single pathological
+    regex (state-cap overflow or unsupported syntax) is demoted to
+    host `re` on its own; every other pattern stays on the device DFA.
+    ``dfa_pids[i]`` maps DFA accept-bit i back to the pattern id it
+    represents; ``host_pids`` are the demoted patterns."""
 
     def __init__(self) -> None:
         self.patterns: List[str] = []
         self._ids: Dict[str, int] = {}
         self.dfa: Optional[MultiDFA] = None
-        self.fallback = False
+        self.dfa_pids: List[int] = []
+        self.host_pids: List[int] = []
+        self._host_res: Dict[int, "re.Pattern"] = {}
 
     def intern(self, pattern: str) -> int:
         pid = self._ids.get(pattern)
@@ -59,13 +67,50 @@ class _PatternSet:
     def compile(self) -> None:
         if not self.patterns:
             return
+        # the accept mask is one uint64 bit per pattern: more than 64
+        # distinct patterns on one port must fail LOUDLY at import
+        # (surfaced by endpoint regeneration), never silently shift a
+        # rule's bit out of the mask
+        if len(self.patterns) > 64:
+            raise ValueError(
+                f"more than 64 distinct L7 patterns on one port "
+                f"({len(self.patterns)})"
+            )
         try:
             self.dfa = compile_patterns(self.patterns)
+            self.dfa_pids = list(range(len(self.patterns)))
+            return
         except RegexError:
-            self.fallback = True
+            pass
+        # isolate offenders: survivors are added greedily so a pattern
+        # is demoted only if the COMBINED automaton can't afford it;
+        # the last successful build IS the final DFA (no recompile)
+        good: List[int] = []
+        dfa: Optional[MultiDFA] = None
+        self.host_pids = []
+        for pid in range(len(self.patterns)):
+            try:
+                cand = compile_patterns(
+                    [self.patterns[i] for i in good] + [self.patterns[pid]]
+                )
+            except RegexError:
+                self.host_pids.append(pid)
+                continue
+            good.append(pid)
+            dfa = cand
+        self.dfa_pids = good
+        self.dfa = dfa
+        # precompile host regexes NOW: a pattern our parser accepts
+        # but stdlib `re` rejects must fail once at import, not per
+        # request batch on the datapath
+        for pid in self.host_pids:
+            self._host_res[pid] = re.compile(self.patterns[pid])
+        if self.host_pids:
+            metrics.l7_fallback_patterns.inc(value=len(self.host_pids))
 
     def masks(self, values: Sequence[str], max_len: int) -> np.ndarray:
-        """[B] uint64 accept masks for a batch of field values.
+        """[B] uint64 accept masks (bit = pattern id) for a batch of
+        field values.
 
         Values longer than ``max_len`` can't ride the fixed-width DFA
         batch, so they walk the same DFA host-side (linear time — no
@@ -73,29 +118,35 @@ class _PatternSet:
         instead of silently never matching (long request paths are
         common enough that fail-closed here would diverge from the
         reference)."""
+        n = len(values)
         if not self.patterns:
-            return np.zeros(len(values), np.uint64)
-        if self.dfa is not None and not self.fallback:
+            return np.zeros(n, np.uint64)
+        out = np.zeros(n, np.uint64)
+        if self.dfa is not None:
             encs = [v.encode() for v in values]
-            out = match_patterns(self.dfa, encs, max_len)
+            raw = match_patterns(self.dfa, encs, max_len)
             for i, enc in enumerate(encs):
                 if len(enc) > max_len:
-                    out[i] = np.uint64(self.dfa.match_str(enc))
-            return out
-        # DFA compile overflowed the state cap: host `re` is the only
-        # engine left. re.error propagates loudly — a pattern this
-        # parser accepts but `re` rejects must not silently never-match.
-        return np.array(
-            [
-                sum(
-                    1 << pid
-                    for pid, p in enumerate(self.patterns)
-                    if re.fullmatch(p, v)
-                )
-                for v in values
-            ],
-            np.uint64,
-        )
+                    raw[i] = np.uint64(self.dfa.match_str(enc))
+            if len(self.dfa_pids) == len(self.patterns):
+                out = raw  # identity mapping (no demotions)
+            else:
+                for slot, pid in enumerate(self.dfa_pids):
+                    out |= ((raw >> np.uint64(slot)) & np.uint64(1)) << np.uint64(pid)
+        # demoted patterns: host `re` (precompiled at import), counted
+        # so a production rule set silently running on Python is
+        # visible in /metrics
+        for pid in self.host_pids:
+            cre = self._host_res[pid]
+            hits = np.fromiter(
+                (cre.fullmatch(v) is not None for v in values), bool, n
+            )
+            out |= hits.astype(np.uint64) << np.uint64(pid)
+        if self.host_pids:
+            metrics.l7_host_fallback_evaluations.inc(
+                value=n * len(self.host_pids)
+            )
+        return out
 
 
 @dataclasses.dataclass

@@ -144,6 +144,14 @@ verdicts_total = registry.counter(
     "cilium_tpu_datapath_verdicts_total", "Flow verdicts by outcome"
 )
 identity_count = registry.gauge("cilium_tpu_identity_count", "Allocated identities")
+l7_fallback_patterns = registry.counter(
+    "cilium_tpu_l7_fallback_patterns_total",
+    "L7 regex patterns demoted from the device DFA to host re",
+)
+l7_host_fallback_evaluations = registry.counter(
+    "cilium_tpu_l7_host_fallback_evaluations_total",
+    "Request-field evaluations that ran on host re instead of the DFA",
+)
 compile_time = registry.histogram(
     "cilium_tpu_policy_compile_seconds", "Policy tensor compile latency"
 )

@@ -163,6 +163,54 @@ class TestHTTPPolicy:
         pol = HTTPPolicy([])
         assert pol.check(HTTPRequest("BREW", "/coffee"))
 
+    def test_pathological_pattern_demotes_only_itself(self):
+        """One state-cap-overflowing pattern must not push the whole
+        set off-device (per-pattern fallback), and fallback work is
+        counted in metrics."""
+        from cilium_tpu import metrics
+
+        bad = "/api/.*a.{14}b"  # exponential subset construction
+        rules = [
+            (HTTPRule(method="GET", path="/v1/.*"), None),
+            (HTTPRule(method="GET", path=bad), None),
+            (HTTPRule(method="POST", path="/v2/exact"), None),
+        ]
+        pol = HTTPPolicy(rules)
+        # the two sane patterns ride the DFA; only the bad one is host
+        assert pol._paths.dfa is not None
+        assert len(pol._paths.host_pids) == 1
+        assert len(pol._paths.dfa_pids) == 2
+        before = metrics.l7_host_fallback_evaluations.get()
+        reqs = [
+            HTTPRequest(method="GET", path="/v1/x"),
+            HTTPRequest(method="GET", path="/api/za" + "c" * 14 + "b"),
+            HTTPRequest(method="POST", path="/v2/exact"),
+            HTTPRequest(method="GET", path="/nope"),
+        ]
+        out = pol.check_batch(reqs)
+        assert out.tolist() == [True, True, True, False]
+        # 4 values × 1 demoted pattern counted as host evaluations
+        assert metrics.l7_host_fallback_evaluations.get() == before + 4
+        assert metrics.l7_fallback_patterns.get() >= 1
+
+    def test_all_patterns_pathological_still_enforce(self):
+        bad1 = "/a/.*x.{14}y"
+        bad2 = "/b/.*p.{14}q"
+        pol = HTTPPolicy([(HTTPRule(path=bad1), None),
+                          (HTTPRule(path=bad2), None)])
+        assert pol._paths.dfa is None  # nothing fit on-device
+        assert len(pol._paths.host_pids) == 2
+        reqs = [
+            HTTPRequest(method="GET", path="/a/zx" + "m" * 14 + "y"),
+            HTTPRequest(method="GET", path="/c/other"),
+        ]
+        assert pol.check_batch(reqs).tolist() == [True, False]
+
+    def test_over_64_patterns_fails_loudly(self):
+        rules = [(HTTPRule(path=f"/svc{i}/.*"), None) for i in range(65)]
+        with pytest.raises(ValueError, match="64"):
+            HTTPPolicy(rules)
+
     def test_overlong_path_takes_host_fallback(self):
         # Long request paths must still match allow rules (advisor
         # finding: fail-closed divergence at common path lengths).
