@@ -91,6 +91,18 @@ class APIClient:
     def identity_get(self, num: int):
         return self._request("GET", f"/identity/{num}")
 
+    def health(self):
+        return self._request("GET", "/health")
+
+    def health_probe(self):
+        return self._request("POST", "/health/probe")
+
+    def debuginfo(self):
+        return self._request("GET", "/debuginfo")
+
+    def fqdn_poll(self):
+        return self._request("POST", "/fqdn/poll")
+
     def service_list(self):
         return self._request("GET", "/service")
 

@@ -138,6 +138,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "identity not found"})
             else:
                 self._json(200, ident)
+        elif path == "/health" and method == "GET":
+            self._json(200, d.health_report())
+        elif path == "/health/probe" and method == "POST":
+            self._json(200, d.health_probe_now())
+        elif path == "/debuginfo" and method == "GET":
+            self._json(200, d.debuginfo())
+        elif path == "/fqdn/poll" and method == "POST":
+            self._json(200, d.fqdn_poll())
         elif path == "/service" and method == "GET":
             self._json(200, d.service_list())
         elif path == "/service" and method == "PUT":

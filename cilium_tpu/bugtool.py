@@ -1,0 +1,84 @@
+"""bugtool / debuginfo: one-shot state capture for support.
+
+Reference: bugtool/ (cilium-bugtool archives `cilium status`, map
+dumps, logs, sysctl) and the /debuginfo REST endpoint
+(daemon/debuginfo.go). Here the capture walks the daemon object:
+status, policy rules, endpoints + realized policymaps, identities,
+services, ipcache, prefilter, conntrack summary, health report,
+metrics text, and recent L7 access logs — everything an operator
+needs to reconstruct verdict behavior offline.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+import time
+from typing import Dict
+
+
+def collect_debuginfo(daemon) -> Dict:
+    """The GET /debuginfo payload (daemon/debuginfo.go)."""
+    ipcache = {
+        cidr: {"identity": e.identity, "source": e.source,
+               "host_ip": e.host_ip}
+        for cidr, e in daemon.ipcache.items()
+    }
+    pf_rev, pf_cidrs = daemon.prefilter.dump()
+    endpoints = daemon.endpoint_list()
+    policymaps = {}
+    for em in endpoints:
+        eid = em["id"]
+        try:
+            policymaps[eid] = {
+                "ingress": daemon.policymap_dump(eid, ingress=True),
+                "egress": daemon.policymap_dump(eid, ingress=False),
+            }
+        except Exception as e:  # a broken endpoint must not kill capture
+            policymaps[eid] = {"error": f"{type(e).__name__}: {e}"}
+    ct = daemon.conntrack
+    return {
+        "timestamp": time.time(),
+        "status": daemon.status(),
+        "policy": daemon.policy_get(),
+        "endpoints": endpoints,
+        "policymaps": policymaps,
+        "identities": daemon.identity_list(),
+        "services": daemon.service_list(),
+        "ipcache": ipcache,
+        "prefilter": {"revision": pf_rev, "cidrs": pf_cidrs},
+        "conntrack": {
+            "entries": len(ct) if ct is not None else 0,
+            "capacity": ct.capacity if ct is not None else 0,
+        },
+        "fqdn": {
+            "names": daemon.fqdn.tracked_names(),
+            "failures": daemon.fqdn.failures,
+        },
+        "health": daemon.health.report(),
+        "accesslog": [r.to_dict() for r in daemon.proxy.accesslog.recent(200)],
+    }
+
+
+def write_archive(daemon, path: str) -> str:
+    """cilium-bugtool against a live in-process daemon."""
+    return write_archive_from(collect_debuginfo(daemon),
+                              daemon.metrics_text(), path)
+
+
+def write_archive_from(info: Dict, metrics_text: str, path: str) -> str:
+    """cilium-bugtool: write a tar.gz of per-subsystem JSON files plus
+    the raw Prometheus metrics text. Accepts the /debuginfo payload so
+    the CLI can archive a REMOTE daemon over REST. Returns the path."""
+    members = {f"{key}.json": json.dumps(value, indent=1, default=str)
+               for key, value in info.items()}
+    members["metrics.prom"] = metrics_text
+    with tarfile.open(path, "w:gz") as tar:
+        for name, text in sorted(members.items()):
+            data = text.encode()
+            ti = tarfile.TarInfo(name=f"cilium-tpu-bugtool/{name}")
+            ti.size = len(data)
+            ti.mtime = int(time.time())
+            tar.addfile(ti, io.BytesIO(data))
+    return path

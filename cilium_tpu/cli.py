@@ -91,6 +91,15 @@ class _Surface:
             raise SystemExit(f"identity {num} not found")
         return out
 
+    def _d_health(self):
+        return self._daemon.health_report()
+
+    def _d_health_probe(self):
+        return self._daemon.health_probe_now()
+
+    def _d_debuginfo(self):
+        return self._daemon.debuginfo()
+
     def _d_service_list(self):
         return self._daemon.service_list()
 
@@ -149,6 +158,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--state", default=DEFAULT_STATE,
                    help="state dir for standalone mode")
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    hl = sub.add_parser("health", help="node connectivity status")
+    hl.add_argument("--probe", action="store_true",
+                    help="run an immediate probe sweep first")
+
+    bt = sub.add_parser("bugtool", help="archive daemon state for support")
+    bt.add_argument("--output", default="",
+                    help="archive path (default: cilium-tpu-bugtool-<ts>.tar.gz)")
 
     mon = sub.add_parser("monitor", help="stream datapath/agent events")
     mon.add_argument("--json", action="store_true", help="print raw events")
@@ -253,6 +270,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         monitor = MonitorServer(daemon.monitor, args.socket + ".monitor")
         monitor.start()
         daemon.fqdn_start()  # ToFQDNs DNS poll loop (daemon/main.go:808)
+        if daemon.health.nodes is not None:
+            # node prober (daemon/main.go:927-945) — only meaningful
+            # once a node registry is attached; a standalone daemon
+            # has no peers and would spin an empty sweep forever
+            daemon.health.start()
         print(f"cilium-tpu daemon serving on {args.socket} "
               f"(monitor: {args.socket}.monitor, state: {args.state})")
         try:
@@ -330,6 +352,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             _print(s.identity_get(args.id))
     elif args.cmd == "bpf":
         _print(s.policymap_get(args.endpoint, egress=args.egress))
+    elif args.cmd == "health":
+        _print(s.health_probe() if args.probe else s.health())
+    elif args.cmd == "bugtool":
+        import time as _time
+
+        from .bugtool import write_archive_from
+
+        out = args.output or f"cilium-tpu-bugtool-{int(_time.time())}.tar.gz"
+        write_archive_from(s.debuginfo(), s.metrics(), out)
+        print(f"archive written: {out}")
     elif args.cmd == "service":
         if args.sub == "list":
             _print(s.service_list())

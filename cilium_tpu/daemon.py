@@ -26,6 +26,7 @@ from .datapath.pipeline import DatapathPipeline
 from .endpoint.endpoint import Endpoint, EndpointState
 from .endpoint.manager import EndpointManager
 from .fqdn import DNSPoller, system_resolver
+from .health import HealthProber, tcp_probe
 from .engine import PolicyEngine
 from .identity import IdentityRegistry
 from .ipcache.ipcache import IPCache, SOURCE_AGENT
@@ -60,6 +61,8 @@ class Daemon:
         *,
         conntrack: bool = True,
         dns_resolver=None,
+        node_registry=None,
+        health_probe=None,
     ) -> None:
         self.state_dir = state_dir
         self.repo = Repository()
@@ -80,6 +83,12 @@ class Daemon:
         # serializes snapshot writers: API threads AND the background
         # DNS poller both reach save_state
         self._save_lock = threading.Lock()
+        # node connectivity prober (cilium-health launch,
+        # daemon/main.go:927-945); probes the node registry when one
+        # is attached, reports empty standalone
+        self.health = HealthProber(
+            nodes=node_registry, probe=health_probe or tcp_probe
+        )
         # ToFQDNs poller (fqdn.StartDNSPoller, daemon/main.go:808 —
         # started lazily via fqdn_start(); tests drive fqdn_poll())
         self.fqdn = DNSPoller(
@@ -386,6 +395,30 @@ class Daemon:
     def fqdn_start(self, interval: float = 5.0) -> None:
         self.fqdn.start(interval)
 
+    # -- health / debuginfo ---------------------------------------------
+    def attach_node_registry(self, registry) -> None:
+        """Give the health prober a cluster node registry
+        (nodes/registry.py) — clustered deployments call this after
+        joining the kvstore; standalone daemons have no peers to
+        probe."""
+        self.health.nodes = registry
+
+    def health_report(self) -> Dict:
+        """GET /health (the cilium-health status surface)."""
+        return self.health.report()
+
+    def health_probe_now(self) -> Dict:
+        """POST /health/probe — one immediate sweep (cilium-health
+        `--probe`)."""
+        self.health.probe_once()
+        return self.health.report()
+
+    def debuginfo(self) -> Dict:
+        """GET /debuginfo (daemon/debuginfo.go)."""
+        from . import bugtool
+
+        return bugtool.collect_debuginfo(self)
+
     # -- status ---------------------------------------------------------
     def status(self) -> Dict:
         return {
@@ -470,5 +503,6 @@ class Daemon:
         return n
 
     def shutdown(self) -> None:
+        self.health.stop()
         self.fqdn.stop()
         self.endpoint_manager.shutdown()

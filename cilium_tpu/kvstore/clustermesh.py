@@ -20,9 +20,14 @@ import json
 import threading
 from typing import Callable, Dict, List, Optional
 
+from typing import TYPE_CHECKING
+
 from ..identity.registry import IdentityRegistry
-from ..nodes.registry import Node
 from ..ipcache.ipcache import IPCache, SOURCE_KVSTORE
+
+if TYPE_CHECKING:  # runtime import is lazy — nodes.registry depends on
+    from ..nodes.registry import Node  # kvstore, so a top-level import
+    # here would make `import cilium_tpu.nodes` order-dependent
 from ..labels import parse_label_array
 from .backend import (
     BackendOperations,
@@ -81,6 +86,8 @@ class RemoteCluster:
         """Apply pending remote events (the RemoteCache merge of
         allocator.go + ipcache kvstore watcher, scoped to this
         cluster)."""
+        from ..nodes.registry import Node  # lazy: breaks import cycle
+
         n = 0
         for ev in self._w_ids.drain():
             n += 1

@@ -1,0 +1,139 @@
+"""Health prober + bugtool/debuginfo.
+
+Reference analogs: pkg/health/server/prober.go:40,229,262 (per-node
+probe sweep + status), bugtool/ (state archive), daemon/debuginfo.go.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+
+import pytest
+
+from cilium_tpu.health import HealthProber
+from cilium_tpu.nodes.registry import Node
+
+
+class FakeRegistry:
+    def __init__(self, nodes):
+        self.nodes = nodes
+
+    def remote_nodes(self):
+        return list(self.nodes)
+
+
+class TestProber:
+    def test_probe_sweep_and_failures(self):
+        reg = FakeRegistry([
+            Node(name="n1", ipv4="10.0.1.1", health_ip="10.0.1.100"),
+            Node(name="n2", ipv4="10.0.2.1"),
+        ])
+        up = {"10.0.1.100"}
+
+        def probe(addr, port):
+            if addr in up:
+                return 0.0012
+            raise OSError("connection refused")
+
+        p = HealthProber(nodes=reg, probe=probe)
+        p.probe_once()
+        rep = p.report()
+        assert rep["total"] == 2 and rep["reachable"] == 1
+        by = {n["name"]: n for n in rep["nodes"]}
+        assert by["n1"]["reachable"] and by["n1"]["latency_s"] > 0
+        assert by["n1"]["address"] == "10.0.1.100"  # health_ip preferred
+        assert not by["n2"]["reachable"] and by["n2"]["failures"] == 1
+        # consecutive failures accumulate; recovery resets
+        p.probe_once()
+        assert p.report()["nodes"][1]["failures"] == 2
+        up.add("10.0.2.1")
+        p.probe_once()
+        by = {n["name"]: n for n in p.report()["nodes"]}
+        assert by["n2"]["reachable"] and by["n2"]["failures"] == 0
+
+    def test_departed_nodes_forgotten(self):
+        reg = FakeRegistry([Node(name="n1", ipv4="10.0.1.1")])
+        p = HealthProber(nodes=reg, probe=lambda a, q: 0.001)
+        p.probe_once()
+        assert p.report()["total"] == 1
+        reg.nodes = []
+        p.probe_once()
+        assert p.report()["total"] == 0
+
+    def test_standalone_empty(self):
+        p = HealthProber()
+        p.probe_once()
+        assert p.report() == {"nodes": [], "reachable": 0, "total": 0}
+
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"k8s:app": "web"}},
+    "ingress": [{"fromEndpoints": [{"matchLabels": {"k8s:app": "lb"}}],
+                 "toPorts": [{"ports": [{"port": "80", "protocol": "TCP"}]}]}],
+    "labels": ["k8s:policy=hb"],
+}]
+
+
+class TestDebuginfoAndBugtool:
+    @pytest.fixture()
+    def daemon(self):
+        from cilium_tpu.daemon import Daemon
+
+        d = Daemon(health_probe=lambda a, p: 0.001)
+        d.policy_add(json.dumps(RULES))
+        d.endpoint_add(7, ["k8s:app=web"], ipv4="10.1.0.7")
+        d.endpoint_add(9, ["k8s:app=lb"], ipv4="10.1.0.9")
+        d.service_upsert({"ip": "10.96.0.1", "port": 443},
+                         [{"ip": "10.1.0.7", "port": 8443}])
+        yield d
+        d.shutdown()
+
+    def test_debuginfo_payload(self, daemon):
+        info = daemon.debuginfo()
+        assert info["status"]["endpoints"] == 2
+        assert len(info["policy"]["rules"]) == 1
+        assert info["policymaps"][7]["ingress"]  # realized rows present
+        assert "egress" in info["policymaps"][7]
+        assert "10.1.0.7/32" in info["ipcache"]
+        assert info["services"][0]["frontend"]["ip"] == "10.96.0.1"
+        assert info["health"] == {"nodes": [], "reachable": 0, "total": 0}
+
+    def test_archive_roundtrip(self, daemon, tmp_path):
+        from cilium_tpu.bugtool import write_archive
+
+        path = write_archive(daemon, str(tmp_path / "bug.tar.gz"))
+        with tarfile.open(path) as tar:
+            names = {m.name for m in tar.getmembers()}
+            assert "cilium-tpu-bugtool/status.json" in names
+            assert "cilium-tpu-bugtool/metrics.prom" in names
+            st = json.load(tar.extractfile("cilium-tpu-bugtool/status.json"))
+            assert st["endpoints"] == 2
+            pm = json.load(
+                tar.extractfile("cilium-tpu-bugtool/policymaps.json")
+            )
+            assert pm["7"]["ingress"]  # keys stringify through JSON
+
+    def test_rest_and_cli(self, daemon, tmp_path):
+        from cilium_tpu.api.client import APIClient
+        from cilium_tpu.api.server import APIServer
+
+        sock = str(tmp_path / "api.sock")
+        srv = APIServer(daemon, sock)
+        srv.start()
+        try:
+            c = APIClient(sock)
+            assert c.health()["total"] == 0
+            assert c.health_probe()["total"] == 0
+            info = c.debuginfo()
+            assert info["status"]["endpoints"] == 2
+            # CLI bugtool over REST
+            from cilium_tpu.cli import main
+
+            out = str(tmp_path / "bug2.tar.gz")
+            assert main(["--socket", sock, "bugtool", "--output", out]) == 0
+            with tarfile.open(out) as tar:
+                assert any("endpoints.json" in m.name for m in tar.getmembers())
+        finally:
+            srv.stop()
