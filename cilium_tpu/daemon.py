@@ -396,12 +396,13 @@ class Daemon:
         self.fqdn.start(interval)
 
     # -- health / debuginfo ---------------------------------------------
-    def attach_node_registry(self, registry) -> None:
+    def attach_node_registry(self, registry, *, probe_interval: float = 60.0) -> None:
         """Give the health prober a cluster node registry
-        (nodes/registry.py) — clustered deployments call this after
-        joining the kvstore; standalone daemons have no peers to
-        probe."""
+        (nodes/registry.py) and start probing — clustered deployments
+        call this after joining the kvstore; standalone daemons have
+        no peers to probe."""
         self.health.nodes = registry
+        self.health.start(probe_interval)
 
     def health_report(self) -> Dict:
         """GET /health (the cilium-health status surface)."""

@@ -70,6 +70,7 @@ class HealthProber:
         self.probe = probe
         self.port = port
         self._lock = threading.Lock()
+        self._sweep_lock = threading.Lock()
         self._status: Dict[str, NodeStatus] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -77,43 +78,60 @@ class HealthProber:
     def probe_once(self) -> List[NodeStatus]:
         """One sweep over all known nodes (prober.go runProbe).
 
-        Each sweep builds a FRESH NodeStatus per node and swaps it in
-        under the lock only when complete: probes block (up to the
-        transport timeout), and mutating the shared object in place
-        would let a concurrent report() — or a concurrent sweep from
-        the REST thread — observe torn state."""
-        nodes = list(self.nodes.remote_nodes()) if self.nodes else []
-        out: List[NodeStatus] = []
-        for n in nodes:
-            addr = n.health_ip or n.ipv4 or n.ipv6
-            key = f"{n.cluster}/{n.name}"
-            with self._lock:
-                prev = self._status.get(key)
-                prev_failures = prev.failures if prev else 0
-            st = NodeStatus(
-                name=n.name, cluster=n.cluster, address=addr,
-                last_probe=time.time(),
-            )
-            if addr is None:
-                st.error = "no address"
-                st.failures = prev_failures + 1
-            else:
-                try:
-                    st.latency_s = self.probe(addr, self.port)
-                    st.reachable = True
-                except OSError as e:
+        Sweeps are serialized by ``_sweep_lock`` — the background loop
+        and POST /health/probe must not interleave, or a sweep that
+        blocked in a connect timeout could overwrite a newer sweep's
+        result with stale state and corrupt consecutive-failure
+        counts. Within a sweep, nodes are probed CONCURRENTLY (the
+        reference fans out too, prober.go), bounding sweep time to
+        roughly one transport timeout instead of timeouts × down
+        nodes. Fresh NodeStatus objects are swapped in whole so
+        report() never sees torn state."""
+        with self._sweep_lock:
+            nodes = list(self.nodes.remote_nodes()) if self.nodes else []
+
+            def probe_node(n) -> NodeStatus:
+                addr = n.health_ip or n.ipv4 or n.ipv6
+                key = f"{n.cluster}/{n.name}"
+                with self._lock:
+                    prev = self._status.get(key)
+                    prev_failures = prev.failures if prev else 0
+                st = NodeStatus(
+                    name=n.name, cluster=n.cluster, address=addr,
+                    last_probe=time.time(),
+                )
+                if addr is None:
+                    st.error = "no address"
                     st.failures = prev_failures + 1
-                    st.error = str(e) or type(e).__name__
-            out.append(st)
+                else:
+                    try:
+                        st.latency_s = self.probe(addr, self.port)
+                        st.reachable = True
+                    except OSError as e:
+                        st.failures = prev_failures + 1
+                        st.error = str(e) or type(e).__name__
+                return st
+
+            if not nodes:
+                out: List[NodeStatus] = []
+            elif len(nodes) == 1:
+                out = [probe_node(nodes[0])]
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(
+                    max_workers=min(32, len(nodes))
+                ) as pool:
+                    out = list(pool.map(probe_node, nodes))
             with self._lock:
-                self._status[key] = st
-        # forget nodes that left the cluster
-        live = {f"{n.cluster}/{n.name}" for n in nodes}
-        with self._lock:
-            for key in list(self._status):
-                if key not in live:
-                    del self._status[key]
-        return out
+                for st in out:
+                    self._status[f"{st.cluster}/{st.name}"] = st
+                # forget nodes that left the cluster
+                live = {f"{n.cluster}/{n.name}" for n in nodes}
+                for key in list(self._status):
+                    if key not in live:
+                        del self._status[key]
+            return out
 
     def report(self) -> Dict:
         """The GET /health payload (health server Status)."""
@@ -131,6 +149,7 @@ class HealthProber:
     def start(self, interval: float = DEFAULT_INTERVAL) -> None:
         if self._thread is not None:
             return
+        self._stop.clear()  # restartable after stop()
 
         def loop():
             # initial sweep at launch (the reference probes immediately,

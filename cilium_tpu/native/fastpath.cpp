@@ -1,0 +1,309 @@
+// Native batch enforcement front-end.
+//
+// The role of the reference's in-kernel eBPF datapath (SURVEY native
+// census item 1): consume the control plane's compiled state — the
+// TPU-materialized policymap rows, the ipcache/prefilter stride-8
+// tries — and enforce verdicts for flow batches at memory speed with
+// no interpreter in the loop. Mirrors the per-packet path of
+// bpf/bpf_lxc.c + bpf/lib/policy.h:
+//
+//   conntrack probe (one hash)            conntrack.h ct_lookup
+//   prefilter deny LPM (ingress only)     bpf_xdp.c check_filters
+//   identity LPM, world on miss           bpf_netdev.c secctx
+//   policymap: exact -> L3 -> L4          policy.h __policy_can_access
+//   CT create on allow (not on redirect)  ct_create4
+//
+// Exposed as a C ABI consumed through ctypes (no pybind11 in the
+// image). All tables are copied in at load time; eval runs without
+// allocation or locks (one loader thread / N eval threads is the
+// supported pattern, same as pinned BPF maps: writers swap, readers
+// race-free on the snapshot they started with).
+
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <vector>
+
+namespace {
+
+constexpr int kProbes = 16;
+constexpr uint64_t kEmpty = ~0ull;
+
+inline uint64_t mix64(uint64_t x) {
+  x ^= x >> 30; x *= 0xbf58476d1ce4e5b9ull;
+  x ^= x >> 27; x *= 0x94d049bb133111ebull;
+  x ^= x >> 31;
+  return x;
+}
+
+inline double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+}
+
+// open-addressing (ka, kb) -> uint8 value table
+struct HashTable {
+  std::vector<uint64_t> ka, kb;
+  std::vector<uint8_t> val;
+  uint64_t mask = 0;
+
+  void init(size_t entries) {
+    size_t cap = 64;
+    while (cap < entries * 4) cap <<= 1;  // load factor <= 0.25
+    ka.assign(cap, kEmpty);
+    kb.assign(cap, 0);
+    val.assign(cap, 0);
+    mask = cap - 1;
+  }
+
+  bool insert(uint64_t a, uint64_t b, uint8_t v) {
+    uint64_t h = mix64(a ^ mix64(b));
+    for (int p = 0; p < kProbes; ++p) {
+      uint64_t s = (h + p) & mask;
+      if (ka[s] == kEmpty || (ka[s] == a && kb[s] == b)) {
+        ka[s] = a; kb[s] = b; val[s] = v;
+        return true;
+      }
+    }
+    return false;
+  }
+
+  inline int find(uint64_t a, uint64_t b) const {
+    uint64_t h = mix64(a ^ mix64(b));
+    for (int p = 0; p < kProbes; ++p) {
+      uint64_t s = (h + p) & mask;
+      if (ka[s] == kEmpty) return -1;
+      if (ka[s] == a && kb[s] == b) return int(val[s]);
+    }
+    return -1;
+  }
+};
+
+// stride-8 trie (same layout as ops/lpm.py): child[M][256], info[M][256]
+struct Trie {
+  std::vector<int32_t> child, info;
+  int levels = 0;
+  bool loaded = false;
+
+  // walk -> deepest non-zero info (value+1), 0 = miss
+  inline int32_t lookup(const uint8_t* addr) const {
+    int32_t node = 0, best = 0;
+    for (int l = 0; l < levels; ++l) {
+      size_t idx = size_t(node) * 256 + addr[l];
+      int32_t v = info[idx];
+      if (v) best = v;
+      node = child[idx];
+      if (!node) break;
+    }
+    return best;
+  }
+};
+
+// conntrack: (ka, kb, kc) keys with expiry; same tuple packing as
+// datapath/conntrack.py so behavior is comparable
+struct Conntrack {
+  std::vector<uint64_t> ka, kb, kc;
+  std::vector<double> expires;
+  uint64_t mask = 0;
+  double tcp_life = 21600.0, other_life = 60.0;
+
+  void init(int bits) {
+    size_t cap = 1ull << bits;
+    ka.assign(cap, kEmpty);
+    kb.assign(cap, 0);
+    kc.assign(cap, 0);
+    expires.assign(cap, 0.0);
+    mask = cap - 1;
+  }
+
+  inline uint64_t hash(uint64_t a, uint64_t b, uint64_t c) const {
+    return mix64(a ^ mix64(b ^ mix64(c)));
+  }
+
+  inline bool probe(uint64_t a, uint64_t b, uint64_t c, double now) {
+    uint64_t h = hash(a, b, c);
+    for (int p = 0; p < kProbes; ++p) {
+      uint64_t s = (h + p) & mask;
+      if (ka[s] == kEmpty) return false;
+      if (ka[s] == a && kb[s] == b && kc[s] == c && expires[s] > now) {
+        expires[s] = now + (((c >> 1) & 0xff) == 6 ? tcp_life : other_life);
+        return true;
+      }
+    }
+    return false;
+  }
+
+  inline void insert(uint64_t a, uint64_t b, uint64_t c, double now) {
+    uint64_t h = hash(a, b, c);
+    for (int p = 0; p < kProbes; ++p) {
+      uint64_t s = (h + p) & mask;
+      if (ka[s] == kEmpty || expires[s] <= now ||
+          (ka[s] == a && kb[s] == b && kc[s] == c)) {
+        ka[s] = a; kb[s] = b; kc[s] = c;
+        expires[s] = now + (((c >> 1) & 0xff) == 6 ? tcp_life : other_life);
+        return;
+      }
+    }
+    // full neighborhood: drop (flow re-verdicts next packet)
+  }
+
+  void flush() {
+    std::fill(ka.begin(), ka.end(), kEmpty);
+  }
+};
+
+struct Fastpath {
+  HashTable policy;     // ka = identity, kb = ep<<32|dport<<16|proto<<8|dir
+  Trie ip4, ip6;        // value = identity (not row: standalone table)
+  Trie deny4, deny6;    // prefilter
+  Conntrack ct;
+  bool ct_enabled = false;
+  uint64_t world_identity = 2;
+  uint32_t ep_count = 0;
+  std::vector<int64_t> counters;  // [ep][3] fwd/drop_policy/drop_prefilter
+};
+
+// verdict codes — match datapath/pipeline.py
+constexpr int8_t FORWARD = 1;
+constexpr int8_t DROP_POLICY = 2;
+constexpr int8_t DROP_PREFILTER = 3;
+
+inline uint64_t policy_kb(uint32_t ep, uint32_t dport, uint32_t proto,
+                          uint32_t dir) {
+  return (uint64_t(ep) << 32) | (uint64_t(dport) << 16) |
+         (uint64_t(proto) << 8) | dir;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* nf_create(uint32_t ep_count, int ct_bits) {
+  auto* fp = new Fastpath();
+  fp->ep_count = ep_count;
+  fp->counters.assign(size_t(ep_count ? ep_count : 1) * 3, 0);
+  if (ct_bits > 0) {
+    fp->ct.init(ct_bits);
+    fp->ct_enabled = true;
+  }
+  return fp;
+}
+
+void nf_destroy(void* h) { delete static_cast<Fastpath*>(h); }
+
+void nf_set_world(void* h, uint64_t identity) {
+  static_cast<Fastpath*>(h)->world_identity = identity;
+}
+
+// entries: parallel arrays — identity u64, ep u32, dport u32, proto
+// u32, dir u32, redirect u8. value stored = 1 (allow) | 2 (redirect)
+int64_t nf_load_policy(void* h, int64_t n, const uint64_t* identity,
+                       const uint32_t* ep, const uint32_t* dport,
+                       const uint32_t* proto, const uint32_t* dir,
+                       const uint8_t* redirect) {
+  auto* fp = static_cast<Fastpath*>(h);
+  fp->policy.init(size_t(n));
+  int64_t loaded = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    loaded += fp->policy.insert(
+        identity[i], policy_kb(ep[i], dport[i], proto[i], dir[i]),
+        redirect[i] ? 2 : 1);
+  }
+  return loaded;
+}
+
+// which: 0 = ipcache v4, 1 = ipcache v6, 2 = deny v4, 3 = deny v6
+void nf_load_trie(void* h, int which, const int32_t* child,
+                  const int32_t* info, int32_t n_nodes, int levels) {
+  auto* fp = static_cast<Fastpath*>(h);
+  Trie* t = which == 0 ? &fp->ip4 : which == 1 ? &fp->ip6
+            : which == 2 ? &fp->deny4 : &fp->deny6;
+  t->child.assign(child, child + size_t(n_nodes) * 256);
+  t->info.assign(info, info + size_t(n_nodes) * 256);
+  t->levels = levels;
+  t->loaded = true;
+}
+
+void nf_ct_flush(void* h) { static_cast<Fastpath*>(h)->ct.flush(); }
+
+// addr: n * stride bytes (stride 4 = v4, 16 = v6), big-endian address
+// bytes (the trie's walk order). sports may be null (disables CT).
+void nf_eval_batch(void* h, int64_t n, const uint8_t* addr, int stride,
+                   const int32_t* ep_idx, const int32_t* dport,
+                   const int32_t* proto, const int32_t* sport,
+                   uint8_t ingress, int8_t* verdict_out,
+                   uint8_t* redirect_out) {
+  auto* fp = static_cast<Fastpath*>(h);
+  const bool v6 = stride == 16;
+  const Trie& ip = v6 ? fp->ip6 : fp->ip4;
+  const Trie& deny = v6 ? fp->deny6 : fp->deny4;
+  const bool use_ct = fp->ct_enabled && sport != nullptr;
+  const double now = use_ct ? now_s() : 0.0;
+  const uint32_t dir = ingress ? 0u : 1u;
+
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* a = addr + size_t(i) * stride;
+    uint64_t ct_a = 0, ct_b = 0, ct_c = 0;
+    if (use_ct) {
+      // pack_keys layout (datapath/conntrack.py)
+      if (v6) {
+        for (int k = 0; k < 8; ++k) ct_a = (ct_a << 8) | a[k];
+        for (int k = 8; k < 16; ++k) ct_b = (ct_b << 8) | a[k];
+      } else {
+        ct_b = (uint64_t(a[0]) << 24) | (uint64_t(a[1]) << 16) |
+               (uint64_t(a[2]) << 8) | a[3];
+      }
+      ct_c = (uint64_t(ep_idx[i]) << 41) | (uint64_t(sport[i]) << 25) |
+             (uint64_t(dport[i]) << 9) | (uint64_t(proto[i]) << 1) | dir;
+      if (fp->ct.probe(ct_a, ct_b, ct_c, now)) {
+        verdict_out[i] = FORWARD;
+        redirect_out[i] = 0;
+        if (uint32_t(ep_idx[i]) < fp->ep_count)
+          fp->counters[size_t(ep_idx[i]) * 3]++;
+        continue;
+      }
+    }
+    int8_t v;
+    uint8_t red = 0;
+    if (ingress && deny.loaded && deny.lookup(a) > 0) {
+      v = DROP_PREFILTER;
+    } else {
+      int32_t hit = ip.loaded ? ip.lookup(a) : 0;
+      uint64_t ident = hit > 0 ? uint64_t(hit - 1) : fp->world_identity;
+      // __policy_can_access probe order (bpf/lib/policy.h:46):
+      // exact {id,dport,proto} -> L3-only {id} -> L4-only {dport,proto}
+      int val = fp->policy.find(
+          ident, policy_kb(uint32_t(ep_idx[i]), uint32_t(dport[i]),
+                           uint32_t(proto[i]), dir));
+      if (val < 0)
+        val = fp->policy.find(ident,
+                              policy_kb(uint32_t(ep_idx[i]), 0, 0, dir));
+      if (val < 0)
+        val = fp->policy.find(
+            0, policy_kb(uint32_t(ep_idx[i]), uint32_t(dport[i]),
+                         uint32_t(proto[i]), dir));
+      if (val > 0) {
+        v = FORWARD;
+        red = (val == 2);
+        if (use_ct && !red) fp->ct.insert(ct_a, ct_b, ct_c, now);
+      } else {
+        v = DROP_POLICY;
+      }
+    }
+    verdict_out[i] = v;
+    redirect_out[i] = red;
+    if (uint32_t(ep_idx[i]) < fp->ep_count) {
+      int cls = v == FORWARD ? 0 : v == DROP_POLICY ? 1 : 2;
+      fp->counters[size_t(ep_idx[i]) * 3 + cls]++;
+    }
+  }
+}
+
+void nf_counters(void* h, int64_t* out) {
+  auto* fp = static_cast<Fastpath*>(h);
+  std::memcpy(out, fp->counters.data(),
+              fp->counters.size() * sizeof(int64_t));
+}
+
+}  // extern "C"

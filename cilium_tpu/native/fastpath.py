@@ -1,0 +1,198 @@
+"""ctypes wrapper: the native enforcement front-end.
+
+Consumes the SAME compiled state the device pipeline materializes —
+per-endpoint policymap snapshots (ops/materialize.py) and the
+ipcache/prefilter prefixes — and answers flow batches entirely in
+native code: conntrack probe, deny LPM, identity LPM, 3-step
+policymap lookup, per-endpoint counters. This is the SURVEY native
+census item 1: the eBPF datapath role, re-hosted as a userspace C++
+library fed by TPU-computed policy tensors. The device pipeline stays
+the batch/cold path and the source of truth; this front-end is the
+per-node enforcement loop a non-Python dataplane embeds.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..ops.lpm import TrieBuilder, ipv4_to_bytes
+from ..ops.materialize import TRAFFIC_INGRESS
+from . import build as _build
+
+FORWARD = 1
+DROP_POLICY = 2
+DROP_PREFILTER = 3
+
+_WHICH_IP4, _WHICH_IP6, _WHICH_DENY4, _WHICH_DENY6 = 0, 1, 2, 3
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeFastpath:
+    """One loaded enforcement state (policy + tries + CT)."""
+
+    def __init__(self, ep_count: int, ct_bits: int = 18) -> None:
+        self._lib = _build.load()
+        self._h = self._lib.nf_create(ep_count, ct_bits)
+        self.ep_count = ep_count
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.nf_destroy(h)
+            self._h = None
+
+    # -- loading --------------------------------------------------------
+    def set_world_identity(self, identity: int) -> None:
+        self._lib.nf_set_world(self._h, identity)
+
+    def load_policy_snapshots(self, snapshots: Sequence) -> int:
+        """Load per-endpoint EndpointPolicySnapshot dicts (the
+        realized policymap the TPU materialization produced); snapshot
+        order defines the endpoint index, matching the pipeline."""
+        idents, eps, dports, protos, dirs, reds = [], [], [], [], [], []
+        for ep_idx, snap in enumerate(snapshots):
+            for key, red in snap.entries.items():
+                idents.append(key.identity)
+                eps.append(ep_idx)
+                dports.append(key.dport)
+                protos.append(key.nexthdr)
+                dirs.append(key.direction)
+                reds.append(1 if red else 0)
+        n = len(idents)
+        identity = np.asarray(idents, np.uint64)
+        ep = np.asarray(eps, np.uint32)
+        dport = np.asarray(dports, np.uint32)
+        proto = np.asarray(protos, np.uint32)
+        dir_ = np.asarray(dirs, np.uint32)
+        red = np.asarray(reds, np.uint8)
+        return int(self._lib.nf_load_policy(
+            self._h, n,
+            _ptr(identity, ctypes.c_uint64), _ptr(ep, ctypes.c_uint32),
+            _ptr(dport, ctypes.c_uint32), _ptr(proto, ctypes.c_uint32),
+            _ptr(dir_, ctypes.c_uint32), _ptr(red, ctypes.c_uint8),
+        ))
+
+    def _load_trie(self, which: int, prefixes, levels: int) -> None:
+        """prefixes: iterable of (cidr_string, value)."""
+        import ipaddress
+
+        tb = TrieBuilder(levels)
+        for cidr, value in prefixes:
+            net = ipaddress.ip_network(cidr, strict=False)
+            tb.insert(net.network_address.packed, net.prefixlen, int(value))
+        child, info = tb.arrays()
+        child = np.ascontiguousarray(child, np.int32)
+        info = np.ascontiguousarray(info, np.int32)
+        self._lib.nf_load_trie(
+            self._h, which, _ptr(child, ctypes.c_int32),
+            _ptr(info, ctypes.c_int32), child.shape[0], levels,
+        )
+
+    def load_ipcache(self, ipcache) -> None:
+        """IP→IDENTITY tries from the authoritative ipcache (values are
+        identities, not device rows — this table is standalone)."""
+        v4 = [(c, e.identity) for c, e in ipcache.items() if ":" not in c]
+        v6 = [(c, e.identity) for c, e in ipcache.items() if ":" in c]
+        self._load_trie(_WHICH_IP4, v4, 4)
+        if v6:
+            self._load_trie(_WHICH_IP6, v6, 16)
+
+    def load_prefilter(self, prefilter) -> None:
+        _, cidrs = prefilter.dump()
+        v4 = [(c, 1) for c in cidrs if ":" not in c]
+        v6 = [(c, 1) for c in cidrs if ":" in c]
+        if v4:
+            self._load_trie(_WHICH_DENY4, v4, 4)
+        if v6:
+            self._load_trie(_WHICH_DENY6, v6, 16)
+
+    def ct_flush(self) -> None:
+        self._lib.nf_ct_flush(self._h)
+
+    # -- evaluation -----------------------------------------------------
+    def process(
+        self,
+        src_ips: np.ndarray,  # [B] uint32 IPv4 peer addresses
+        ep_idx: np.ndarray,
+        dports: np.ndarray,
+        protos: np.ndarray,
+        *,
+        ingress: bool = True,
+        sports: Optional[np.ndarray] = None,
+    ):
+        """Same contract as DatapathPipeline.process → (verdict int8,
+        redirect bool)."""
+        peer = np.ascontiguousarray(
+            ipv4_to_bytes(np.asarray(src_ips)), np.uint8
+        )
+        return self._eval(peer, 4, ep_idx, dports, protos, sports, ingress)
+
+    def process_v6(
+        self, peer_bytes: np.ndarray, ep_idx, dports, protos,
+        *, ingress: bool = True, sports=None,
+    ):
+        peer = np.ascontiguousarray(peer_bytes, np.uint8)
+        return self._eval(peer, 16, ep_idx, dports, protos, sports, ingress)
+
+    def _eval(self, peer, stride, ep_idx, dports, protos, sports, ingress):
+        n = peer.shape[0]
+        ep_idx = np.ascontiguousarray(ep_idx, np.int32)
+        dports = np.ascontiguousarray(dports, np.int32)
+        protos = np.ascontiguousarray(protos, np.int32)
+        verdict = np.empty(n, np.int8)
+        redirect = np.empty(n, np.uint8)
+        sp = (
+            None if sports is None
+            else np.ascontiguousarray(sports, np.int32)
+        )
+        self._lib.nf_eval_batch(
+            self._h, n, _ptr(peer, ctypes.c_uint8), stride,
+            _ptr(ep_idx, ctypes.c_int32), _ptr(dports, ctypes.c_int32),
+            _ptr(protos, ctypes.c_int32),
+            None if sp is None else _ptr(sp, ctypes.c_int32),
+            1 if ingress else 0,
+            _ptr(verdict, ctypes.c_int8), _ptr(redirect, ctypes.c_uint8),
+        )
+        return verdict, redirect.astype(bool)
+
+    @property
+    def counters(self) -> np.ndarray:
+        out = np.zeros(max(1, self.ep_count) * 3, np.int64)
+        self._lib.nf_counters(self._h, _ptr(out, ctypes.c_int64))
+        return out.reshape(-1, 3)
+
+    # -- convenience ----------------------------------------------------
+    @classmethod
+    def from_pipeline(
+        cls, pipeline, *, ingress: bool = True, ct_bits: int = 18
+    ) -> "NativeFastpath":
+        """Snapshot a DatapathPipeline's realized state into a native
+        front-end (both directions are loaded; `ingress` only selects
+        which snapshot list defines endpoint order — they share it)."""
+        from ..identity.model import ID_WORLD
+        from ..ops.materialize import TRAFFIC_EGRESS
+
+        pipeline.rebuild()
+        ing = pipeline._mat[TRAFFIC_INGRESS].snapshots
+        eg = pipeline._mat[TRAFFIC_EGRESS].snapshots
+        nf = cls(ep_count=len(ing), ct_bits=ct_bits)
+        nf.set_world_identity(ID_WORLD)
+        # both directions share endpoint indices; merge entry dicts
+        merged = []
+        for a, b in zip(ing, eg):
+            class _Snap:  # minimal duck type for load_policy_snapshots
+                pass
+
+            s = _Snap()
+            s.entries = {**a.entries, **b.entries}
+            merged.append(s)
+        nf.load_policy_snapshots(merged)
+        nf.load_ipcache(pipeline.ipcache)
+        nf.load_prefilter(pipeline.prefilter)
+        return nf

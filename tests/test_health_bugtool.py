@@ -67,6 +67,45 @@ class TestProber:
         p.probe_once()
         assert p.report() == {"nodes": [], "reachable": 0, "total": 0}
 
+    def test_restartable_after_stop(self):
+        import threading
+
+        reg = FakeRegistry([Node(name="n1", ipv4="10.0.1.1")])
+        fired = threading.Event()
+
+        def probe(a, q):
+            fired.set()
+            return 0.001
+
+        p = HealthProber(nodes=reg, probe=probe)
+        p.start(interval=30)
+        assert fired.wait(5)  # immediate first sweep
+        p.stop()
+        fired.clear()
+        p.start(interval=30)  # must clear the stop event
+        assert fired.wait(5)
+        p.stop()
+
+    def test_attach_registry_starts_prober(self):
+        from cilium_tpu.daemon import Daemon
+
+        d = Daemon(health_probe=lambda a, p: 0.001)
+        d.attach_node_registry(
+            FakeRegistry([Node(name="peer", ipv4="10.0.9.9")]),
+            probe_interval=30,
+        )
+        try:
+            import time
+
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if d.health_report()["total"] == 1:
+                    break
+                time.sleep(0.05)
+            assert d.health_report()["total"] == 1
+        finally:
+            d.shutdown()
+
 
 RULES = [{
     "endpointSelector": {"matchLabels": {"k8s:app": "web"}},

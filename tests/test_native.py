@@ -1,0 +1,171 @@
+"""Native C++ front-end: differential parity vs the device pipeline.
+
+The native evaluator (cilium_tpu/native) must produce bit-identical
+verdicts to DatapathPipeline for the same loaded state — the same
+oracle-vs-device discipline the repo uses for the TPU path, applied to
+the C++ path. Reference analog: the kernel verifier + unit-test.c
+harness for bpf/ (SURVEY §4 tier 3).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cilium_tpu.datapath.pipeline import (
+    DROP_POLICY,
+    DROP_PREFILTER,
+    FORWARD,
+    DatapathPipeline,
+)
+from cilium_tpu.engine import PolicyEngine
+from cilium_tpu.identity import IdentityRegistry
+from cilium_tpu.ipcache.ipcache import IPCache
+from cilium_tpu.ipcache.prefilter import PreFilter
+from cilium_tpu.labels import parse_label_array
+from cilium_tpu.native import NativeFastpath, native_available
+from cilium_tpu.ops.lpm import ip_strings_to_u32, ipv6_to_bytes
+from cilium_tpu.policy.api import (
+    EgressRule,
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    rule,
+)
+from cilium_tpu.policy.repository import Repository
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+
+def _world():
+    repo = Repository()
+    repo.add_list([
+        rule(
+            ["k8s:app=web"],
+            ingress=[IngressRule(
+                from_endpoints=(EndpointSelector.make(["k8s:app=lb"]),),
+                to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),)),),
+            )],
+            egress=[EgressRule(
+                to_endpoints=(EndpointSelector.make(["k8s:app=db"]),),
+                to_ports=(PortRule(ports=(PortProtocol(5432, "TCP"),)),),
+            )],
+            labels=["k8s:policy=n0"],
+        ),
+        rule(
+            ["k8s:app=db"],
+            ingress=[IngressRule(
+                from_endpoints=(EndpointSelector.make(["k8s:app=web"]),),
+            )],
+            labels=["k8s:policy=n1"],
+        ),
+    ])
+    reg = IdentityRegistry()
+    web = reg.allocate(parse_label_array(["k8s:app=web"]))
+    lb = reg.allocate(parse_label_array(["k8s:app=lb"]))
+    db = reg.allocate(parse_label_array(["k8s:app=db"]))
+    other = reg.allocate(parse_label_array(["k8s:app=other"]))
+    cache = IPCache()
+    cache.upsert("10.0.0.2/32", lb.id, source="k8s")
+    cache.upsert("10.0.0.3/32", db.id, source="k8s")
+    cache.upsert("10.0.0.4/32", other.id, source="k8s")
+    cache.upsert("10.1.0.0/16", lb.id, source="k8s")  # broader prefix
+    cache.upsert("fd00::2/128", lb.id, source="k8s")
+    pf = PreFilter()
+    pf.insert(pf.revision, ["192.0.2.0/24", "2001:db8::/32"])
+    pipe = DatapathPipeline(PolicyEngine(repo, reg), cache, pf)
+    pipe.set_endpoints([web.id, db.id])
+    return pipe, dict(web=web, lb=lb, db=db, other=other)
+
+
+def _random_flows(n, seed=0):
+    rng = np.random.default_rng(seed)
+    # mix of known IPs, the broad prefix, prefiltered, and unknown
+    pool = ip_strings_to_u32([
+        "10.0.0.2", "10.0.0.3", "10.0.0.4", "10.1.7.9", "192.0.2.55",
+        "8.8.8.8",
+    ])
+    ips = pool[rng.integers(0, len(pool), n)].astype(np.uint32)
+    eps = rng.integers(0, 2, n).astype(np.int32)
+    dports = rng.choice([80, 443, 5432, 53], n).astype(np.int32)
+    protos = rng.choice([6, 17], n).astype(np.int32)
+    return ips, eps, dports, protos
+
+
+class TestParity:
+    def test_ingress_parity(self):
+        pipe, ids = _world()
+        nf = NativeFastpath.from_pipeline(pipe, ct_bits=0)
+        ips, eps, dports, protos = _random_flows(512)
+        pv, pr = pipe.process(ips, eps, dports, protos, ingress=True)
+        nv, nr = nf.process(ips, eps, dports, protos, ingress=True)
+        assert np.array_equal(pv, nv)
+        assert np.array_equal(pr, nr)
+        # sanity: the batch exercised every verdict class
+        assert {FORWARD, DROP_POLICY, DROP_PREFILTER} <= set(pv.tolist())
+
+    def test_egress_parity(self):
+        pipe, ids = _world()
+        nf = NativeFastpath.from_pipeline(pipe, ct_bits=0)
+        ips, eps, dports, protos = _random_flows(512, seed=1)
+        pv, pr = pipe.process(ips, eps, dports, protos, ingress=False)
+        nv, nr = nf.process(ips, eps, dports, protos, ingress=False)
+        assert np.array_equal(pv, nv) and np.array_equal(pr, nr)
+
+    def test_v6_parity(self):
+        pipe, ids = _world()
+        nf = NativeFastpath.from_pipeline(pipe, ct_bits=0)
+        peers = ipv6_to_bytes(
+            ["fd00::2", "2001:db8::9", "fd00::99"] * 10
+        ).astype(np.int32)
+        n = peers.shape[0]
+        eps = np.zeros(n, np.int32)
+        dports = np.full(n, 80, np.int32)
+        protos = np.full(n, 6, np.int32)
+        pv, _ = pipe.process_v6(peers, eps, dports, protos, ingress=True)
+        nv, _ = nf.process_v6(peers, eps, dports, protos, ingress=True)
+        assert np.array_equal(pv, nv)
+        assert set(pv.tolist()) == {FORWARD, DROP_PREFILTER, DROP_POLICY}
+
+
+class TestConntrack:
+    def test_established_bypass_and_counters(self):
+        pipe, ids = _world()
+        nf = NativeFastpath.from_pipeline(pipe, ct_bits=12)
+        ips = ip_strings_to_u32(["10.0.0.2"])
+        args = (ips, np.zeros(1, np.int32), np.array([80], np.int32),
+                np.array([6], np.int32))
+        v1, _ = nf.process(*args, sports=np.array([5555]))
+        v2, _ = nf.process(*args, sports=np.array([5555]))
+        assert v1.tolist() == [FORWARD] and v2.tolist() == [FORWARD]
+        assert nf.counters[0, 0] == 2  # both forwarded
+        # flush → next packet re-verdicts (still allowed)
+        nf.ct_flush()
+        v3, _ = nf.process(*args, sports=np.array([5555]))
+        assert v3.tolist() == [FORWARD]
+
+    def test_denied_flow_never_cached(self):
+        pipe, ids = _world()
+        nf = NativeFastpath.from_pipeline(pipe, ct_bits=12)
+        ips = ip_strings_to_u32(["10.0.0.4"])
+        args = (ips, np.zeros(1, np.int32), np.array([80], np.int32),
+                np.array([6], np.int32))
+        for _ in range(3):
+            v, _ = nf.process(*args, sports=np.array([6666]))
+            assert v.tolist() == [DROP_POLICY]
+        assert nf.counters[0, 1] == 3
+
+
+class TestLoader:
+    def test_policy_row_count(self):
+        pipe, ids = _world()
+        pipe.rebuild()
+        from cilium_tpu.ops.materialize import TRAFFIC_INGRESS
+
+        snaps = pipe._mat[TRAFFIC_INGRESS].snapshots
+        nf = NativeFastpath(ep_count=len(snaps), ct_bits=0)
+        n = nf.load_policy_snapshots(snaps)
+        assert n == sum(len(s.entries) for s in snaps) and n > 0
