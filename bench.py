@@ -130,6 +130,114 @@ def _bench_rule_update(engine, repo, rng) -> float:
     return sorted(samples)[len(samples) // 2] * 1000
 
 
+def _bench_lpm_50k(nrng: np.random.Generator) -> float:
+    """50k-prefix LPM match rate (BASELINE.md north-star: the ipcache
+    identity-derivation stage at production prefix counts,
+    bpf/node_config.h IPCACHE_MAP_SIZE envelope)."""
+    from cilium_tpu.ops.lpm import TrieBuilder, ipv4_to_bytes, lpm_lookup
+
+    tb = TrieBuilder(4)
+    addrs = nrng.integers(0, 2**32, 50_000, dtype=np.uint64).astype(np.uint32)
+    plens = nrng.choice(np.array([8, 12, 16, 20, 24, 28, 32]), 50_000)
+    for a, pl in zip(addrs.tolist(), plens.tolist()):
+        a &= (0xFFFFFFFF << (32 - pl)) & 0xFFFFFFFF
+        tb.insert(a.to_bytes(4, "big"), pl, a % 65000)
+    child, info = tb.arrays()
+    child_j, info_j = jnp.asarray(child), jnp.asarray(info)
+    b = 1 << 20
+    q = jnp.asarray(
+        ipv4_to_bytes(nrng.integers(0, 2**32, b, dtype=np.uint64).astype(np.uint32))
+    )
+    r = lpm_lookup(child_j, info_j, q, levels=4)
+    jax.block_until_ready(r)
+    iters = 10
+    t0 = time.time()
+    for _ in range(iters):
+        r = lpm_lookup(child_j, info_j, q, levels=4)
+    jax.block_until_ready(r)
+    return iters * b / (time.time() - t0)
+
+
+def _bench_l7_dfa() -> float:
+    """HTTP multi-pattern DFA request rate (the NPDS regex matcher,
+    envoy/cilium_network_policy.h:68-202, as one device dispatch)."""
+    from cilium_tpu.l7.regex_compile import compile_patterns
+    from cilium_tpu.ops.dfa import device_dfa, dfa_match_batch, strings_to_batch
+
+    patterns = [f"/api/v{i}/[a-z0-9]*" for i in range(8)] + [
+        f"/svc{i}/.*" for i in range(8)
+    ]
+    dev = device_dfa(compile_patterns(patterns))
+    b = 1 << 17
+    paths = [f"/api/v{i % 8}/obj{i % 97}".encode() for i in range(b)]
+    sb, lens = strings_to_batch(paths, 64)
+    sbj, lj = jnp.asarray(sb), jnp.asarray(lens)
+    lo, hi = dfa_match_batch(*dev, sbj, lj, 64)
+    jax.block_until_ready(lo)
+    iters = 10
+    t0 = time.time()
+    for _ in range(iters):
+        lo, hi = dfa_match_batch(*dev, sbj, lj, 64)
+    jax.block_until_ready(lo)
+    return iters * b / (time.time() - t0)
+
+
+def _bench_kafka_acl() -> float:
+    """Kafka ACL batch rate (pkg/kafka/policy.go MatchesRule hoisted to
+    broadcast compares)."""
+    from cilium_tpu.l7.kafka_policy import KafkaACL, KafkaRequest
+    from cilium_tpu.policy.api import KafkaRule
+
+    acl = KafkaACL(
+        [(KafkaRule(role="produce", topic=f"t{i}"), None) for i in range(32)]
+    )
+    reqs = [
+        KafkaRequest(api_key=0, api_version=2, client_id="c", topic=f"t{i % 48}")
+        for i in range(100_000)
+    ]
+    acl.check_batch(reqs[:1000])
+    iters = 5
+    t0 = time.time()
+    for _ in range(iters):
+        acl.check_batch(reqs)
+    return iters * len(reqs) / (time.time() - t0)
+
+
+def _bench_native(snaps, idents, nrng: np.random.Generator) -> float:
+    """Native C++ front-end rate on the SAME materialized state (the
+    per-node enforcement loop; SURVEY native census item 1)."""
+    from cilium_tpu.identity.model import ID_WORLD
+    from cilium_tpu.ipcache.ipcache import IPCache
+    from cilium_tpu.native import NativeFastpath, native_available
+
+    if not native_available():
+        return 0.0
+    cache = IPCache()
+    for i, ident in enumerate(idents):
+        cache.upsert(f"10.{(i >> 8) & 255}.{i & 255}.1/32", ident.id, source="k8s")
+    nf = NativeFastpath(ep_count=N_ENDPOINTS, ct_bits=0)
+    nf.set_world_identity(ID_WORLD)
+    nf.load_policy_snapshots(snaps)
+    nf.load_ipcache(cache)
+    b = 1 << 20
+    i_sel = nrng.integers(0, len(idents), b)
+    ips = (
+        np.uint32(10) << 24
+        | ((i_sel >> 8) & 255).astype(np.uint32) << 16
+        | (i_sel & 255).astype(np.uint32) << 8
+        | 1
+    ).astype(np.uint32)
+    eps = nrng.integers(0, N_ENDPOINTS, b).astype(np.int32)
+    dports = nrng.choice(np.array([80, 443, 8080, 53, 22], np.int32), b)
+    protos = np.where(dports == 53, 17, 6).astype(np.int32)
+    nf.process(ips[:1000], eps[:1000], dports[:1000], protos[:1000])
+    iters = 5
+    t0 = time.time()
+    for _ in range(iters):
+        nf.process(ips, eps, dports, protos)
+    return iters * b / (time.time() - t0)
+
+
 def _bench_dispatch_rtt() -> float:
     """Median blocking round trip for a trivial pre-compiled dispatch —
     the environment's latency floor for ANY blocking device update
@@ -163,7 +271,7 @@ def main() -> None:
     tables, _snaps = materialize_endpoints(
         compiled, engine.device_policy, ep_ids, ingress=True
     )
-    jax.block_until_ready(tables.id_allow)
+    jax.block_until_ready(tables.id_bits)
     t_mat = time.time() - t0
 
     # Flow batch (fixed device arrays; realistic mixed ports).
@@ -212,6 +320,24 @@ def main() -> None:
     update_rule_ms = _bench_rule_update(engine, repo, rng)
     dispatch_rtt_ms = _bench_dispatch_rtt()
 
+    # ── the other north-star configs (BASELINE.md): LPM at 50k
+    # prefixes, L7 DFA request rate, Kafka ACL batch rate, plus the
+    # native C++ front-end on the same realized state, and a warm full
+    # re-materialization (the rebuild path rule deletion takes).
+    extra = os.environ.get("BENCH_EXTRA", "1") != "0"
+    lpm50k = _bench_lpm_50k(np.random.default_rng(3)) if extra else 0.0
+    l7_dfa = _bench_l7_dfa() if extra else 0.0
+    kafka_acl = _bench_kafka_acl() if extra else 0.0
+    native_vps = (
+        _bench_native(_snaps, idents, np.random.default_rng(5)) if extra else 0.0
+    )
+    t0 = time.time()
+    tables2, _ = materialize_endpoints(
+        compiled, engine.device_policy, ep_ids, ingress=True
+    )
+    jax.block_until_ready(tables2.id_bits)
+    rebuild_warm_s = time.time() - t0
+
     allow_frac = float(jnp.mean((dec == 1).astype(jnp.float32)))
     result = {
         "metric": f"policymap verdicts/sec at {N_RULES} rules",
@@ -221,6 +347,11 @@ def main() -> None:
         "p99_us": round(p99_us, 2),
         "update_ident_ms": round(update_ident_ms, 1),
         "update_rule_ms": round(update_rule_ms, 1),
+        "lpm50k_lps": round(lpm50k),
+        "l7_dfa_rps": round(l7_dfa),
+        "kafka_acl_rps": round(kafka_acl),
+        "native_vps": round(native_vps),
+        "rebuild_warm_s": round(rebuild_warm_s, 2),
     }
     print(json.dumps(result))
     print(

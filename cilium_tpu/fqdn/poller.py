@@ -146,9 +146,12 @@ class DNSPoller:
             return
 
         log = logging.getLogger("cilium_tpu.fqdn")
+        # fresh Event per loop (see health/prober.py start): a restart
+        # after a timed-out join must not revive the old thread
+        self._stop = stop_ev = threading.Event()
 
         def loop():
-            while not self._stop.wait(interval):
+            while not stop_ev.wait(interval):
                 try:
                     self.poll_once()
                     self.failures = 0

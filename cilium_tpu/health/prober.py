@@ -149,7 +149,10 @@ class HealthProber:
     def start(self, interval: float = DEFAULT_INTERVAL) -> None:
         if self._thread is not None:
             return
-        self._stop.clear()  # restartable after stop()
+        # fresh Event per loop: restart after a timed-out join must
+        # not revive the old thread (it keeps watching ITS event,
+        # which stays set forever, and exits at its next check)
+        self._stop = stop_ev = threading.Event()
 
         def loop():
             # initial sweep at launch (the reference probes immediately,
@@ -160,7 +163,7 @@ class HealthProber:
                     self.probe_once()
                 except Exception:
                     pass  # a registry hiccup must not kill the prober
-                if self._stop.wait(interval):
+                if stop_ev.wait(interval):
                     return
 
         self._thread = threading.Thread(target=loop, daemon=True)

@@ -16,9 +16,14 @@ import tempfile
 from typing import Optional
 
 _SRC = os.path.join(os.path.dirname(__file__), "fastpath.cpp")
+# per-user 0700 cache dir: the path is predictable, so a shared dir
+# would let another local user pre-plant a .so at the known hash path
+# and get code into our process at dlopen
 _CACHE_DIR = os.environ.get(
     "CILIUM_TPU_NATIVE_CACHE",
-    os.path.join(tempfile.gettempdir(), "cilium_tpu_native"),
+    os.path.join(
+        tempfile.gettempdir(), f"cilium_tpu_native_{os.getuid()}"
+    ),
 )
 
 _lib: Optional[ctypes.CDLL] = None
@@ -31,12 +36,23 @@ def _so_path() -> str:
     return os.path.join(_CACHE_DIR, f"fastpath_{digest}.so")
 
 
+def _check_owned(path: str) -> bool:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return False
+    return st.st_uid == os.getuid()
+
+
 def build() -> str:
-    """Compile (cached by source hash) → .so path."""
+    """Compile (cached by source hash) → .so path. A cached .so is
+    trusted only if we own it — never dlopen another user's file."""
     so = _so_path()
-    if os.path.exists(so):
+    if os.path.exists(so) and _check_owned(so):
         return so
-    os.makedirs(_CACHE_DIR, exist_ok=True)
+    os.makedirs(_CACHE_DIR, mode=0o700, exist_ok=True)
+    if not _check_owned(_CACHE_DIR):
+        raise RuntimeError(f"native cache dir {_CACHE_DIR} not owned by us")
     tmp = so + f".tmp.{os.getpid()}"
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",

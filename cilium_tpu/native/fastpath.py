@@ -54,7 +54,12 @@ class NativeFastpath:
     def load_policy_snapshots(self, snapshots: Sequence) -> int:
         """Load per-endpoint EndpointPolicySnapshot dicts (the
         realized policymap the TPU materialization produced); snapshot
-        order defines the endpoint index, matching the pipeline."""
+        order defines the endpoint index, matching the pipeline.
+        Raises if the C++ table dropped any entry (a dropped allow
+        would silently misenforce). Any load flushes conntrack — the
+        established-flow bypass is only sound while the verdict basis
+        that admitted the flow still holds (same invariant as
+        DatapathPipeline.rebuild)."""
         idents, eps, dports, protos, dirs, reds = [], [], [], [], [], []
         for ep_idx, snap in enumerate(snapshots):
             for key, red in snap.entries.items():
@@ -71,12 +76,19 @@ class NativeFastpath:
         proto = np.asarray(protos, np.uint32)
         dir_ = np.asarray(dirs, np.uint32)
         red = np.asarray(reds, np.uint8)
-        return int(self._lib.nf_load_policy(
+        loaded = int(self._lib.nf_load_policy(
             self._h, n,
             _ptr(identity, ctypes.c_uint64), _ptr(ep, ctypes.c_uint32),
             _ptr(dport, ctypes.c_uint32), _ptr(proto, ctypes.c_uint32),
             _ptr(dir_, ctypes.c_uint32), _ptr(red, ctypes.c_uint8),
         ))
+        if loaded != n:
+            raise RuntimeError(
+                f"native policy table dropped {n - loaded} of {n} entries "
+                "(hash neighborhood overflow)"
+            )
+        self.ct_flush()
+        return loaded
 
     def _load_trie(self, which: int, prefixes, levels: int) -> None:
         """prefixes: iterable of (cidr_string, value)."""
@@ -96,21 +108,23 @@ class NativeFastpath:
 
     def load_ipcache(self, ipcache) -> None:
         """IP→IDENTITY tries from the authoritative ipcache (values are
-        identities, not device rows — this table is standalone)."""
+        identities, not device rows — this table is standalone).
+        Empty lists STILL load (an empty trie): a reload that removed
+        the last entry must not leave the previous trie enforcing
+        stale mappings. Flushes conntrack (verdict basis moved)."""
         v4 = [(c, e.identity) for c, e in ipcache.items() if ":" not in c]
         v6 = [(c, e.identity) for c, e in ipcache.items() if ":" in c]
         self._load_trie(_WHICH_IP4, v4, 4)
-        if v6:
-            self._load_trie(_WHICH_IP6, v6, 16)
+        self._load_trie(_WHICH_IP6, v6, 16)
+        self.ct_flush()
 
     def load_prefilter(self, prefilter) -> None:
         _, cidrs = prefilter.dump()
         v4 = [(c, 1) for c in cidrs if ":" not in c]
         v6 = [(c, 1) for c in cidrs if ":" in c]
-        if v4:
-            self._load_trie(_WHICH_DENY4, v4, 4)
-        if v6:
-            self._load_trie(_WHICH_DENY6, v6, 16)
+        self._load_trie(_WHICH_DENY4, v4, 4)
+        self._load_trie(_WHICH_DENY6, v6, 16)
+        self.ct_flush()
 
     def ct_flush(self) -> None:
         self._lib.nf_ct_flush(self._h)
@@ -181,17 +195,17 @@ class NativeFastpath:
         pipeline.rebuild()
         ing = pipeline._mat[TRAFFIC_INGRESS].snapshots
         eg = pipeline._mat[TRAFFIC_EGRESS].snapshots
+        from ..ops.materialize import EndpointPolicySnapshot
+
         nf = cls(ep_count=len(ing), ct_bits=ct_bits)
         nf.set_world_identity(ID_WORLD)
         # both directions share endpoint indices; merge entry dicts
-        merged = []
-        for a, b in zip(ing, eg):
-            class _Snap:  # minimal duck type for load_policy_snapshots
-                pass
-
-            s = _Snap()
-            s.entries = {**a.entries, **b.entries}
-            merged.append(s)
+        merged = [
+            EndpointPolicySnapshot(
+                entries={**a.entries, **b.entries}, slots=a.slots
+            )
+            for a, b in zip(ing, eg)
+        ]
         nf.load_policy_snapshots(merged)
         nf.load_ipcache(pipeline.ipcache)
         nf.load_prefilter(pipeline.prefilter)

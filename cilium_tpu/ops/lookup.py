@@ -8,14 +8,22 @@ policy is one column c, and each identity row carries a packed bitmap
 of the columns that allow it:
 
     col_ep/col_port/col_proto/col_is_l3  [C]      column metadata
-    id_allow / id_redirect               [N, C/32] uint32 per-identity bits
+    id_bits                              [N, 2·C/32] uint32:
+                                         allow words ‖ redirect words
 
 A flow verdict is ONE packed row-gather (embedding lookup on the src
-identity) + broadcast compares of its (endpoint, port, proto) against
-the column metadata — no hashing, no per-element gathers (serial on
-TPU), fully batched. Per-flow traffic is O(C) VPU ops with C = total
-policymap slots, which for realistic endpoint counts is bandwidth-,
-not compute-, bound.
+identity — XLA lowers small-N takes to a one-hot MXU matmul, which is
+why allow and redirect share a single combined table: one matmul
+instead of two, measured ~1.3× end-to-end) + broadcast compares of
+its (endpoint, port, proto) against the column metadata — no hashing,
+no per-element gathers (serial on TPU), fully batched. Per-flow
+traffic is O(C) VPU ops with C = total policymap slots, which for
+realistic endpoint counts is bandwidth-, not compute-, bound.
+
+(A per-endpoint segmented layout — gathering only the flow's
+endpoint's K columns from an [N·E, K] table — was prototyped and is
+~2.4× SLOWER: N·E rows push the gather off the one-hot-matmul path
+into true scalar gathers. Keep N small and the row wide.)
 """
 
 from __future__ import annotations
@@ -36,8 +44,17 @@ class PolicymapTables:
     col_port: jnp.ndarray  # [C] int32
     col_proto: jnp.ndarray  # [C] int32
     col_is_l3: jnp.ndarray  # [C] bool
-    id_allow: jnp.ndarray  # [N, C/32] uint32
-    id_redirect: jnp.ndarray  # [N, C/32] uint32
+    # combined per-identity bitmaps: [N, 2W] uint32, first W words =
+    # allow bits, last W = redirect bits (one gather serves both)
+    id_bits: jnp.ndarray
+
+    @property
+    def id_allow(self) -> jnp.ndarray:  # [N, C/32] uint32 view
+        return self.id_bits[:, : self.id_bits.shape[1] // 2]
+
+    @property
+    def id_redirect(self) -> jnp.ndarray:
+        return self.id_bits[:, self.id_bits.shape[1] // 2:]
 
 
 @functools.partial(jax.jit, static_argnames=("block",))
@@ -47,19 +64,21 @@ def lookup_batch(
     src_rows: jnp.ndarray,  # [B] int32 identity rows
     dport: jnp.ndarray,  # [B] int32
     proto: jnp.ndarray,  # [B] int32
-    block: int = 65536,
+    block: int = 16384,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """→ (decision[B] int8, redirect[B] bool)."""
     b = ep_idx.shape[0]
     pad = (-b) % block
+    w = t.id_bits.shape[1] // 2
 
     def pad1(x, fill=0):
         return jnp.pad(x, (0, pad), constant_values=fill).reshape(-1, block)
 
     def one(args):
         ep, port, prt, src = args
-        allow_bits = unpack_bits_u32(jnp.take(t.id_allow, src, axis=0)).astype(bool)
-        red_bits = unpack_bits_u32(jnp.take(t.id_redirect, src, axis=0)).astype(bool)
+        both = unpack_bits_u32(jnp.take(t.id_bits, src, axis=0)).astype(bool)
+        allow_bits = both[:, : w * 32]
+        red_bits = both[:, w * 32:]
         colsel = (ep[:, None] == t.col_ep[None, :]) & (
             t.col_is_l3[None, :]
             | (

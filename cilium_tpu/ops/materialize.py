@@ -209,8 +209,11 @@ def materialize_endpoints_state(
         col_port=jnp.asarray(np.pad(np.asarray(col_port, np.int32), (0, pad))),
         col_proto=jnp.asarray(np.pad(np.asarray(col_proto, np.int32), (0, pad))),
         col_is_l3=jnp.asarray(np.pad(np.asarray(col_is_l3, bool), (0, pad))),
-        id_allow=pack_bool_bits(jnp.asarray(allow_nc)),
-        id_redirect=pack_bool_bits(jnp.asarray(red_nc)),
+        # allow ‖ redirect in one table: the lookup kernel's row gather
+        # lowers to a single one-hot matmul serving both bitmaps
+        id_bits=pack_bool_bits(
+            jnp.asarray(np.concatenate([allow_nc, red_nc], axis=1))
+        ),
     )
     return MaterializedState(
         tables=tables,
@@ -227,13 +230,11 @@ def materialize_endpoints_state(
 
 @jax.jit
 def _patch_bitmap_rows(
-    id_allow: jnp.ndarray,
-    id_redirect: jnp.ndarray,
+    id_bits: jnp.ndarray,
     idx: jnp.ndarray,
-    allow_rows: jnp.ndarray,
-    red_rows: jnp.ndarray,
+    comb_rows: jnp.ndarray,
 ):
-    return id_allow.at[idx].set(allow_rows), id_redirect.at[idx].set(red_rows)
+    return id_bits.at[idx].set(comb_rows)
 
 
 def patch_identity_rows(
@@ -348,16 +349,13 @@ def patch_identity_rows(
                 seg_i += 1
 
     idx = np.asarray(rows, np.int32)
-    allow_rows = _pack_rows(state.allow_nc[idx])
-    red_rows = _pack_rows(state.red_nc[idx])
-    new_allow, new_red = _patch_bitmap_rows(
-        state.tables.id_allow,
-        state.tables.id_redirect,
-        jnp.asarray(idx),
-        jnp.asarray(allow_rows),
-        jnp.asarray(red_rows),
+    comb_rows = _pack_rows(
+        np.concatenate([state.allow_nc[idx], state.red_nc[idx]], axis=1)
     )
-    state.tables = state.tables.replace(id_allow=new_allow, id_redirect=new_red)
+    new_bits = _patch_bitmap_rows(
+        state.tables.id_bits, jnp.asarray(idx), jnp.asarray(comb_rows)
+    )
+    state.tables = state.tables.replace(id_bits=new_bits)
 
 
 def _pack_rows(rows_bool: np.ndarray) -> np.ndarray:

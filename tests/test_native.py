@@ -159,6 +159,40 @@ class TestConntrack:
         assert nf.counters[0, 1] == 3
 
 
+class TestReload:
+    def test_policy_reload_flushes_conntrack(self):
+        from cilium_tpu.ops.materialize import EndpointPolicySnapshot
+
+        pipe, ids = _world()
+        nf = NativeFastpath.from_pipeline(pipe, ct_bits=12)
+        ips = ip_strings_to_u32(["10.0.0.2"])
+        args = (ips, np.zeros(1, np.int32), np.array([80], np.int32),
+                np.array([6], np.int32))
+        v, _ = nf.process(*args, sports=np.array([7777]))
+        assert v.tolist() == [FORWARD]  # CT entry created
+        # revoke everything: the established flow must NOT keep its
+        # bypass across the load (verdict basis changed)
+        nf.load_policy_snapshots(
+            [EndpointPolicySnapshot(entries={}, slots=[]) for _ in range(2)]
+        )
+        v, _ = nf.process(*args, sports=np.array([7777]))
+        assert v.tolist() == [DROP_POLICY]
+
+    def test_empty_ipcache_reload_clears_trie(self):
+        from cilium_tpu.ipcache.ipcache import IPCache
+
+        pipe, ids = _world()
+        nf = NativeFastpath.from_pipeline(pipe, ct_bits=0)
+        ips = ip_strings_to_u32(["10.0.0.2"])
+        args = (ips, np.zeros(1, np.int32), np.array([80], np.int32),
+                np.array([6], np.int32))
+        v, _ = nf.process(*args)
+        assert v.tolist() == [FORWARD]
+        nf.load_ipcache(IPCache())  # all entries gone → world → deny
+        v, _ = nf.process(*args)
+        assert v.tolist() == [DROP_POLICY]
+
+
 class TestLoader:
     def test_policy_row_count(self):
         pipe, ids = _world()
