@@ -91,6 +91,12 @@ class APIClient:
     def identity_get(self, num: int):
         return self._request("GET", f"/identity/{num}")
 
+    def ipam_allocate(self, owner: str = ""):
+        return self._request("POST", "/ipam", {"owner": owner})
+
+    def ipam_release(self, ip: str):
+        return self._request("DELETE", f"/ipam/{ip}")
+
     def health(self):
         return self._request("GET", "/health")
 

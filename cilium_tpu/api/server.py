@@ -138,6 +138,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "identity not found"})
             else:
                 self._json(200, ident)
+        elif path == "/ipam" and method == "POST":
+            body = self._body() if self.headers.get("Content-Length") else {}
+            ip = d.ipam.allocate_next(owner=body.get("owner", ""))
+            self._json(201, {"ip": ip, "cidr": str(d.ipam.net)})
+        elif (m := re.fullmatch(r"/ipam/(.+)", path)) and method == "DELETE":
+            ok = d.ipam.release(m.group(1))
+            self._json(200 if ok else 404, {"released": ok})
         elif path == "/health" and method == "GET":
             self._json(200, d.health_report())
         elif path == "/health/probe" and method == "POST":

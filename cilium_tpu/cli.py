@@ -262,6 +262,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .api.server import APIServer
         from .daemon import Daemon
         from .monitor.server import MonitorServer
+        from .utils.logging import setup as logging_setup
+
+        logging_setup(os.environ.get("CILIUM_TPU_LOG_LEVEL", "info"))
 
         daemon = Daemon(
             state_dir=args.state, conntrack=not args.no_conntrack

@@ -27,6 +27,15 @@ from .endpoint.endpoint import Endpoint, EndpointState
 from .endpoint.manager import EndpointManager
 from .fqdn import DNSPoller, system_resolver
 from .health import HealthProber, tcp_probe
+from .ipam import IPAM
+from .maps.lxcmap import LXCMap
+from .maps.proxymap import ProxyMap
+from .maps.tunnel import TunnelMap
+from .utils.iputil import prefix_lengths_of
+from .utils.logging import get_logger
+from .utils.prefix_counter import PrefixLengthCounter
+
+log = get_logger("daemon")
 from .engine import PolicyEngine
 from .identity import IdentityRegistry
 from .ipcache.ipcache import IPCache, SOURCE_AGENT
@@ -63,6 +72,7 @@ class Daemon:
         dns_resolver=None,
         node_registry=None,
         health_probe=None,
+        pod_cidr: str = "10.200.0.0/16",
     ) -> None:
         self.state_dir = state_dir
         self.repo = Repository()
@@ -80,6 +90,19 @@ class Daemon:
         )
         self.endpoint_manager = EndpointManager()
         self.proxy = Proxy()
+        # datapath state maps (pkg/maps/{lxcmap,tunnel,proxymap})
+        self.ipam = IPAM(pod_cidr)
+        self.lxcmap = LXCMap()
+        self.tunnel = TunnelMap()
+        self.proxymap = ProxyMap()
+        # distinct CIDR prefix lengths in force (pkg/counter) — a new
+        # length forces a datapath trie rebuild (the compileBase
+        # trigger of daemon/policy.go:184-195)
+        self.prefix_lengths = PrefixLengthCounter()
+        # datapath redirect verdicts → proxymap entries (the
+        # cilium_proxy4/6 write of bpf_lxc.c; the L7 front-end reads
+        # them back to recover original destination + source identity)
+        self.pipeline.on_redirect = self._record_proxy_flow
         # serializes snapshot writers: API threads AND the background
         # DNS poller both reach save_state
         self._save_lock = threading.Lock()
@@ -114,6 +137,23 @@ class Daemon:
             os.makedirs(state_dir, exist_ok=True)
             self.restore_state()
 
+    @staticmethod
+    def _rule_cidrs(rules) -> List[str]:
+        """Every CIDR prefix a rule set installs (pkg/policy/cidr.go
+        GetCIDRPrefixes role) — CIDRRule exceptions expand into the
+        covering sub-prefixes the datapath actually materializes."""
+        from .policy.cidr import compute_resultant_cidr_set
+
+        out: List[str] = []
+        for r in rules:
+            for ing in r.ingress:
+                out.extend(ing.from_cidr)
+                out.extend(compute_resultant_cidr_set(ing.from_cidr_set))
+            for eg in r.egress:
+                out.extend(eg.to_cidr)
+                out.extend(compute_resultant_cidr_set(eg.to_cidr_set))
+        return out
+
     # -- policy ---------------------------------------------------------
     def policy_add(self, rules_json: str) -> Dict:
         """PUT /policy (daemon/policy.go PolicyAdd:167)."""
@@ -121,6 +161,8 @@ class Daemon:
         rev = self.repo.add_list(rules)
         self._regenerate("policy import")
         self.save_state()
+        log.info("policy imported",
+                 fields={"policyRevision": rev, "rules": len(rules)})
         return {"revision": rev, "count": len(rules)}
 
     def policy_get(self, labels: Optional[Sequence[str]] = None) -> Dict:
@@ -140,10 +182,10 @@ class Daemon:
 
     def policy_delete(self, labels: Sequence[str]) -> Dict:
         """DELETE /policy (daemon/policy.go PolicyDelete:253)."""
-        rev, n = self.repo.delete_by_labels(parse_label_array(labels))
+        rev, deleted = self.repo.take_by_labels(parse_label_array(labels))
         self._regenerate("policy delete")
         self.save_state()
-        return {"revision": rev, "deleted": n}
+        return {"revision": rev, "deleted": len(deleted)}
 
     def policy_translate(self, translator) -> Dict:
         """Re-translate imported rules against changed external state
@@ -254,6 +296,11 @@ class Daemon:
                           proxy=self.proxy)
         self.save_state()
         self.notify_agent("endpoint-created", f"endpoint {endpoint_id}")
+        log.info("endpoint created", fields={
+            "endpointID": endpoint_id,
+            "identity": ep.identity.id if ep.identity else 0,
+            "ipAddr": ipv4 or ipv6 or "",
+        })
         return self._endpoint_model(ep)
 
     def endpoint_delete(self, endpoint_id: int) -> bool:
@@ -271,6 +318,7 @@ class Daemon:
             self._sync_pipeline_endpoints()
         self.save_state()
         self.notify_agent("endpoint-deleted", f"endpoint {endpoint_id}")
+        log.info("endpoint deleted", fields={"endpointID": endpoint_id})
         return True
 
     def endpoint_list(self) -> List[Dict]:
@@ -293,6 +341,42 @@ class Daemon:
         self.pipeline.set_endpoints(
             [(ep.id, ep.identity.id) for ep in eps if ep.identity]
         )
+        self.lxcmap.sync_endpoints(eps)  # daemon.go:953 syncLXCMap
+
+    def _record_proxy_flow(
+        self, peer_addr: bytes, ep_idx: int, sport: int, dport: int,
+        proto: int, ingress: bool, family: int,
+    ) -> None:
+        """bpf_lxc.c proxymap insert on redirect verdicts: key the
+        redirected 5-tuple to its ORIGINAL destination + source
+        identity so the L7 front-end (envoy/cilium_bpf_metadata.cc
+        read side) knows where the connection was headed and who sent
+        it."""
+        import ipaddress as _ipa
+
+        from .maps.proxymap import ProxyValue
+
+        ep_id = self.pipeline.endpoint_id_at(ep_idx)
+        ep = self.endpoint_manager.lookup(ep_id) if ep_id is not None else None
+        ep_ip = (ep.ipv4 if family == 4 else ep.ipv6) if ep else None
+        peer_ip = str(_ipa.ip_address(peer_addr))
+        entry = self.ipcache.lookup_by_ip(peer_ip)
+        if ingress:
+            src_ip, src_port = peer_ip, sport
+            dst_ip, dst_port = ep_ip or "", dport
+            src_identity = entry.identity if entry else 0
+        else:
+            src_ip, src_port = ep_ip or "", sport
+            dst_ip, dst_port = peer_ip, dport
+            src_identity = ep.identity.id if ep and ep.identity else 0
+        self.proxymap.record(
+            src_ip, src_port, dst_ip, dst_port, proto,
+            ProxyValue(
+                orig_dst_ip=dst_ip,
+                orig_dst_port=dst_port,
+                src_identity=src_identity,
+            ),
+        )
 
     def notify_agent(self, kind: str, message: str) -> None:
         """AgentNotify on the monitor stream (pkg/monitor/agent.go)."""
@@ -300,6 +384,12 @@ class Daemon:
             self.monitor.publish(AgentNotify(kind=kind, message=message))
 
     def _regenerate(self, reason: str) -> None:
+        # authoritative prefix-length recount (pkg/counter role):
+        # incremental add/delete pairs drift once translation or the
+        # DNS poller rewrites rule CIDRs, so recount from the live set
+        with self.repo._lock:
+            rules = list(self.repo.rules)
+        self.prefix_lengths.resync(prefix_lengths_of(self._rule_cidrs(rules)))
         self.endpoint_manager.regenerate_all(self.pipeline, reason)
         self.notify_agent("regenerate", reason)
 
@@ -403,6 +493,11 @@ class Daemon:
         no peers to probe."""
         self.health.nodes = registry
         self.health.start(probe_interval)
+        # remote alloc CIDRs → tunnel endpoints (node/manager.go);
+        # registries without an observer feed (tests, static lists)
+        # just skip tunnel programming
+        if hasattr(registry, "observe"):
+            self.tunnel.observe_nodes(registry)
 
     def health_report(self) -> Dict:
         """GET /health (the cilium-health status surface)."""
@@ -433,6 +528,9 @@ class Daemon:
             ),
             "prefilter_revision": self.prefilter.revision,
             "services": len(self.services.list()),
+            "ipam_allocated": len(self.ipam),
+            "lxcmap_entries": len(self.lxcmap),
+            "tunnel_entries": len(self.tunnel),
         }
 
     def metrics_text(self) -> str:
@@ -500,7 +598,15 @@ class Daemon:
                 )
                 n += 1
             except ValueError:
-                pass
+                continue
+            # re-register restored IPs with IPAM so allocate_next
+            # cannot hand them out again (pkg/ipam restore path)
+            ip = em.get("ipv4")
+            if ip:
+                try:
+                    self.ipam.allocate(ip, owner=f"endpoint-{em['id']}")
+                except ValueError:
+                    pass  # outside the pool (static IP) or pre-claimed
         return n
 
     def shutdown(self) -> None:

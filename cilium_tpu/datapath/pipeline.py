@@ -149,6 +149,11 @@ class DatapathPipeline:
         self.conntrack = conntrack
         self.lb = lb
         self.monitor = monitor
+        # called for every redirect verdict with a known 5-tuple:
+        # fn(peer_addr_bytes, ep_idx, sport, dport, proto, ingress,
+        # family) — the cilium_proxy4/6 write hook (bpf_lxc.c inserts
+        # a proxymap entry when the verdict is a proxy port)
+        self.on_redirect = None
         # TraceNotify for forwarded flows is opt-in (the reference
         # gates trace events behind the TraceNotify endpoint option);
         # DropNotify is always emitted while a listener is attached.
@@ -189,6 +194,12 @@ class DatapathPipeline:
             return self._endpoint_ids.index(endpoint_id)
         except ValueError:
             return None
+
+    def endpoint_id_at(self, idx: int) -> Optional[int]:
+        with self._lock:
+            if 0 <= idx < len(self._endpoint_ids):
+                return self._endpoint_ids[idx]
+        return None
 
     # ------------------------------------------------------------------
     def rebuild(self, force: bool = False) -> Dict[Tuple[int, int], DatapathTables]:
@@ -607,6 +618,16 @@ class DatapathPipeline:
                     kb[oidx],
                     kc[oidx],
                     revnat=None if revnat_vals is None else revnat_vals[oidx],
+                )
+
+        # proxymap handoff: redirected flows carry their full 5-tuple
+        # here (sports present) — record for the L7 front-end
+        if self.on_redirect is not None and redirect.any():
+            for i in np.nonzero(redirect)[0]:
+                self.on_redirect(
+                    bytes(int(x) & 0xFF for x in peer_bytes[i]),
+                    int(ep_idx[i]), int(sports[i]), int(dports[i]),
+                    int(protos[i]), ingress, family,
                 )
 
         # host counter accumulation (CT hits included)

@@ -1,0 +1,83 @@
+"""CNI-shaped endpoint plumbing.
+
+Reference: plugins/cilium-cni/cilium-cni.go — ADD creates the veth
+pair, asks the daemon for an IP (POST /ipam), then registers the
+endpoint (PUT /endpoint/{id}); DEL is symmetric. Here the "interface"
+is virtual (no kernel), but the command flow, result shape, and
+failure cleanup mirror the CNI contract so an orchestrator-side
+integration drives the same steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class CNIResult:
+    """CNI ADD result (the types.Result subset we produce)."""
+
+    endpoint_id: int
+    ipv4: Optional[str]
+    interface: str
+    gateway: Optional[str] = None
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+class CNIError(Exception):
+    pass
+
+
+def cni_add(
+    daemon,
+    container_id: str,
+    *,
+    labels: Optional[List[str]] = None,
+    ifname: str = "eth0",
+) -> CNIResult:
+    """CNI ADD: allocate an IP, register the endpoint, return the
+    result. On endpoint-registration failure the allocated IP is
+    released (the reference releases IPAM on error too)."""
+    ep_id = endpoint_id_for(container_id)
+    ip = daemon.ipam.allocate_next(owner=container_id)
+    try:
+        daemon.endpoint_add(
+            ep_id,
+            labels or [f"container:id={container_id[:12]}"],
+            ipv4=ip,
+            pod_name=container_id,
+        )
+    except Exception as e:
+        daemon.ipam.release(ip)
+        raise CNIError(f"endpoint create failed: {e}") from e
+    return CNIResult(
+        endpoint_id=ep_id,
+        ipv4=ip,
+        interface=f"lxc{ep_id}",
+        gateway=str(daemon.ipam.net.network_address + 1),
+    )
+
+
+def cni_del(daemon, container_id: str) -> bool:
+    """CNI DEL: tear down the endpoint and release its IP. Idempotent
+    (the CNI spec requires DEL to succeed for unknown containers)."""
+    ep_id = endpoint_id_for(container_id)
+    ep = daemon.endpoint_manager.lookup(ep_id)
+    ip = ep.ipv4 if ep is not None else None
+    deleted = daemon.endpoint_delete(ep_id)
+    if ip:
+        daemon.ipam.release(ip)
+    return deleted
+
+
+def endpoint_id_for(container_id: str) -> int:
+    """Stable endpoint id from a container id (the reference derives
+    endpoint ids from the interface; here a stable hash keeps ADD/DEL
+    symmetric without shared state)."""
+    import hashlib
+
+    h = hashlib.sha256(container_id.encode()).digest()
+    return 4096 + (int.from_bytes(h[:4], "big") % (2**20))

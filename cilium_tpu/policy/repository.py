@@ -143,11 +143,20 @@ class Repository:
     def delete_by_labels(self, labels: LabelArray) -> Tuple[int, int]:
         """Remove rules carrying every given label; returns (revision,
         n_deleted) (repository.go DeleteByLabels:286)."""
+        rev, deleted = self.take_by_labels(labels)
+        return rev, len(deleted)
+
+    def take_by_labels(self, labels: LabelArray) -> Tuple[int, List[Rule]]:
+        """delete_by_labels returning the removed rules themselves —
+        callers tracking derived state (prefix-length counter) need
+        the exact rule set removed under THIS lock hold, not a
+        separately computed snapshot that can race a concurrent add."""
         with self._lock:
-            kept, deleted = [], 0
+            kept: List[Rule] = []
+            deleted: List[Rule] = []
             for r in self.rules:
                 if len(labels) and all(r.labels.has(l) for l in labels):
-                    deleted += 1
+                    deleted.append(r)
                 else:
                     kept.append(r)
             self.rules = kept

@@ -1,0 +1,344 @@
+"""Datapath state maps, IPAM, CNI flow, workloads watcher, infra utils.
+
+Reference analogs: pkg/maps/{lxcmap,tunnel,proxymap}, pkg/counter,
+pkg/ip, pkg/ipam, pkg/logging, plugins/cilium-cni, pkg/workloads.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+
+import pytest
+
+from cilium_tpu.ipam import IPAM, IPAMError
+from cilium_tpu.maps.lxcmap import EndpointInfo, LXCMap
+from cilium_tpu.maps.proxymap import ProxyMap, ProxyValue
+from cilium_tpu.maps.tunnel import TunnelMap
+from cilium_tpu.utils.iputil import (
+    coalesce_cidrs,
+    prefix_lengths_of,
+    range_to_cidrs,
+    remove_cidrs,
+)
+from cilium_tpu.utils.logging import get_logger, setup
+from cilium_tpu.utils.prefix_counter import PrefixLengthCounter
+
+
+class TestLXCMap:
+    def test_crud_and_sync(self):
+        m = LXCMap()
+        m.upsert("10.0.0.5", EndpointInfo(endpoint_id=7))
+        assert m.lookup("10.0.0.5").endpoint_id == 7
+        assert m.lookup("10.0.0.6") is None
+
+        class EP:
+            def __init__(self, id, ipv4=None, ipv6=None):
+                self.id, self.ipv4, self.ipv6 = id, ipv4, ipv6
+
+        n = m.sync_endpoints([EP(1, "10.0.0.1"), EP(2, "10.0.0.2", "fd00::2")])
+        assert n == 3 and len(m) == 3
+        assert m.lookup("10.0.0.5") is None  # stale entry swept
+        assert m.lookup("fd00::2").endpoint_id == 2
+
+
+class TestTunnelMap:
+    def test_lpm_and_node_observer(self):
+        t = TunnelMap()
+        t.upsert("10.1.0.0/16", "192.168.0.1")
+        t.upsert("10.1.2.0/24", "192.168.0.2")
+        assert t.lookup("10.1.2.9") == "192.168.0.2"  # longest wins
+        assert t.lookup("10.1.9.9") == "192.168.0.1"
+        assert t.lookup("10.9.0.1") is None
+
+    def test_observe_node_registry(self):
+        from cilium_tpu.kvstore import InMemoryBackend, InMemoryStore
+        from cilium_tpu.nodes.registry import Node, NodeRegistry
+
+        store = InMemoryStore()
+        local = NodeRegistry(
+            InMemoryBackend(store, "l"),
+            Node(name="local", ipv4="192.168.0.1",
+                 ipv4_alloc_cidr="10.1.0.0/24"),
+        )
+        t = TunnelMap()
+        t.observe_nodes(local)
+        remote = NodeRegistry(
+            InMemoryBackend(store, "r"),
+            Node(name="remote", ipv4="192.168.0.2",
+                 ipv4_alloc_cidr="10.2.0.0/24"),
+        )
+        local.pump()
+        assert t.lookup("10.2.0.9") == "192.168.0.2"
+        remote.unregister()
+        local.pump()
+        assert t.lookup("10.2.0.9") is None
+
+
+class TestProxyMap:
+    def test_record_lookup_gc(self):
+        pm = ProxyMap(lifetime=0.0)  # instant expiry for gc test
+        pm2 = ProxyMap()
+        v = ProxyValue(orig_dst_ip="10.0.0.9", orig_dst_port=80,
+                       src_identity=1002)
+        pm2.record("10.0.0.1", 4444, "10.0.0.2", 15001, 6, v)
+        got = pm2.lookup("10.0.0.1", 4444, "10.0.0.2", 15001, 6)
+        assert got == v
+        assert pm2.lookup("10.0.0.1", 4445, "10.0.0.2", 15001, 6) is None
+        pm.record("1.1.1.1", 1, "2.2.2.2", 2, 6, v)
+        assert pm.lookup("1.1.1.1", 1, "2.2.2.2", 2, 6) is None
+        assert pm.gc() == 1
+
+
+class TestPrefixCounter:
+    def test_refcount_and_change_signal(self):
+        c = PrefixLengthCounter()
+        assert c.add([(4, 24), (4, 24), (4, 32)])  # new lengths
+        assert not c.add([(4, 24)])  # already present
+        assert c.distinct() == ([32, 24], [])
+        assert not c.delete([(4, 24)])  # refs remain (2 left)
+        assert not c.delete([(4, 24)])
+        assert c.delete([(4, 24)])  # last ref gone
+        assert c.distinct() == ([32], [])
+        with pytest.raises(ValueError):
+            c.add([(4, 33)])
+
+    def test_daemon_wiring_forces_rebuild(self):
+        from cilium_tpu.daemon import Daemon
+
+        d = Daemon()
+        d.policy_add(json.dumps([{
+            "endpointSelector": {"matchLabels": {"k8s:app": "web"}},
+            "ingress": [{"fromCIDR": ["192.0.2.0/24"]}],
+            "labels": ["k8s:policy=c1"],
+        }]))
+        assert d.prefix_lengths.distinct()[0] == [24]
+        d.policy_delete(["k8s:policy=c1"])
+        assert d.prefix_lengths.distinct() == ([], [])
+        d.shutdown()
+
+
+class TestTunnelChurn:
+    def test_local_node_skipped_and_cidr_change_cleans_stale(self):
+        from cilium_tpu.kvstore import InMemoryBackend, InMemoryStore
+        from cilium_tpu.nodes.registry import Node, NodeRegistry
+
+        store = InMemoryStore()
+        local = NodeRegistry(
+            InMemoryBackend(store, "l"),
+            Node(name="local", ipv4="192.168.0.1",
+                 ipv4_alloc_cidr="10.1.0.0/24"),
+        )
+        t = TunnelMap()
+        t.observe_nodes(local)
+        # the local node's own CIDR must never be tunnel-mapped
+        assert t.lookup("10.1.0.5") is None
+        remote_backend = InMemoryBackend(store, "r")
+        NodeRegistry(
+            remote_backend,
+            Node(name="remote", ipv4="192.168.0.2",
+                 ipv4_alloc_cidr="10.2.0.0/24"),
+        )
+        local.pump()
+        assert t.lookup("10.2.0.9") == "192.168.0.2"
+        # remote re-registers with a DIFFERENT alloc CIDR: the stale
+        # prefix must disappear
+        NodeRegistry(
+            InMemoryBackend(store, "r2"),
+            Node(name="remote", ipv4="192.168.0.2",
+                 ipv4_alloc_cidr="10.3.0.0/24"),
+        )
+        local.pump()
+        assert t.lookup("10.3.0.9") == "192.168.0.2"
+        assert t.lookup("10.2.0.9") is None
+
+
+class TestProxymapWiring:
+    def test_redirect_records_proxymap_entry(self):
+        from cilium_tpu.daemon import Daemon
+
+        d = Daemon()
+        d.policy_add(json.dumps([{
+            "endpointSelector": {"matchLabels": {"k8s:app": "web"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"k8s:app": "client"}}],
+                "toPorts": [{
+                    "ports": [{"port": "80", "protocol": "TCP"}],
+                    "rules": {"http": [{"method": "GET", "path": "/api/.*"}]},
+                }],
+            }],
+            "labels": ["k8s:policy=l7p"],
+        }]))
+        d.endpoint_add(7, ["k8s:app=web"], ipv4="10.200.0.7")
+        d.endpoint_add(9, ["k8s:app=client"], ipv4="10.200.0.9")
+        import numpy as np
+
+        from cilium_tpu.ops.lpm import ip_strings_to_u32
+
+        ep = d.pipeline.endpoint_index(7)
+        v, red = d.pipeline.process(
+            ip_strings_to_u32(["10.200.0.9"]),
+            np.array([ep], np.int32),
+            np.array([80], np.int32), np.array([6], np.int32),
+            ingress=True, sports=np.array([5555]),
+        )
+        assert bool(red[0])
+        got = d.proxymap.lookup("10.200.0.9", 5555, "10.200.0.7", 80, 6)
+        assert got is not None
+        assert got.orig_dst_ip == "10.200.0.7" and got.orig_dst_port == 80
+        client_identity = d.endpoint_manager.lookup(9).identity.id
+        assert got.src_identity == client_identity
+        d.shutdown()
+
+
+class TestIPAMRestore:
+    def test_restore_reclaims_ips(self, tmp_path):
+        from cilium_tpu.daemon import Daemon
+
+        d = Daemon(state_dir=str(tmp_path))
+        ip = d.ipam.allocate_next("cni")
+        d.endpoint_add(7, ["k8s:app=web"], ipv4=ip)
+        d.shutdown()
+        d2 = Daemon(state_dir=str(tmp_path))
+        # the restored endpoint's IP is reserved again — a fresh
+        # allocation must not collide with it
+        assert d2.ipam.owner_of(ip) is not None
+        assert d2.ipam.allocate_next("new") != ip
+        d2.shutdown()
+
+
+class TestIPUtil:
+    def test_coalesce(self):
+        assert coalesce_cidrs(["10.0.0.0/25", "10.0.0.128/25"]) == ["10.0.0.0/24"]
+        assert coalesce_cidrs(["10.0.0.0/8", "10.1.0.0/16"]) == ["10.0.0.0/8"]
+
+    def test_range_to_cidrs(self):
+        assert range_to_cidrs("10.0.0.0", "10.0.0.255") == ["10.0.0.0/24"]
+        out = range_to_cidrs("10.0.0.1", "10.0.0.6")
+        import ipaddress
+
+        covered = set()
+        for c in out:
+            covered |= set(ipaddress.ip_network(c))
+        assert covered == {ipaddress.ip_address(f"10.0.0.{i}") for i in range(1, 7)}
+
+    def test_remove_cidrs(self):
+        out = remove_cidrs(["10.0.0.0/24"], ["10.0.0.128/25"])
+        assert out == ["10.0.0.0/25"]
+        assert remove_cidrs(["10.0.0.0/24"], ["10.0.0.0/16"]) == []
+
+    def test_prefix_lengths_of(self):
+        assert prefix_lengths_of(["10.0.0.0/24", "fd00::/64"]) == [
+            (4, 24), (6, 64),
+        ]
+
+
+class TestLogging:
+    def test_structured_fields_and_json(self):
+        buf = io.StringIO()
+        setup("debug", as_json=True, stream=buf)
+        log = get_logger("policy", endpointID=7)
+        log.info("regenerated", fields={"policyRevision": 3})
+        rec = json.loads(buf.getvalue())
+        assert rec["subsys"] == "policy" and rec["level"] == "info"
+        assert rec["endpointID"] == 7 and rec["policyRevision"] == 3
+        # plain format carries key=values too
+        buf2 = io.StringIO()
+        setup("info", as_json=False, stream=buf2)
+        log.with_fields(ipAddr="10.0.0.1").warning("drop observed")
+        assert "ipAddr=10.0.0.1" in buf2.getvalue()
+        setup("info")  # restore default stderr handler
+
+
+class TestIPAM:
+    def test_allocate_release_cycle(self):
+        pool = IPAM("10.200.0.0/29", reserve_base=2)  # 8 addrs, tiny
+        ips = [pool.allocate_next("a"), pool.allocate_next("b")]
+        assert ips == ["10.200.0.2", "10.200.0.3"]
+        assert pool.owner_of(ips[0]) == "a"
+        # broadcast + reserved are never handed out
+        remaining = []
+        while True:
+            try:
+                remaining.append(pool.allocate_next())
+            except IPAMError:
+                break
+        assert "10.200.0.7" not in ips + remaining  # broadcast
+        assert "10.200.0.0" not in ips + remaining
+        assert pool.release(ips[0]) and not pool.release(ips[0])
+        assert pool.allocate_next() == ips[0]  # reuse released
+
+    def test_explicit_allocate(self):
+        pool = IPAM("10.200.0.0/24")
+        assert pool.allocate("10.200.0.77", "restore") == "10.200.0.77"
+        with pytest.raises(IPAMError):
+            pool.allocate("10.200.0.77")
+        with pytest.raises(IPAMError):
+            pool.allocate("10.201.0.1")
+
+
+class TestCNIAndWorkloads:
+    def test_cni_add_del(self):
+        from cilium_tpu.daemon import Daemon
+        from cilium_tpu.plugins.cni import cni_add, cni_del
+
+        d = Daemon()
+        res = cni_add(d, "abc123def456", labels=["container:app=web"])
+        assert res.ipv4 and res.endpoint_id >= 4096
+        ep = d.endpoint_manager.lookup(res.endpoint_id)
+        assert ep is not None and ep.ipv4 == res.ipv4
+        assert d.lxcmap.lookup(res.ipv4).endpoint_id == res.endpoint_id
+        assert cni_del(d, "abc123def456")
+        assert d.endpoint_manager.lookup(res.endpoint_id) is None
+        assert d.ipam.owner_of(res.ipv4) is None
+        assert not cni_del(d, "abc123def456")  # idempotent
+        d.shutdown()
+
+    def test_workload_watcher_sync(self):
+        from cilium_tpu.daemon import Daemon
+        from cilium_tpu.workloads import (
+            ContainerInfo,
+            IGNORE_LABEL,
+            WorkloadWatcher,
+        )
+
+        class FakeRuntime:
+            def __init__(self):
+                self.live = []
+
+            def containers(self):
+                return list(self.live)
+
+        d = Daemon()
+        rt = FakeRuntime()
+        w = WorkloadWatcher(d, rt)
+        rt.live = [
+            ContainerInfo(id="c1" * 6, labels={"app": "web"}),
+            ContainerInfo(id="c2" * 6, labels={IGNORE_LABEL: "true"}),
+        ]
+        assert w.sync() == 1  # ignored container skipped
+        ep_id = w.endpoint_of("c1" * 6)
+        ep = d.endpoint_manager.lookup(ep_id)
+        assert any("container:app=web" == str(l) for l in ep.labels)
+        # container dies → endpoint removed on next sync
+        rt.live = []
+        assert w.sync() == 1
+        assert d.endpoint_manager.lookup(ep_id) is None
+        d.shutdown()
+
+    def test_ipam_rest(self, tmp_path):
+        from cilium_tpu.api.client import APIClient
+        from cilium_tpu.api.server import APIServer
+        from cilium_tpu.daemon import Daemon
+
+        d = Daemon()
+        srv = APIServer(d, str(tmp_path / "api.sock"))
+        srv.start()
+        try:
+            c = APIClient(str(tmp_path / "api.sock"))
+            out = c.ipam_allocate(owner="cni")
+            assert out["ip"].startswith("10.200.")
+            assert c.ipam_release(out["ip"])["released"]
+        finally:
+            srv.stop()
+            d.shutdown()
