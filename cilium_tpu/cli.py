@@ -272,6 +272,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         server = APIServer(daemon, args.socket)
         monitor = MonitorServer(daemon.monitor, args.socket + ".monitor")
         monitor.start()
+        from .xds.server import XDSServer
+
+        xds = XDSServer(daemon.xds_cache, args.socket + ".xds")
+        xds.start()
         daemon.fqdn_start()  # ToFQDNs DNS poll loop (daemon/main.go:808)
         if daemon.health.nodes is not None:
             # node prober (daemon/main.go:927-945) — only meaningful
@@ -279,10 +283,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             # has no peers and would spin an empty sweep forever
             daemon.health.start()
         print(f"cilium-tpu daemon serving on {args.socket} "
-              f"(monitor: {args.socket}.monitor, state: {args.state})")
+              f"(monitor: {args.socket}.monitor, xds: {args.socket}.xds, "
+              f"state: {args.state})")
         try:
             server.serve_forever()
         except KeyboardInterrupt:
+            xds.stop()
             monitor.stop()
             server.stop()
             daemon.shutdown()

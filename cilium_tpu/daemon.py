@@ -34,6 +34,12 @@ from .maps.tunnel import TunnelMap
 from .utils.iputil import prefix_lengths_of
 from .utils.logging import get_logger
 from .utils.prefix_counter import PrefixLengthCounter
+from .xds.cache import ResourceCache
+from .xds.npds import (
+    delete_endpoint_policy,
+    publish_endpoint_policy,
+    wire_nphds,
+)
 
 log = get_logger("daemon")
 from .engine import PolicyEngine
@@ -103,6 +109,11 @@ class Daemon:
         # cilium_proxy4/6 write of bpf_lxc.c; the L7 front-end reads
         # them back to recover original destination + source identity)
         self.pipeline.on_redirect = self._record_proxy_flow
+        # xDS distribution (pkg/envoy xDS): NPDS per-endpoint L7
+        # policy + NPHDS identity→addresses, served to external
+        # proxies by an XDSServer the embedder/CLI attaches
+        self.xds_cache = ResourceCache()
+        wire_nphds(self.xds_cache, self.ipcache)
         # serializes snapshot writers: API threads AND the background
         # DNS poller both reach save_state
         self._save_lock = threading.Lock()
@@ -292,8 +303,11 @@ class Daemon:
                 self.ipcache.upsert(f"{ipv6}/128", ep.identity.id,
                                     source=SOURCE_AGENT)
             self._sync_pipeline_endpoints()
-            ep.regenerate(self.pipeline, reason="endpoint create",
-                          proxy=self.proxy)
+            # a fresh identity changes what OTHER endpoints' L7
+            # identity scopes must allow — regenerate the fleet (the
+            # identity-watcher → TriggerPolicyUpdates path; it covers
+            # the new endpoint too)
+            self._regenerate("endpoint created")
         self.save_state()
         self.notify_agent("endpoint-created", f"endpoint {endpoint_id}")
         log.info("endpoint created", fields={
@@ -316,6 +330,12 @@ class Daemon:
             if ep.identity is not None:
                 self.registry.release(ep.identity)
             self._sync_pipeline_endpoints()
+            # the released identity must drop out of every OTHER
+            # endpoint's L7 scope + published NPDS (symmetric to the
+            # create-path fleet regen) — a re-allocated identity id
+            # must not inherit stale allows
+            self._regenerate("endpoint deleted")
+        delete_endpoint_policy(self.xds_cache, endpoint_id)
         self.save_state()
         self.notify_agent("endpoint-deleted", f"endpoint {endpoint_id}")
         log.info("endpoint deleted", fields={"endpointID": endpoint_id})
@@ -390,7 +410,13 @@ class Daemon:
         with self.repo._lock:
             rules = list(self.repo.rules)
         self.prefix_lengths.resync(prefix_lengths_of(self._rule_cidrs(rules)))
-        self.endpoint_manager.regenerate_all(self.pipeline, reason)
+        self.endpoint_manager.regenerate_all(
+            self.pipeline, reason, proxy=self.proxy
+        )
+        # NPDS: republish every endpoint's L7 policy post-regeneration
+        # (UpdateNetworkPolicy, pkg/envoy/server.go:535)
+        for ep in self.endpoint_manager.endpoints():
+            publish_endpoint_policy(self.xds_cache, ep.id, self.proxy)
         self.notify_agent("regenerate", reason)
 
     # -- map dumps ------------------------------------------------------

@@ -73,13 +73,18 @@ class EndpointManager:
         return len(self._by_id)
 
     # -- regeneration fan-out ------------------------------------------
-    def regenerate_all(self, pipeline, reason: str = "") -> int:
+    def regenerate_all(self, pipeline, reason: str = "", proxy=None) -> int:
         """Queue every endpoint to the builder pool; returns the count
         that regenerated successfully (RegenerateAllEndpoints). A
         failing endpoint counts as unsuccessful, it never aborts the
-        fan-out."""
+        fan-out. Passing ``proxy`` reconciles L7 redirects per
+        endpoint (without it, policy changes would never refresh the
+        redirects' identity scoping)."""
         eps = self.endpoints()
-        futures = [self._pool.submit(ep.regenerate, pipeline, reason) for ep in eps]
+        futures = [
+            self._pool.submit(ep.regenerate, pipeline, reason, proxy)
+            for ep in eps
+        ]
         ok = 0
         for f in futures:
             try:

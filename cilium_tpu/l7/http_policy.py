@@ -224,3 +224,23 @@ class HTTPPolicy:
 
     def check(self, request: HTTPRequest) -> bool:
         return bool(self.check_batch([request])[0])
+
+    def rules_model(self) -> List[Dict]:
+        """JSON-able view of the compiled rules — the NPDS
+        PortNetworkPolicyRule shape (http_rules + remote_policies,
+        envoy/cilium_network_policy.h) the xDS layer distributes."""
+        out: List[Dict] = []
+        for cr in self._rules:
+            d: Dict = {}
+            if cr.rule.method:
+                d["method"] = cr.rule.method
+            if cr.rule.path:
+                d["path"] = cr.rule.path
+            if cr.rule.host:
+                d["host"] = cr.rule.host
+            if cr.rule.headers:
+                d["headers"] = list(cr.rule.headers)
+            if cr.allowed_identities is not None:
+                d["remote_policies"] = sorted(cr.allowed_identities)
+            out.append(d)
+        return out

@@ -100,5 +100,23 @@ class KafkaACL:
                     ok[i, j] = False
         return ok.any(axis=1)
 
+    def rules_model(self) -> List[Dict]:
+        """JSON-able view of the rules + their identity scopes (the
+        NPDS kafka_rules shape, mirroring HTTPPolicy.rules_model)."""
+        out: List[Dict] = []
+        for rule, idents in self._rules:
+            d: Dict = {}
+            for key, val in (
+                ("role", rule.role), ("api_key", rule.api_key),
+                ("api_version", rule.api_version),
+                ("client_id", rule.client_id), ("topic", rule.topic),
+            ):
+                if val:
+                    d[key] = val
+            if idents is not None:
+                d["remote_policies"] = sorted(idents)
+            out.append(d)
+        return out
+
     def check(self, request: KafkaRequest) -> bool:
         return bool(self.check_batch([request])[0])

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..l7.http_policy import HTTPPolicy, HTTPRequest
 from ..l7.kafka_policy import KafkaACL, KafkaRequest
@@ -121,6 +121,16 @@ class Proxy:
     def redirects(self) -> Dict[str, Redirect]:
         with self._lock:
             return dict(self._redirects)
+
+    def redirects_for(self, endpoint_id: int) -> List[Redirect]:
+        """All live redirects of one endpoint (stable order) — the
+        per-endpoint L7 policy view NPDS serializes."""
+        with self._lock:
+            return sorted(
+                (r for r in self._redirects.values()
+                 if r.endpoint_id == endpoint_id),
+                key=lambda r: (r.dst_port, not r.ingress),
+            )
 
     # -- enforcement hooks ----------------------------------------------
     def check_http(self, redirect: Redirect, requests: Sequence[HTTPRequest]):

@@ -1,0 +1,269 @@
+"""xDS distribution: versioned cache, stream protocol, ACK/NACK, NPDS.
+
+Reference analogs: pkg/envoy/xds/{cache,server,ack}.go (the e2e-style
+stream tests mirror pkg/envoy/xds/server_e2e_test.go),
+pkg/envoy/server.go:535 UpdateNetworkPolicy, resources.go NPHDS.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.utils.completion import WaitGroup
+from cilium_tpu.xds import (
+    NETWORK_POLICY_HOSTS_TYPE,
+    NETWORK_POLICY_TYPE,
+    ResourceCache,
+    XDSClient,
+    XDSServer,
+    endpoint_policy_resource,
+    publish_host_mapping,
+    wire_nphds,
+)
+
+
+class TestCache:
+    def test_versioning_and_noop(self):
+        c = ResourceCache()
+        v1 = c.upsert("t", "a", {"x": 1})
+        assert v1 == 1
+        assert c.upsert("t", "a", {"x": 1}) == 1  # no-op write
+        v2 = c.upsert("t", "a", {"x": 2})
+        assert v2 == 2
+        v3 = c.upsert("t", "b", {"y": 1})
+        ver, res = c.get("t")
+        assert ver == v3 == 3 and set(res) == {"a", "b"}
+        _, subset = c.get("t", ["b", "missing"])
+        assert set(subset) == {"b"}
+        assert c.delete("t", "a") == 4
+        assert c.delete("t", "a") == 4  # idempotent
+
+    def test_wait_newer(self):
+        c = ResourceCache()
+        c.upsert("t", "a", {})
+        assert c.wait_newer("t", 1, timeout=0.05) is None  # nothing newer
+        t = threading.Thread(
+            target=lambda: (time.sleep(0.05), c.upsert("t", "b", {}))
+        )
+        t.start()
+        assert c.wait_newer("t", 1, timeout=5.0) == 2
+        t.join()
+
+
+@pytest.fixture()
+def stream(tmp_path):
+    cache = ResourceCache()
+    srv = XDSServer(cache, str(tmp_path / "xds.sock"))
+    srv.start()
+    yield cache, srv, str(tmp_path / "xds.sock")
+    srv.stop()
+
+
+class TestStream:
+    def test_subscribe_push_ack(self, stream):
+        cache, srv, path = stream
+        cache.upsert(NETWORK_POLICY_TYPE, "7", {"endpoint_id": 7})
+        got = {}
+        client = XDSClient(path, node="envoy-1")
+        client.subscribe(
+            NETWORK_POLICY_TYPE,
+            lambda v, res: got.update(res),
+        )
+        assert client.wait_applied(NETWORK_POLICY_TYPE, 1)
+        assert got["7"] == {"endpoint_id": 7}
+        # server observes the ACK
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if srv.acked_version("envoy-1", NETWORK_POLICY_TYPE) >= 1:
+                break
+            time.sleep(0.02)
+        assert srv.acked_version("envoy-1", NETWORK_POLICY_TYPE) >= 1
+        # a cache update pushes a new version to the live stream
+        v2 = cache.upsert(NETWORK_POLICY_TYPE, "9", {"endpoint_id": 9})
+        assert client.wait_applied(NETWORK_POLICY_TYPE, v2)
+        assert got["9"] == {"endpoint_id": 9}
+        client.close()
+
+    def test_ack_completion_gates_regeneration(self, stream):
+        """The reference blocks endpoint regeneration until the proxy
+        ACKs the policy version (ack.go + completion.WaitGroup)."""
+        cache, srv, path = stream
+        client = XDSClient(path, node="envoy-1")
+        client.subscribe(NETWORK_POLICY_TYPE, lambda v, res: None)
+        assert client.wait_applied(NETWORK_POLICY_TYPE, 0, timeout=5)
+        version = cache.upsert(NETWORK_POLICY_TYPE, "7", {"endpoint_id": 7})
+        wg = WaitGroup()
+        srv.wait_for_ack(NETWORK_POLICY_TYPE, version, "envoy-1", wg.add())
+        assert wg.wait(timeout=5.0)
+        client.close()
+
+    def test_nack_fails_completion(self, stream):
+        cache, srv, path = stream
+
+        def bad_handler(version, res):
+            if res:
+                raise ValueError("bad resource")
+
+        client = XDSClient(path, node="envoy-2")
+        client.subscribe(NETWORK_POLICY_TYPE, bad_handler)
+        time.sleep(0.1)
+        version = cache.upsert(NETWORK_POLICY_TYPE, "7", {"endpoint_id": 7})
+        wg = WaitGroup()
+        comp = wg.add()
+        srv.wait_for_ack(NETWORK_POLICY_TYPE, version, "envoy-2", comp)
+        with pytest.raises(RuntimeError, match="bad resource"):
+            wg.wait(timeout=5.0)
+        assert comp.err is not None
+        client.close()
+
+    def test_disconnect_fails_pending_completions(self, stream):
+        """A dead stream can never ACK — wait_for_ack callers must be
+        failed, not hung (ack.go completions on stream close)."""
+        cache, srv, path = stream
+        client = XDSClient(path, node="envoy-x")
+        client.subscribe(NETWORK_POLICY_TYPE, lambda v, r: None)
+        assert client.wait_applied(NETWORK_POLICY_TYPE, 0, timeout=5)
+        # register a completion for a version the client will never see
+        wg = WaitGroup()
+        srv.wait_for_ack(NETWORK_POLICY_TYPE, 999, "envoy-x", wg.add())
+        client.close()
+        with pytest.raises(RuntimeError, match="stream closed"):
+            assert wg.wait(timeout=5.0)
+
+    def test_resubscription_with_new_names_gets_push(self, stream):
+        cache, srv, path = stream
+        cache.upsert(NETWORK_POLICY_TYPE, "1", {"endpoint_id": 1})
+        cache.upsert(NETWORK_POLICY_TYPE, "2", {"endpoint_id": 2})
+        seen = {}
+        client = XDSClient(path, node="envoy-y")
+        client.subscribe(NETWORK_POLICY_TYPE,
+                         lambda v, r: (seen.clear(), seen.update(r)),
+                         resource_names=["1"])
+        assert client.wait_applied(NETWORK_POLICY_TYPE, 2)
+        assert set(seen) == {"1"}
+        # widen the subscription — same cache version, new names must
+        # still be pushed
+        client.subscribe(NETWORK_POLICY_TYPE,
+                         lambda v, r: (seen.clear(), seen.update(r)),
+                         resource_names=["1", "2"])
+        deadline = time.time() + 5
+        while time.time() < deadline and set(seen) != {"1", "2"}:
+            time.sleep(0.02)
+        assert set(seen) == {"1", "2"}
+        client.close()
+
+    def test_already_acked_completes_immediately(self, stream):
+        cache, srv, path = stream
+        client = XDSClient(path, node="envoy-3")
+        client.subscribe(NETWORK_POLICY_TYPE, lambda v, r: None)
+        v = cache.upsert(NETWORK_POLICY_TYPE, "1", {"endpoint_id": 1})
+        assert client.wait_applied(NETWORK_POLICY_TYPE, v)
+        deadline = time.time() + 5
+        while time.time() < deadline and srv.acked_version(
+            "envoy-3", NETWORK_POLICY_TYPE
+        ) < v:
+            time.sleep(0.02)
+        wg = WaitGroup()
+        srv.wait_for_ack(NETWORK_POLICY_TYPE, v, "envoy-3", wg.add())
+        assert wg.wait(timeout=1.0)
+        client.close()
+
+
+class TestNPDS:
+    def _daemon_with_l7(self):
+        from cilium_tpu.daemon import Daemon
+
+        d = Daemon()
+        d.policy_add(json.dumps([{
+            "endpointSelector": {"matchLabels": {"k8s:app": "web"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"k8s:app": "client"}}],
+                "toPorts": [{
+                    "ports": [{"port": "80", "protocol": "TCP"}],
+                    "rules": {"http": [{"method": "GET", "path": "/api/.*"}]},
+                }],
+            }],
+            "labels": ["k8s:policy=xp"],
+        }]))
+        d.endpoint_add(7, ["k8s:app=web"], ipv4="10.200.0.7")
+        d.endpoint_add(9, ["k8s:app=client"], ipv4="10.200.0.9")
+        return d
+
+    def test_endpoint_policy_resource(self):
+        d = self._daemon_with_l7()
+        res = endpoint_policy_resource(7, d.proxy)
+        assert res["endpoint_id"] == 7
+        port = res["l7_ports"][0]
+        assert port["port"] == 80 and port["parser"] == "http"
+        rule = port["http_rules"][0]
+        assert rule["method"] == "GET" and rule["path"] == "/api/.*"
+        client_identity = d.endpoint_manager.lookup(9).identity.id
+        assert client_identity in rule["remote_policies"]
+        d.shutdown()
+
+    def test_daemon_publishes_npds_and_nphds(self, tmp_path):
+        d = self._daemon_with_l7()
+        # NPDS rows exist for both endpoints after regeneration
+        _, res = d.xds_cache.get(NETWORK_POLICY_TYPE)
+        assert "7" in res and res["7"]["l7_ports"]
+        # NPHDS maps each identity to its addresses
+        _, hosts = d.xds_cache.get(NETWORK_POLICY_HOSTS_TYPE)
+        web_identity = str(d.endpoint_manager.lookup(7).identity.id)
+        assert "10.200.0.7/32" in hosts[web_identity]["host_addresses"]
+        # an external proxy sees the rows over the socket
+        srv = XDSServer(d.xds_cache, str(tmp_path / "x.sock"))
+        srv.start()
+        try:
+            seen = {}
+            c = XDSClient(str(tmp_path / "x.sock"), node="ext-proxy")
+            c.subscribe(NETWORK_POLICY_TYPE, lambda v, r: seen.update(r))
+            ver, _ = d.xds_cache.get(NETWORK_POLICY_TYPE)
+            assert c.wait_applied(NETWORK_POLICY_TYPE, ver)
+            assert "7" in seen
+            # endpoint deletion propagates (resource removed)
+            d.endpoint_delete(7)
+            ver2, res2 = d.xds_cache.get(NETWORK_POLICY_TYPE)
+            assert "7" not in res2 and ver2 > ver
+            assert c.wait_applied(NETWORK_POLICY_TYPE, ver2)
+            c.close()
+        finally:
+            srv.stop()
+            d.shutdown()
+
+    def test_endpoint_delete_drops_identity_from_peer_scopes(self):
+        """Releasing an identity must remove it from OTHER endpoints'
+        published remote_policies — a re-allocated id must not inherit
+        stale allows."""
+        d = self._daemon_with_l7()
+        client_identity = d.endpoint_manager.lookup(9).identity.id
+        _, res = d.xds_cache.get(NETWORK_POLICY_TYPE)
+        rules = res["7"]["l7_ports"][0]["http_rules"]
+        assert client_identity in rules[0]["remote_policies"]
+        d.endpoint_delete(9)
+        _, res = d.xds_cache.get(NETWORK_POLICY_TYPE)
+        rules = res["7"]["l7_ports"][0]["http_rules"]
+        assert client_identity not in rules[0].get("remote_policies", [])
+        d.shutdown()
+
+    def test_nphds_follows_ipcache_churn(self):
+        from cilium_tpu.ipcache.ipcache import IPCache
+
+        cache = ResourceCache()
+        ipc = IPCache()
+        ipc.upsert("10.0.0.1/32", 1001, source="k8s")
+        wire_nphds(cache, ipc)
+        _, hosts = cache.get(NETWORK_POLICY_HOSTS_TYPE)
+        assert hosts["1001"]["host_addresses"] == ["10.0.0.1/32"]
+        ipc.upsert("10.0.0.2/32", 1001, source="k8s")
+        _, hosts = cache.get(NETWORK_POLICY_HOSTS_TYPE)
+        assert hosts["1001"]["host_addresses"] == [
+            "10.0.0.1/32", "10.0.0.2/32",
+        ]
+        ipc.delete("10.0.0.1/32", "k8s")
+        ipc.delete("10.0.0.2/32", "k8s")
+        _, hosts = cache.get(NETWORK_POLICY_HOSTS_TYPE)
+        assert "1001" not in hosts  # empty set deletes the row
