@@ -557,17 +557,21 @@ def unpack_conjuncts(
     """Pre-unpack conjunct word masks to transposed bit matrices for
     host_selector_matches (cacheable across incremental updates)."""
     s, cps, w = conj_req.shape
+    # float32 operands straight from the bit unpack: numpy int32
+    # matmul has no BLAS path and is ~50× slower; bit-count sums stay
+    # far below f32's exact-integer range (2^24), so float
+    # accumulation is exact here
     req = np.unpackbits(
         conj_req.reshape(s * cps, w).view(np.uint8).reshape(s * cps, w * 4),
         axis=1,
         bitorder="little",
-    ).astype(np.int32)
+    ).astype(np.float32)
     forbid = np.unpackbits(
         conj_forbid.reshape(s * cps, w).view(np.uint8).reshape(s * cps, w * 4),
         axis=1,
         bitorder="little",
-    ).astype(np.int32)
-    return req.T.copy(), forbid.T.copy()
+    ).astype(np.float32)
+    return np.ascontiguousarray(req.T), np.ascontiguousarray(forbid.T)
 
 
 def host_selector_matches(
@@ -586,14 +590,14 @@ def host_selector_matches(
         return np.zeros((n, 0), bool)
     bits = np.unpackbits(
         id_bits.view(np.uint8).reshape(n, w * 4), axis=1, bitorder="little"
-    ).astype(np.int32)
+    ).astype(np.float32)
     req_t, forbid_t = unpacked if unpacked is not None else unpack_conjuncts(
         conj_req, conj_forbid
     )
     hit_req = bits @ req_t
     hit_forbid = bits @ forbid_t
     ok = (
-        (hit_req == req_count.reshape(1, s * cps))
+        (hit_req == req_count.reshape(1, s * cps).astype(np.float32))
         & (hit_forbid == 0)
         & conj_valid.reshape(1, s * cps)
     )

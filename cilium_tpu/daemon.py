@@ -79,6 +79,7 @@ class Daemon:
         node_registry=None,
         health_probe=None,
         pod_cidr: str = "10.200.0.0/16",
+        regen_debounce: float = 0.0,
     ) -> None:
         self.state_dir = state_dir
         self.repo = Repository()
@@ -114,6 +115,21 @@ class Daemon:
         # proxies by an XDSServer the embedder/CLI attaches
         self.xds_cache = ResourceCache()
         wire_nphds(self.xds_cache, self.ipcache)
+        # fleet regeneration is synchronous by default (tests and
+        # small deployments observe effects immediately); a busy node
+        # sets regen_debounce > 0 to fold bursts of endpoint churn
+        # into rate-limited sweeps (pkg/trigger TriggerPolicyUpdates)
+        self._regen_trigger = None
+        if regen_debounce > 0:
+            from .utils.trigger import Trigger
+
+            self._regen_trigger = Trigger(
+                lambda reasons: self._regenerate_now(
+                    "; ".join(reasons) or "debounced"
+                ),
+                min_interval=regen_debounce,
+                name="fleet-regeneration",
+            )
         # serializes snapshot writers: API threads AND the background
         # DNS poller both reach save_state
         self._save_lock = threading.Lock()
@@ -330,6 +346,9 @@ class Daemon:
             if ep.identity is not None:
                 self.registry.release(ep.identity)
             self._sync_pipeline_endpoints()
+            # release the endpoint's L7 redirects (and their proxy
+            # ports) BEFORE the fleet regen republishes NPDS
+            self.proxy.remove_endpoint(endpoint_id)
             # the released identity must drop out of every OTHER
             # endpoint's L7 scope + published NPDS (symmetric to the
             # create-path fleet regen) — a re-allocated identity id
@@ -404,6 +423,12 @@ class Daemon:
             self.monitor.publish(AgentNotify(kind=kind, message=message))
 
     def _regenerate(self, reason: str) -> None:
+        if self._regen_trigger is not None:
+            self._regen_trigger.trigger(reason)
+            return
+        self._regenerate_now(reason)
+
+    def _regenerate_now(self, reason: str) -> None:
         # authoritative prefix-length recount (pkg/counter role):
         # incremental add/delete pairs drift once translation or the
         # DNS poller rewrites rule CIDRs, so recount from the live set

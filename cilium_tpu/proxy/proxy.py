@@ -122,6 +122,21 @@ class Proxy:
         with self._lock:
             return dict(self._redirects)
 
+    def remove_endpoint(self, endpoint_id: int) -> int:
+        """Tear down every redirect of a deleted endpoint, returning
+        its proxy ports to the allocator (removeOldRedirects on the
+        endpoint-delete path — without this, L7 endpoint churn leaks
+        ports until the 10000-20000 range exhausts)."""
+        with self._lock:
+            doomed = [
+                key for key, r in self._redirects.items()
+                if r.endpoint_id == endpoint_id
+            ]
+            for key in doomed:
+                r = self._redirects.pop(key)
+                self._ports_in_use.discard(r.proxy_port)
+            return len(doomed)
+
     def redirects_for(self, endpoint_id: int) -> List[Redirect]:
         """All live redirects of one endpoint (stable order) — the
         per-endpoint L7 policy view NPDS serializes."""

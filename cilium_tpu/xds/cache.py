@@ -50,6 +50,12 @@ class ResourceCache:
             self._cond.notify_all()
             return version
 
+    def version(self, type_url: str) -> int:
+        """Current version only — the stream poll reads this 5×/s per
+        client, so it must not copy the resource dict."""
+        with self._lock:
+            return self._types.get(type_url, (0, {}))[0]
+
     def get(
         self, type_url: str, names: Optional[List[str]] = None
     ) -> Tuple[int, Dict[str, dict]]:

@@ -179,6 +179,7 @@ class XDSServer:
             # per-(stream, type) subscription state
             subs: Dict[str, Optional[List[str]]] = {}
             sent_version: Dict[str, int] = {}
+            sent_nonce: Dict[str, str] = {}
             conn.settimeout(0.2)
 
             def push(type_url: str) -> None:
@@ -195,15 +196,16 @@ class XDSServer:
                     "resources": resources,
                 })
                 sent_version[type_url] = version
+                sent_nonce[type_url] = nonce
 
             while not self._stop.is_set():
                 try:
                     req = _recv_msg(conn)
                 except socket.timeout:
                     # version moved since last push? re-push
+                    # (version() is copy-free — this runs 5×/s)
                     for t in list(subs):
-                        cur, _ = self.cache.get(t, None)
-                        if cur > sent_version.get(t, -1):
+                        if self.cache.version(t) > sent_version.get(t, -1):
                             push(t)
                     continue
                 if req is None:
@@ -216,6 +218,12 @@ class XDSServer:
                 subs[t] = req.get("resource_names")
                 ver = int(req.get("version_info") or 0)
                 if not first and not names_changed:
+                    # stale-ACK guard (server.go nonce check): only a
+                    # response to our LATEST push counts — a late ACK
+                    # of an old response must not mark newer versions
+                    # applied
+                    if req.get("response_nonce") != sent_nonce.get(t):
+                        continue
                     if req.get("error_detail"):
                         self._on_nack(node, t, ver,
                                       str(req["error_detail"]))
@@ -226,8 +234,12 @@ class XDSServer:
                 # otherwise never deliver the newly requested names)
                 if first or names_changed:
                     push(t)
-        except (OSError, ValueError, KeyError):
-            pass
+        except (OSError, ValueError, KeyError) as e:
+            # protocol failures must be diagnosable — a proxy stuck in
+            # a reconnect loop with silent teardown is undebuggable
+            log.warning("xds stream error", fields={
+                "node": node, "error": f"{type(e).__name__}: {e}",
+            })
         finally:
             # a dead stream can never ACK: fail its pending
             # completions instead of hanging wait_for_ack callers

@@ -249,6 +249,33 @@ class TestNPDS:
         assert client_identity not in rules[0].get("remote_policies", [])
         d.shutdown()
 
+    def test_endpoint_churn_releases_proxy_ports(self):
+        """Deleting an L7 endpoint must free its redirects + proxy
+        ports — churn would otherwise exhaust the 10000-20000 range."""
+        d = self._daemon_with_l7()
+        assert len(d.proxy.redirects_for(7)) == 1
+        ports_before = len(d.proxy._ports_in_use)
+        d.endpoint_delete(7)
+        assert d.proxy.redirects_for(7) == []
+        assert len(d.proxy._ports_in_use) == ports_before - 1
+        d.shutdown()
+
+    def test_regen_debounce_folds_bursts(self):
+        import time as _t
+
+        from cilium_tpu.daemon import Daemon
+
+        d = Daemon(regen_debounce=0.2)
+        for i in range(5):
+            d.endpoint_add(100 + i, [f"k8s:app=burst{i}"])
+        # folded: far fewer sweeps than events
+        deadline = _t.time() + 5
+        while _t.time() < deadline and d._regen_trigger.run_count == 0:
+            _t.sleep(0.05)
+        assert d._regen_trigger.run_count >= 1
+        assert d._regen_trigger.fold_count >= 1
+        d.shutdown()
+
     def test_nphds_follows_ipcache_churn(self):
         from cilium_tpu.ipcache.ipcache import IPCache
 
