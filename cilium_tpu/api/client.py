@@ -91,6 +91,20 @@ class APIClient:
     def identity_get(self, num: int):
         return self._request("GET", f"/identity/{num}")
 
+    def config_get(self):
+        return self._request("GET", "/config")
+
+    def config_patch(self, options: dict):
+        return self._request("PATCH", "/config", {"options": options})
+
+    def endpoint_config(self, ep_id: int, options: dict):
+        return self._request(
+            "PATCH", f"/endpoint/{ep_id}/config", {"options": options}
+        )
+
+    def map_dump(self, name: str):
+        return self._request("GET", f"/map/{name}")
+
     def ipam_allocate(self, owner: str = ""):
         return self._request("POST", "/ipam", {"owner": owner})
 

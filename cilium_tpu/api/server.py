@@ -138,6 +138,22 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "identity not found"})
             else:
                 self._json(200, ident)
+        elif path == "/config" and method == "GET":
+            self._json(200, d.config_get())
+        elif path == "/config" and method == "PATCH":
+            body = self._body()
+            self._json(200, d.config_patch(body.get("options", {})))
+        elif (m := re.fullmatch(r"/endpoint/(\d+)/config", path)) and method == "PATCH":
+            ep_id = int(m.group(1))
+            if d.endpoint_manager.lookup(ep_id) is None:
+                self._json(404, {"error": f"endpoint {ep_id} not found"})
+            else:
+                body = self._body()
+                self._json(200, d.endpoint_config(
+                    ep_id, body.get("options", {})
+                ))
+        elif (m := re.fullmatch(r"/map/(\w+)", path)) and method == "GET":
+            self._json(200, d.map_dump(m.group(1)))
         elif path == "/ipam" and method == "POST":
             body = self._body() if self.headers.get("Content-Length") else {}
             ip = d.ipam.allocate_next(owner=body.get("owner", ""))

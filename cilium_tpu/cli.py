@@ -100,6 +100,18 @@ class _Surface:
     def _d_debuginfo(self):
         return self._daemon.debuginfo()
 
+    def _d_config_get(self):
+        return self._daemon.config_get()
+
+    def _d_config_patch(self, options):
+        return self._daemon.config_patch(options)
+
+    def _d_endpoint_config(self, ep_id, options):
+        return self._daemon.endpoint_config(ep_id, options)
+
+    def _d_map_dump(self, name):
+        return self._daemon.map_dump(name)
+
     def _d_service_list(self):
         return self._daemon.service_list()
 
@@ -189,6 +201,11 @@ def build_parser() -> argparse.ArgumentParser:
     pol.add_parser("get", help="dump the repository")
     dele = pol.add_parser("delete", help="delete rules by label")
     dele.add_argument("labels", nargs="+", help="labels, e.g. k8s:policy=x")
+    val = pol.add_parser("validate", help="sanitize a rules file")
+    val.add_argument("file", help="rules JSON ('-' = stdin)")
+    pw = pol.add_parser("wait", help="wait until the repository reaches a revision")
+    pw.add_argument("revision", type=int)
+    pw.add_argument("--timeout", type=float, default=30.0)
     tr = pol.add_parser("trace", help="offline verdict + trace log")
     tr.add_argument("-s", "--src", action="append", required=True,
                     help="source label (repeatable)")
@@ -210,6 +227,9 @@ def build_parser() -> argparse.ArgumentParser:
     epa.add_argument("-l", "--label", action="append", required=True)
     epa.add_argument("--ipv4")
     epa.add_argument("--ipv6")
+    epc = ep.add_parser("config", help="per-endpoint runtime options")
+    epc.add_argument("id", type=int)
+    epc.add_argument("options", nargs="+", help="Option=true|false pairs")
     epd = ep.add_parser("delete", help="remove an endpoint")
     epd.add_argument("id", type=int)
 
@@ -222,9 +242,22 @@ def build_parser() -> argparse.ArgumentParser:
     idg.add_argument("id", type=int)
 
     # bpf policy get (map dump)
+    cfg = sub.add_parser("config", help="runtime option map")
+    cfg.add_argument("options", nargs="*",
+                     help="Option=true|false pairs (empty: show)")
+
     bpf = sub.add_parser("bpf", help="datapath map access").add_subparsers(
         dest="sub", required=True
     )
+    for mname, mhelp in (
+        ("ct", "conntrack entries"), ("ipcache", "IP→identity cache"),
+        ("tunnel", "tunnel endpoints"), ("proxy", "proxy handoffs"),
+        ("metrics", "per-endpoint counters"),
+    ):
+        mp = bpf.add_parser(mname, help=mhelp).add_subparsers(
+            dest="mapop", required=True
+        )
+        mp.add_parser("list", help=f"dump {mhelp}")
     bp = bpf.add_parser("policy", help="policymap ops").add_subparsers(
         dest="op", required=True
     )
@@ -333,6 +366,31 @@ def main(argv: Optional[List[str]] = None) -> int:
             _print(s.policy_get())
         elif args.sub == "delete":
             _print(s.policy_delete(args.labels))
+        elif args.sub == "validate":
+            from .policy.api.serialization import rules_from_json
+
+            text = (sys.stdin.read() if args.file == "-"
+                    else open(args.file).read())
+            try:
+                rules = rules_from_json(text)
+            except (ValueError, KeyError) as e:
+                print(f"invalid: {e}", file=sys.stderr)
+                return 1
+            print(f"valid: {len(rules)} rule(s)")
+            return 0
+        elif args.sub == "wait":
+            import time as _time
+
+            deadline = _time.time() + args.timeout
+            while _time.time() < deadline:
+                rev = s.status()["policy_revision"]
+                if rev >= args.revision:
+                    print(f"revision {rev} reached")
+                    return 0
+                _time.sleep(0.2)
+            print(f"timeout waiting for revision {args.revision}",
+                  file=sys.stderr)
+            return 1
         elif args.sub == "trace":
             out = s.policy_resolve(
                 args.src, args.dst, args.dport,
@@ -352,6 +410,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif args.sub == "add":
             _print(s.endpoint_put(args.id, args.label,
                                   ipv4=args.ipv4, ipv6=args.ipv6))
+        elif args.sub == "config":
+            opts = {}
+            for pair in args.options:
+                name, _, val = pair.partition("=")
+                opts[name] = val or "true"
+            _print(s.endpoint_config(args.id, opts))
         elif args.sub == "delete":
             _print(s.endpoint_delete(args.id))
     elif args.cmd == "identity":
@@ -359,8 +423,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             _print(s.identity_list())
         else:
             _print(s.identity_get(args.id))
+    elif args.cmd == "config":
+        if args.options:
+            opts = {}
+            for pair in args.options:
+                name, _, val = pair.partition("=")
+                opts[name] = val or "true"
+            _print(s.config_patch(opts))
+        else:
+            _print(s.config_get())
     elif args.cmd == "bpf":
-        _print(s.policymap_get(args.endpoint, egress=args.egress))
+        if args.sub in ("ct", "ipcache", "tunnel", "proxy", "metrics"):
+            _print(s.map_dump(args.sub))
+        else:
+            _print(s.policymap_get(args.endpoint, egress=args.egress))
     elif args.cmd == "health":
         _print(s.health_probe() if args.probe else s.health())
     elif args.cmd == "bugtool":

@@ -52,6 +52,7 @@ from .monitor.events import AgentNotify, L7Notify
 from .monitor.hub import MonitorHub
 from .ops.materialize import TRAFFIC_EGRESS, TRAFFIC_INGRESS
 from .policy.api.serialization import rule_from_dict, rule_to_dict, rules_from_json
+from .option import OptionMap
 from .policy.repository import Repository
 from .policy.search import Decision, PortContext, SearchContext, Trace
 from .proxy.proxy import Proxy
@@ -115,6 +116,13 @@ class Daemon:
         # proxies by an XDSServer the embedder/CLI attaches
         self.xds_cache = ResourceCache()
         wire_nphds(self.xds_cache, self.ipcache)
+        # runtime-mutable option map (pkg/option: PATCH /config /
+        # `cilium config`); endpoints inherit it (applyOptsLocked)
+        self.options = OptionMap()
+        self.options.set("Policy", True)
+        self.options.set("Conntrack", conntrack)
+        self.options.set("DropNotification", True)
+        self.options.on_change(self._on_option_change)
         # fleet regeneration is synchronous by default (tests and
         # small deployments observe effects immediately); a busy node
         # sets regen_debounce > 0 to fold bursts of endpoint churn
@@ -305,7 +313,7 @@ class Daemon:
                 raise ValueError(f"endpoint {endpoint_id} exists")
             lbls = parse_label_array(labels)
             ep = Endpoint(endpoint_id, lbls, ipv4=ipv4, ipv6=ipv6,
-                          pod_name=pod_name)
+                          pod_name=pod_name, parent_options=self.options)
             # CREATING → WAITING_FOR_IDENTITY → READY (endpoint.go
             # lifecycle) so the first regeneration is legal.
             ep.set_state(EndpointState.WAITING_FOR_IDENTITY)
@@ -479,6 +487,124 @@ class Daemon:
         if ident is None:
             return None
         return {"id": ident.id, "labels": list(ident.labels.to_strings())}
+
+    # -- runtime config (pkg/option; PATCH /config) ----------------------
+    # options whose runtime mutation actually changes behavior; the
+    # rest are rejected so the surface never claims changes it cannot
+    # deliver (the reference verifies per-option too, option.go)
+    _MUTABLE_OPTIONS = frozenset(
+        {"Conntrack", "TraceNotification", "DropNotification", "Debug"}
+    )
+
+    def _on_option_change(self, name: str, value: bool) -> None:
+        if name == "TraceNotification":
+            # trace events for forwarded flows are gated per option
+            self.pipeline.trace_enabled = value
+        elif name == "Conntrack":
+            # detach/reattach the CT pre-pass (flows re-verdict on
+            # every batch while detached)
+            self.pipeline.conntrack = self.conntrack if value else None
+        elif name == "DropNotification":
+            self.pipeline.drop_notifications = value
+        elif name == "Debug":
+            import logging as _logging
+
+            _logging.getLogger("cilium_tpu").setLevel(
+                _logging.DEBUG if value else _logging.INFO
+            )
+        log.info("option changed", fields={"option": name, "value": value})
+
+    def _validated_options(self, options: Dict) -> Dict[str, bool]:
+        """Validate EVERY entry before any mutation — a bad entry in a
+        batch must not leave earlier options silently applied while
+        the client sees a 400."""
+        from .option import OPTION_SPECS, _parse_bool
+
+        out: Dict[str, bool] = {}
+        for name, value in options.items():
+            if name not in OPTION_SPECS:
+                raise ValueError(f"unknown option {name!r}")
+            if name not in self._MUTABLE_OPTIONS:
+                raise ValueError(f"option {name!r} is not runtime-mutable")
+            out[name] = value if isinstance(value, bool) else _parse_bool(value)
+        return out
+
+    def config_get(self) -> Dict:
+        """GET /config (daemon/config.go): static config + the mutable
+        option snapshot."""
+        return {
+            "pod_cidr": str(self.ipam.net),
+            "options": self.options.snapshot(),
+        }
+
+    def config_patch(self, options: Dict) -> Dict:
+        """PATCH /config: mutate runtime options atomically (validate
+        all, then apply)."""
+        validated = self._validated_options(options)
+        changed = [
+            name for name, b in validated.items() if self.options.set(name, b)
+        ]
+        return {"changed": changed, "options": self.options.snapshot()}
+
+    def endpoint_config(self, endpoint_id: int, options: Dict) -> Dict:
+        """PATCH /endpoint/{id}/config (cilium endpoint config):
+        per-endpoint overrides layered over the daemon map."""
+        ep = self.endpoint_manager.lookup(endpoint_id)
+        if ep is None:
+            raise KeyError(f"endpoint {endpoint_id} not found")
+        validated = self._validated_options(options)
+        for name, b in validated.items():
+            ep.options.set(name, b)
+        return {"id": endpoint_id, "options": ep.options.snapshot()}
+
+    # -- map dumps (cilium bpf * list) -----------------------------------
+    def map_dump(self, name: str) -> List[Dict]:
+        """One shared name→dump table for the REST route and the CLI
+        (`cilium bpf <map> list`)."""
+        dumps = {
+            "ct": self.ct_dump,
+            "ipcache": self.ipcache_dump,
+            "tunnel": self.tunnel_dump,
+            "proxy": self.proxymap_dump,
+            "metrics": self.metricsmap_dump,
+        }
+        fn = dumps.get(name)
+        if fn is None:
+            raise ValueError(f"unknown map {name!r}")
+        return fn()
+
+    def ct_dump(self) -> List[Dict]:
+        return self.conntrack.dump() if self.conntrack is not None else []
+
+    def ipcache_dump(self) -> List[Dict]:
+        return [
+            {"cidr": cidr, "identity": e.identity, "source": e.source,
+             "host_ip": e.host_ip}
+            for cidr, e in sorted(self.ipcache.items())
+        ]
+
+    def tunnel_dump(self) -> List[Dict]:
+        return [
+            {"prefix": p, "endpoint": ep} for p, ep in self.tunnel.items()
+        ]
+
+    def proxymap_dump(self) -> List[Dict]:
+        return self.proxymap.items()
+
+    def metricsmap_dump(self) -> List[Dict]:
+        """Per-endpoint forwarded/dropped counters (metricsmap role)."""
+        out = []
+        counters = self.pipeline.counters
+        for idx in range(counters.shape[0]):
+            ep_id = self.pipeline.endpoint_id_at(idx)
+            if ep_id is None:
+                continue
+            fwd, dpol, dother = (int(x) for x in counters[idx])
+            out.append({
+                "endpoint": ep_id, "forwarded": fwd,
+                "dropped_policy": dpol, "dropped_other": dother,
+            })
+        return out
 
     # -- services (daemon/loadbalancer.go PUT/GET/DELETE /service) -------
     @staticmethod

@@ -185,6 +185,35 @@ class FlowConntrack:
                 np.add.at(self.packets, s, 1)
             return state, slot
 
+    def dump(self, limit: int = 4096) -> list:
+        """Readable live entries (cilium bpf ct list). Addresses with a
+        zero high word render as IPv4."""
+        import ipaddress
+
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            live = np.nonzero(self.valid & (self.expires > now))[0][:limit]
+            for s in live:
+                kc = self.kc[s]
+                hi, lo = int(self.ka[s]), int(self.kb[s])
+                if hi == 0 and lo <= 0xFFFFFFFF:
+                    peer = str(ipaddress.ip_address(lo))
+                else:
+                    peer = str(ipaddress.ip_address((hi << 64) | lo))
+                out.append({
+                    "peer": peer,
+                    "endpoint_index": int(kc >> np.uint64(41)),
+                    "sport": int((kc >> np.uint64(25)) & np.uint64(0xFFFF)),
+                    "dport": int((kc >> np.uint64(9)) & np.uint64(0xFFFF)),
+                    "proto": int(unpack_proto(np.uint64(kc))),
+                    "direction": "ingress" if int(kc) & 1 == 0 else "egress",
+                    "packets": int(self.packets[s]),
+                    "revnat": int(self.revnat[s]),
+                    "expires_in_s": round(float(self.expires[s]) - now, 1),
+                })
+        return out
+
     def revnat_of(self, slots: np.ndarray) -> np.ndarray:
         """[B] uint16 revNAT id per CT slot (0 for misses / no NAT)."""
         slots = np.asarray(slots)

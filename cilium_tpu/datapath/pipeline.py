@@ -156,8 +156,10 @@ class DatapathPipeline:
         self.on_redirect = None
         # TraceNotify for forwarded flows is opt-in (the reference
         # gates trace events behind the TraceNotify endpoint option);
-        # DropNotify is always emitted while a listener is attached.
+        # DropNotify defaults on while a listener is attached, gated
+        # by the DropNotification runtime option.
         self.trace_enabled = False
+        self.drop_notifications = True
         self._lb_tables: Dict[int, object] = {}
         self._lb_version = -1
         self._lock = threading.Lock()
@@ -405,7 +407,11 @@ class DatapathPipeline:
                 else idx
             )
 
-        for i in np.nonzero(verdict >= DROP_POLICY)[0]:
+        drop_idx = (
+            np.nonzero(verdict >= DROP_POLICY)[0]
+            if self.drop_notifications else ()
+        )
+        for i in drop_idx:
             addr = bytes(int(b) & 0xFF for b in peer_bytes[i])
             events.append(
                 DropNotify(

@@ -57,6 +57,20 @@ class ProxyMap:
                 return None
             return hit[0]
 
+    def items(self) -> list:
+        """Readable live entries (cilium bpf proxy list)."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                {
+                    "src": f"{k[0]}:{k[1]}", "dst": f"{k[2]}:{k[3]}",
+                    "proto": k[4],
+                    "orig_dst": f"{v.orig_dst_ip}:{v.orig_dst_port}",
+                    "src_identity": v.src_identity,
+                }
+                for k, (v, exp) in self._entries.items() if exp > now
+            ]
+
     def gc(self) -> int:
         now = time.monotonic()
         with self._lock:

@@ -1,0 +1,162 @@
+"""Runtime config PATCH, endpoint config inheritance, map-dump surface.
+
+Reference analogs: daemon/config.go (PATCH /config over the mutable
+option map), `cilium endpoint config` (per-endpoint overrides,
+pkg/option inheritance), `cilium bpf {ct,ipcache,tunnel,proxy,
+metrics}` raw map access, `cilium policy validate|wait`.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from cilium_tpu.api.client import APIClient, APIError
+from cilium_tpu.api.server import APIServer
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.ops.lpm import ip_strings_to_u32
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"k8s:app": "web"}},
+    "ingress": [{"fromEndpoints": [{"matchLabels": {"k8s:app": "lb"}}],
+                 "toPorts": [{"ports": [{"port": "80", "protocol": "TCP"}]}]}],
+    "labels": ["k8s:policy=cm"],
+}]
+
+
+@pytest.fixture()
+def daemon():
+    d = Daemon()
+    d.policy_add(json.dumps(RULES))
+    d.endpoint_add(7, ["k8s:app=web"], ipv4="10.200.0.7")
+    d.endpoint_add(9, ["k8s:app=lb"], ipv4="10.200.0.9")
+    yield d
+    d.shutdown()
+
+
+class TestRuntimeConfig:
+    def test_patch_and_trace_wiring(self, daemon):
+        cfg = daemon.config_get()
+        assert cfg["options"]["Policy"] and cfg["options"]["Conntrack"]
+        assert not daemon.pipeline.trace_enabled
+        out = daemon.config_patch({"TraceNotification": "true"})
+        assert "TraceNotification" in out["changed"]
+        assert daemon.pipeline.trace_enabled  # option drives the pipeline
+        daemon.config_patch({"TraceNotification": False})
+        assert not daemon.pipeline.trace_enabled
+        with pytest.raises(ValueError):
+            daemon.config_patch({"Bogus": True})
+        with pytest.raises(ValueError):
+            daemon.config_patch({"Policy": False})  # not runtime-mutable
+
+    def test_patch_is_atomic(self, daemon):
+        """A bad entry must not leave earlier options applied."""
+        assert not daemon.pipeline.trace_enabled
+        with pytest.raises(ValueError):
+            daemon.config_patch({"TraceNotification": True, "Bogus": True})
+        assert not daemon.pipeline.trace_enabled
+        assert not daemon.config_get()["options"].get("TraceNotification")
+
+    def test_conntrack_and_dropnotify_wiring(self, daemon):
+        assert daemon.pipeline.conntrack is daemon.conntrack
+        daemon.config_patch({"Conntrack": False})
+        assert daemon.pipeline.conntrack is None
+        daemon.config_patch({"Conntrack": True})
+        assert daemon.pipeline.conntrack is daemon.conntrack
+        daemon.config_patch({"DropNotification": False})
+        assert not daemon.pipeline.drop_notifications
+        daemon.config_patch({"DropNotification": True})
+
+    def test_endpoint_inherits_and_overrides(self, daemon):
+        ep = daemon.endpoint_manager.lookup(7)
+        assert ep.options.get("Conntrack")  # inherited from daemon map
+        daemon.endpoint_config(7, {"Debug": True})
+        assert ep.options.get("Debug")
+        other = daemon.endpoint_manager.lookup(9)
+        assert not other.options.get("Debug")  # override is per-endpoint
+        with pytest.raises(KeyError):
+            daemon.endpoint_config(999, {"Debug": True})
+
+
+class TestMapDumps:
+    def test_ct_and_metrics_dump(self, daemon):
+        ep = daemon.pipeline.endpoint_index(7)
+        v, _ = daemon.pipeline.process(
+            ip_strings_to_u32(["10.200.0.9", "10.200.0.9"]),
+            np.full(2, ep, np.int32),
+            np.array([80, 443], np.int32), np.array([6, 6], np.int32),
+            ingress=True, sports=np.array([4444, 4445]),
+        )
+        assert v.tolist() == [1, 2]
+        ct = daemon.ct_dump()
+        assert len(ct) == 1  # only the allowed flow created CT state
+        assert ct[0]["peer"] == "10.200.0.9" and ct[0]["dport"] == 80
+        assert ct[0]["direction"] == "ingress" and ct[0]["expires_in_s"] > 0
+        metrics = daemon.metricsmap_dump()
+        row = next(m for m in metrics if m["endpoint"] == 7)
+        assert row["forwarded"] >= 1 and row["dropped_policy"] >= 1
+
+    def test_ipcache_and_tunnel_dump(self, daemon):
+        ipc = daemon.ipcache_dump()
+        assert any(e["cidr"] == "10.200.0.7/32" for e in ipc)
+        daemon.tunnel.upsert("10.9.0.0/24", "192.168.1.2")
+        assert daemon.tunnel_dump() == [
+            {"prefix": "10.9.0.0/24", "endpoint": "192.168.1.2"},
+        ]
+
+
+class TestRESTAndCLI:
+    def test_config_and_maps_over_rest(self, daemon, tmp_path):
+        srv = APIServer(daemon, str(tmp_path / "api.sock"))
+        srv.start()
+        try:
+            c = APIClient(str(tmp_path / "api.sock"))
+            assert c.config_get()["options"]["Policy"]
+            out = c.config_patch({"TraceNotification": True})
+            assert out["options"]["TraceNotification"]
+            assert c.endpoint_config(7, {"Debug": True})["options"]["Debug"]
+            with pytest.raises(APIError):
+                c.config_patch({"Nope": True})
+            assert any(
+                e["cidr"] == "10.200.0.9/32" for e in c.map_dump("ipcache")
+            )
+            assert c.map_dump("ct") == []
+            assert isinstance(c.map_dump("metrics"), list)
+        finally:
+            srv.stop()
+
+    def test_cli_validate_and_config(self, tmp_path, capsys):
+        from cilium_tpu.cli import main
+
+        state = str(tmp_path / "state")
+        sock = str(tmp_path / "none.sock")
+        rules = tmp_path / "r.json"
+        rules.write_text(json.dumps(RULES))
+        assert main(["--socket", sock, "--state", state,
+                     "policy", "validate", str(rules)]) == 0
+        assert "valid: 1 rule" in capsys.readouterr().out
+        bad = tmp_path / "bad.json"
+        bad.write_text('[{"endpointSelector": {}, "ingress": [{"fromCIDR": ["nope"]}]}]')
+        assert main(["--socket", sock, "--state", state,
+                     "policy", "validate", str(bad)]) == 1
+        # config get + patch standalone
+        assert main(["--socket", sock, "--state", state, "config"]) == 0
+        assert '"Policy": true' in capsys.readouterr().out
+        assert main(["--socket", sock, "--state", state, "config",
+                     "Debug=true"]) == 0
+        assert '"Debug": true' in capsys.readouterr().out
+
+    def test_cli_policy_wait(self, daemon, tmp_path):
+        from cilium_tpu.cli import main
+
+        srv = APIServer(daemon, str(tmp_path / "w.sock"))
+        srv.start()
+        try:
+            assert main(["--socket", str(tmp_path / "w.sock"),
+                         "policy", "wait", "1", "--timeout", "5"]) == 0
+            assert main(["--socket", str(tmp_path / "w.sock"),
+                         "policy", "wait", "99999", "--timeout", "0.5"]) == 1
+        finally:
+            srv.stop()
