@@ -137,11 +137,10 @@ class _Surface:
 def _parse_frontend(text: str) -> dict:
     """'10.96.0.10:80/TCP' → frontend dict (cilium service update
     --frontend format, cilium/cmd/service_update.go)."""
-    proto = "TCP"
-    if "/" in text:
-        text, proto = text.rsplit("/", 1)
-    ip, port = text.rsplit(":", 1)
-    return {"ip": ip.strip("[]"), "port": int(port), "protocol": proto.upper()}
+    from .lb.service import L3n4Addr
+
+    fe = L3n4Addr.from_string(text)
+    return {"ip": fe.ip, "port": fe.port, "protocol": fe.protocol}
 
 
 def _parse_backend(text: str) -> dict:

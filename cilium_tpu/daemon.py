@@ -111,6 +111,9 @@ class Daemon:
         # cilium_proxy4/6 write of bpf_lxc.c; the L7 front-end reads
         # them back to recover original destination + source identity)
         self.pipeline.on_redirect = self._record_proxy_flow
+        # per-endpoint option resolution for event gating (`cilium
+        # endpoint config` overrides, layered over the daemon map)
+        self.pipeline.endpoint_options = self._endpoint_option
         # xDS distribution (pkg/envoy xDS): NPDS per-endpoint L7
         # policy + NPHDS identity→addresses, served to external
         # proxies by an XDSServer the embedder/CLI attaches
@@ -390,6 +393,12 @@ class Daemon:
         )
         self.lxcmap.sync_endpoints(eps)  # daemon.go:953 syncLXCMap
 
+    def _endpoint_option(self, ep_id: int, name: str, default: bool) -> bool:
+        ep = self.endpoint_manager.lookup(ep_id)
+        if ep is None:
+            return default
+        return ep.options.get(name)  # inherits the daemon map
+
     def _record_proxy_flow(
         self, peer_addr: bytes, ep_idx: int, sport: int, dport: int,
         proto: int, ingress: bool, family: int,
@@ -502,7 +511,12 @@ class Daemon:
             self.pipeline.trace_enabled = value
         elif name == "Conntrack":
             # detach/reattach the CT pre-pass (flows re-verdict on
-            # every batch while detached)
+            # every batch while detached). Reattach FLUSHES: policy
+            # may have changed while detached (the detached table
+            # skips the pipeline's basis-move flushes), so stale
+            # established-flow bypasses must not come back with it.
+            if value and self.conntrack is not None:
+                self.conntrack.flush()
             self.pipeline.conntrack = self.conntrack if value else None
         elif name == "DropNotification":
             self.pipeline.drop_notifications = value
@@ -526,6 +540,13 @@ class Daemon:
                 raise ValueError(f"unknown option {name!r}")
             if name not in self._MUTABLE_OPTIONS:
                 raise ValueError(f"option {name!r} is not runtime-mutable")
+            if name == "Conntrack" and self.conntrack is None:
+                # a daemon started without a CT table cannot deliver
+                # this change — reporting it applied would lie
+                raise ValueError(
+                    "Conntrack cannot be enabled: daemon started "
+                    "without a conntrack table"
+                )
             out[name] = value if isinstance(value, bool) else _parse_bool(value)
         return out
 

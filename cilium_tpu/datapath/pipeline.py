@@ -160,6 +160,12 @@ class DatapathPipeline:
         # by the DropNotification runtime option.
         self.trace_enabled = False
         self.drop_notifications = True
+        # optional per-endpoint option resolver:
+        # fn(endpoint_id, option_name, default) -> bool. The daemon
+        # points this at each endpoint's OptionMap so `cilium endpoint
+        # config` overrides actually gate that endpoint's events
+        # (applyOptsLocked inheritance, pkg/option).
+        self.endpoint_options = None
         self._lb_tables: Dict[int, object] = {}
         self._lb_version = -1
         self._lock = threading.Lock()
@@ -407,11 +413,17 @@ class DatapathPipeline:
                 else idx
             )
 
-        drop_idx = (
-            np.nonzero(verdict >= DROP_POLICY)[0]
-            if self.drop_notifications else ()
-        )
-        for i in drop_idx:
+        def _opt(ep_id: int, name: str, default: bool) -> bool:
+            if self.endpoint_options is None:
+                return default
+            try:
+                return bool(self.endpoint_options(ep_id, name, default))
+            except Exception:
+                return default
+
+        for i in np.nonzero(verdict >= DROP_POLICY)[0]:
+            if not _opt(_ep(i), "DropNotification", self.drop_notifications):
+                continue
             addr = bytes(int(b) & 0xFF for b in peer_bytes[i])
             events.append(
                 DropNotify(
@@ -425,8 +437,11 @@ class DatapathPipeline:
                     ingress=ingress,
                 )
             )
-        if self.trace_enabled:
-            for i in np.nonzero(verdict == FORWARD)[0]:
+        # forwarded flows are the bulk of a batch — skip the per-flow
+        # walk entirely unless traces can possibly be on
+        trace_possible = self.trace_enabled or self.endpoint_options is not None
+        for i in np.nonzero(verdict == FORWARD)[0] if trace_possible else ():
+            if _opt(_ep(i), "TraceNotification", self.trace_enabled):
                 addr = bytes(int(b) & 0xFF for b in peer_bytes[i])
                 to_proxy = redirect is not None and bool(redirect[i])
                 events.append(

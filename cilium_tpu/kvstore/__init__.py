@@ -17,6 +17,7 @@ from .backend import (
 )
 from .allocator import Allocator, AllocatorError
 from .clustermesh import ClusterMesh, RemoteCluster
+from .filestore import FileBackend, FlakyBackend
 from .store import SharedStore
 
 __all__ = [
@@ -28,6 +29,8 @@ __all__ = [
     "EventTypeDelete",
     "EventTypeListDone",
     "EventTypeModify",
+    "FileBackend",
+    "FlakyBackend",
     "InMemoryBackend",
     "InMemoryStore",
     "KVEvent",

@@ -49,7 +49,8 @@ def _key_to_labels(key: str):
 
 class RemoteCluster:
     """Subscriptions into one remote cluster's kvstore
-    (remote_cluster.go): nodes + identities + ipcache."""
+    (remote_cluster.go): nodes + identities + ipcache + exported
+    services (the global-service backend merge)."""
 
     def __init__(
         self,
@@ -58,15 +59,20 @@ class RemoteCluster:
         registry: IdentityRegistry,
         ipcache: IPCache,
         on_node: Optional[Callable[[str, Node, bool], None]] = None,
+        services=None,  # Optional[lb.service.ServiceManager]
     ) -> None:
         self.name = name
         self.backend = backend
         self.registry = registry
         self.ipcache = ipcache
+        self.services = services
         self._on_node = on_node
         self._id_prefix = f"{IDENTITIES_PATH}/id/"
         self._ip_prefix = f"{IP_IDENTITIES_PATH}/{name}/"
         self._node_prefix = f"{NODES_PATH}/"
+        from ..lb.service import SERVICES_EXPORT_PATH
+
+        self._svc_prefix = f"{SERVICES_EXPORT_PATH}/{name}/"
         self._w_ids: Watcher = backend.list_and_watch(
             f"mesh-{name}-identities", self._id_prefix
         )
@@ -76,8 +82,13 @@ class RemoteCluster:
         self._w_nodes: Watcher = backend.list_and_watch(
             f"mesh-{name}-nodes", self._node_prefix
         )
+        self._w_svcs: Optional[Watcher] = (
+            backend.list_and_watch(f"mesh-{name}-services", self._svc_prefix)
+            if services is not None else None
+        )
         self._held_ids: Dict[int, bool] = {}
         self._ip_entries: set = set()
+        self._svc_frontends: set = set()
         self.nodes: Dict[str, Node] = {}
         self.pump()
 
@@ -149,7 +160,47 @@ class RemoteCluster:
                 self.nodes[name] = node
                 if self._on_node:
                     self._on_node(self.name, node, True)
+        if self._w_svcs is not None:
+            from ..lb.service import Backend, L3n4Addr
+
+            for ev in self._w_svcs.drain():
+                n += 1
+                if ev.typ == EventTypeListDone:
+                    continue
+                fe_str = ev.key[len(self._svc_prefix):]
+                if ev.typ == EventTypeDelete:
+                    fe = self._parse_frontend(fe_str)
+                    if fe is not None:
+                        self.services.set_remote_backends(fe, self.name, [])
+                        self._svc_frontends.discard(fe)
+                    continue
+                try:
+                    payload = json.loads((ev.value or b"{}").decode())
+                    f = payload["frontend"]
+                    fe = L3n4Addr(f["ip"], int(f["port"]),
+                                  str(f.get("protocol", "TCP")))
+                    backs = [
+                        Backend(b["ip"], int(b["port"]),
+                                int(b.get("weight", 1)))
+                        for b in payload.get("backends", [])
+                    ]
+                    # set_remote_backends validates addresses — a
+                    # remote cluster's malformed export must be
+                    # skipped, not crash this pump loop
+                    self.services.set_remote_backends(fe, self.name, backs)
+                except (ValueError, KeyError, TypeError):
+                    continue
+                self._svc_frontends.add(fe)
         return n
+
+    @staticmethod
+    def _parse_frontend(text: str):
+        from ..lb.service import L3n4Addr
+
+        try:
+            return L3n4Addr.from_string(text)
+        except ValueError:
+            return None
 
     def on_remove(self) -> None:
         """Withdraw everything this cluster contributed (clustermesh
@@ -161,7 +212,14 @@ class RemoteCluster:
         for cidr in list(self._ip_entries):
             self.ipcache.delete(cidr, SOURCE_KVSTORE)
         self._ip_entries.clear()
-        for w in (self._w_ids, self._w_ips, self._w_nodes):
+        if self.services is not None:
+            for fe in list(self._svc_frontends):
+                self.services.set_remote_backends(fe, self.name, [])
+            self._svc_frontends.clear()
+        watchers = [self._w_ids, self._w_ips, self._w_nodes]
+        if self._w_svcs is not None:
+            watchers.append(self._w_svcs)
+        for w in watchers:
             self.backend.stop_watcher(w)
 
 
@@ -175,10 +233,12 @@ class ClusterMesh:
         ipcache: IPCache,
         *,
         on_node: Optional[Callable[[str, Node, bool], None]] = None,
+        services=None,  # Optional[lb.service.ServiceManager]
     ) -> None:
         self.registry = registry
         self.ipcache = ipcache
         self._on_node = on_node
+        self._services = services
         self._lock = threading.RLock()
         self.clusters: Dict[str, RemoteCluster] = {}
 
@@ -187,7 +247,8 @@ class ClusterMesh:
             if name in self.clusters:
                 return self.clusters[name]
             rc = RemoteCluster(
-                name, backend, self.registry, self.ipcache, self._on_node
+                name, backend, self.registry, self.ipcache, self._on_node,
+                services=self._services,
             )
             self.clusters[name] = rc
             return rc

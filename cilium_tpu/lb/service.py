@@ -23,6 +23,7 @@ from .device import LBTables, MAX_SEQ
 
 SERVICES_ID_PATH = "cilium/state/services/v1/id"
 SERVICES_VALUE_PATH = "cilium/state/services/v1/value"
+SERVICES_EXPORT_PATH = "cilium/state/services/v1/exports"
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -45,6 +46,17 @@ class L3n4Addr:
 
     def __str__(self) -> str:
         return f"{self.ip}:{self.port}/{self.protocol}"
+
+    @classmethod
+    def from_string(cls, text: str) -> "L3n4Addr":
+        """Inverse of __str__ ('ip:port[/proto]', brackets around v6
+        literals tolerated) — the ONE place the frontend wire format
+        is parsed (CLI args, clustermesh export keys)."""
+        proto = "TCP"
+        if "/" in text:
+            text, proto = text.rsplit("/", 1)
+        ip, _, port = text.rpartition(":")
+        return cls(ip.strip("[]"), int(port), proto.upper())
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,6 +144,9 @@ class ServiceManager:
         self._kv = kvstore
         self.version = 0
         self._synced_frontends: set = set()  # frontends owned by k8s sync
+        # (frontend, remote_cluster) → backends merged in via
+        # clustermesh (the global-service merge; remote_cluster.go)
+        self._remote: Dict[Tuple[L3n4Addr, str], Tuple[Backend, ...]] = {}
 
     # -- id allocation --------------------------------------------------
     def _allocate_id(self, frontend: L3n4Addr) -> int:
@@ -209,6 +224,37 @@ class ServiceManager:
         with self._lock:
             return sorted(self._services.values(), key=lambda s: s.id)
 
+    # -- clustermesh merge (global services) ----------------------------
+    def set_remote_backends(
+        self, frontend: L3n4Addr, cluster: str, backends: Sequence[Backend]
+    ) -> None:
+        """Merge (or clear, with an empty list) one remote cluster's
+        backends for a frontend. Only frontends that exist LOCALLY are
+        served — the local cluster decides which services are global
+        (remote_cluster.go mergeExternalServiceUpdate)."""
+        with self._lock:
+            key = (frontend, cluster)
+            if backends:
+                self._validate(frontend, backends)
+                self._remote[key] = tuple(backends)
+            elif key not in self._remote:
+                return
+            else:
+                del self._remote[key]
+            self.version += 1
+
+    def effective_backends(self, frontend: L3n4Addr) -> List[Backend]:
+        """Own backends + every remote cluster's merged backends."""
+        with self._lock:
+            svc = self._services.get(frontend)
+            out = list(svc.backends) if svc else []
+            for (fe, _cluster), backs in sorted(
+                self._remote.items(), key=lambda kv: kv[0][1]
+            ):
+                if fe == frontend:
+                    out.extend(backs)
+            return out
+
     def rev_nat(self, revnat_id: int) -> Optional[L3n4Addr]:
         """revNAT id → original frontend (the cilium_lb4_reverse_nat
         role): rewrites reply source back to the VIP."""
@@ -258,6 +304,40 @@ class ServiceManager:
             self._synced_frontends = synced
         return len(synced)
 
+    # -- clustermesh export ---------------------------------------------
+    def export_to_store(self, backend, cluster: str) -> int:
+        """Publish this cluster's services (frontend + OWN backends,
+        never merged remote ones — re-export loops would amplify) for
+        clustermesh consumers. Lease-bound: a dead agent's export
+        disappears with its lease. Idempotent full sync; returns the
+        exported service count."""
+        import json as _json
+
+        prefix = f"{SERVICES_EXPORT_PATH}/{cluster}/"
+        with self._lock:
+            services = list(self._services.values())
+        desired = {}
+        for svc in services:
+            desired[prefix + str(svc.frontend)] = _json.dumps({
+                "frontend": {
+                    "ip": svc.frontend.ip,
+                    "port": svc.frontend.port,
+                    "protocol": svc.frontend.protocol,
+                },
+                "backends": [
+                    {"ip": b.ip, "port": b.port, "weight": b.weight}
+                    for b in svc.backends
+                ],
+            }, sort_keys=True).encode()
+        existing = backend.list_prefix(prefix)
+        for key in existing:
+            if key not in desired:
+                backend.delete(key)
+        for key, value in desired.items():
+            if existing.get(key) != value:
+                backend.update(key, value, lease=True)
+        return len(desired)
+
     # -- device snapshot ------------------------------------------------
     def build_device(self) -> Dict[int, Optional[LBTables]]:
         """→ {4: LBTables|None, 6: LBTables|None} (None = no frontends
@@ -286,7 +366,7 @@ class ServiceManager:
                 fe_revnat[i] = svc.id
                 base = len(be_rows)
                 live = [
-                    b for b in svc.backends
+                    b for b in self.effective_backends(svc.frontend)
                     if ipaddress.ip_address(b.ip).version == (6 if family == 6 else 4)
                 ]
                 for b in live:

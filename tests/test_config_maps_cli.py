@@ -69,6 +69,37 @@ class TestRuntimeConfig:
         assert not daemon.pipeline.drop_notifications
         daemon.config_patch({"DropNotification": True})
 
+    def test_endpoint_option_gates_events(self, daemon):
+        """`cilium endpoint config` overrides must actually gate that
+        endpoint's events — not just echo back from the API."""
+        sub = daemon.monitor.subscribe()
+        src = ip_strings_to_u32(["10.200.0.9"])
+        ep = daemon.pipeline.endpoint_index(7)
+        args = (src, np.array([ep], np.int32),
+                np.array([80], np.int32), np.array([6], np.int32))
+        daemon.pipeline.process(*args)  # allowed; traces off → silence
+        assert sub.drain() == []
+        daemon.endpoint_config(7, {"TraceNotification": True})
+        daemon.pipeline.process(*args)
+        evs = sub.drain()
+        assert len(evs) == 1 and evs[0].endpoint == 7
+        # endpoint 9 (no override) stays silent for its own traffic
+        ep9 = daemon.pipeline.endpoint_index(9)
+        daemon.pipeline.process(
+            ip_strings_to_u32(["10.200.0.7"]), np.array([ep9], np.int32),
+            np.array([9999], np.int32), np.array([6], np.int32),
+        )
+        assert all(e.endpoint != 9 or e.type != 2 for e in sub.drain())
+        sub.close()
+
+    def test_conntrack_disabled_daemon_rejects_enable(self):
+        from cilium_tpu.daemon import Daemon
+
+        d = Daemon(conntrack=False)
+        with pytest.raises(ValueError, match="Conntrack"):
+            d.config_patch({"Conntrack": True})
+        d.shutdown()
+
     def test_endpoint_inherits_and_overrides(self, daemon):
         ep = daemon.endpoint_manager.lookup(7)
         assert ep.options.get("Conntrack")  # inherited from daemon map

@@ -1,0 +1,206 @@
+"""File-backed kvstore (cross-process), outage injection, remote
+services over clustermesh.
+
+Reference analogs: pkg/kvstore etcd backend (leases, watch, locks),
+test/runtime/kvstore.go (outage chaos), clustermesh.go:49,103 remote
+services subscription + global-service backend merge.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from cilium_tpu.kvstore import (
+    Allocator,
+    EventTypeCreate,
+    EventTypeDelete,
+    EventTypeListDone,
+    FileBackend,
+    FlakyBackend,
+    InMemoryBackend,
+    InMemoryStore,
+    LockTimeout,
+)
+from cilium_tpu.lb import Backend, L3n4Addr, ServiceManager
+
+
+class TestFileBackend:
+    def test_crud_and_watch(self, tmp_path):
+        db = str(tmp_path / "kv.db")
+        b1 = FileBackend(db, "n1")
+        b2 = FileBackend(db, "n2")
+        try:
+            assert b1.create_only("a/x", b"1")
+            assert not b2.create_only("a/x", b"2")  # CAS across clients
+            assert b2.get("a/x") == b"1"
+            w = b2.list_and_watch("w", "a/")
+            evs = w.drain()
+            assert [e.typ for e in evs] == [EventTypeCreate, EventTypeListDone]
+            b1.set("a/y", b"3")
+            b1.delete("a/x")
+            deadline = time.time() + 5
+            got = []
+            while time.time() < deadline and len(got) < 2:
+                got.extend(w.drain())
+                time.sleep(0.02)
+            assert [(e.typ, e.key) for e in got] == [
+                (EventTypeCreate, "a/y"), (EventTypeDelete, "a/x"),
+            ]
+            assert b1.list_prefix("a/") == {"a/y": b"3"}
+        finally:
+            b1.close()
+            b2.close()
+
+    def test_lease_death_removes_keys(self, tmp_path):
+        db = str(tmp_path / "kv.db")
+        b1 = FileBackend(db, "n1", lease_ttl=0.3)
+        b2 = FileBackend(db, "n2")
+        try:
+            b1.update("nodes/n1", b"alive", lease=True)
+            assert b2.get("nodes/n1") == b"alive"
+            # kill n1's keepalive (simulated agent death) and wait out
+            # the TTL: any other client's next op sweeps the key
+            b1._closed.set()
+            time.sleep(0.6)
+            assert b2.get("nodes/n1") is None
+        finally:
+            b1.close()
+            b2.close()
+
+    def test_locks(self, tmp_path):
+        db = str(tmp_path / "kv.db")
+        b1 = FileBackend(db, "n1")
+        b2 = FileBackend(db, "n2")
+        try:
+            lock = b1.lock_path("ids", timeout=2.0)
+            with pytest.raises(LockTimeout):
+                b2.lock_path("ids", timeout=0.3)
+            lock.unlock()
+            b2.lock_path("ids", timeout=2.0).unlock()
+        finally:
+            b1.close()
+            b2.close()
+
+    def test_cross_process(self, tmp_path):
+        """A REAL second process allocates through the same file —
+        identity numbering converges across process boundaries."""
+        db = str(tmp_path / "kv.db")
+        b1 = FileBackend(db, "p1")
+        try:
+            a1 = Allocator(b1, "alloc", suffix="p1", min_id=256, max_id=400)
+            id_web, _ = a1.allocate("k8s:app=web")
+            script = textwrap.dedent(f"""
+                import sys
+                sys.path.insert(0, {repr("/root/repo")})
+                from cilium_tpu.kvstore import FileBackend, Allocator
+                b = FileBackend({db!r}, "p2")
+                a = Allocator(b, "alloc", suffix="p2", min_id=256, max_id=400)
+                id_web, created = a.allocate("k8s:app=web")
+                id_db, _ = a.allocate("k8s:app=db")
+                print(id_web, int(created), id_db)
+                b.close()
+            """)
+            out = subprocess.run(
+                [sys.executable, "-c", script], capture_output=True,
+                text=True, timeout=60,
+                env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"},
+            )
+            assert out.returncode == 0, out.stderr[-500:]
+            remote_web, created, remote_db = out.stdout.split()
+            # same key ⇒ same id across processes; new key ⇒ distinct
+            assert int(remote_web) == id_web and created == "0"
+            assert int(remote_db) != id_web
+            a1.pump()
+            assert a1.get("k8s:app=db") == int(remote_db)
+        finally:
+            b1.close()
+
+
+class TestOutage:
+    def test_allocator_survives_kvstore_outage(self):
+        store = InMemoryStore()
+        flaky = FlakyBackend(InMemoryBackend(store, "n1"))
+        a = Allocator(flaky, "alloc", suffix="n1", min_id=256, max_id=400)
+        id1, _ = a.allocate("k8s:app=web")
+        flaky.fail(True)
+        # during the outage: local cache still answers, new allocation
+        # fails loudly (no silent split-brain numbering)
+        assert a.get("k8s:app=web") == id1
+        with pytest.raises(Exception):
+            a.allocate("k8s:app=db")
+        assert flaky.op_errors > 0
+        # recovery: allocation works again and numbering is unchanged
+        flaky.fail(False)
+        id2, _ = a.allocate("k8s:app=db")
+        assert id2 != id1
+        assert a.get("k8s:app=web") == id1
+
+
+class TestRemoteServices:
+    def _mesh_world(self):
+        from cilium_tpu.identity import IdentityRegistry
+        from cilium_tpu.ipcache.ipcache import IPCache
+        from cilium_tpu.kvstore import ClusterMesh
+
+        remote_store = InMemoryStore()
+        remote_backend = InMemoryBackend(remote_store, "remote-agent")
+        local_services = ServiceManager()
+        fe = L3n4Addr("10.96.0.10", 80, "TCP")
+        local_services.upsert(fe, [Backend("10.0.0.3", 8080)])
+        mesh = ClusterMesh(
+            IdentityRegistry(), IPCache(), services=local_services
+        )
+        return remote_store, remote_backend, local_services, mesh, fe
+
+    def test_remote_backend_merge_and_withdraw(self):
+        remote_store, remote_backend, services, mesh, fe = self._mesh_world()
+        # the remote cluster exports its services
+        remote_services = ServiceManager()
+        remote_services.upsert(fe, [Backend("172.20.0.9", 8080)])
+        remote_services.export_to_store(remote_backend, "cluster-b")
+        mesh.add_cluster("cluster-b", InMemoryBackend(remote_store, "local"))
+        mesh.pump()
+        backs = {b.ip for b in services.effective_backends(fe)}
+        assert backs == {"10.0.0.3", "172.20.0.9"}  # merged
+        # remote backend set changes → merge follows
+        remote_services.upsert(fe, [Backend("172.20.0.10", 8080)])
+        remote_services.export_to_store(remote_backend, "cluster-b")
+        mesh.pump()
+        backs = {b.ip for b in services.effective_backends(fe)}
+        assert backs == {"10.0.0.3", "172.20.0.10"}
+        # removing the cluster withdraws every merged backend
+        mesh.remove_cluster("cluster-b")
+        assert {b.ip for b in services.effective_backends(fe)} == {"10.0.0.3"}
+
+    def test_remote_only_frontends_not_served(self):
+        remote_store, remote_backend, services, mesh, fe = self._mesh_world()
+        remote_services = ServiceManager()
+        other = L3n4Addr("10.96.0.99", 80, "TCP")
+        remote_services.upsert(other, [Backend("172.20.0.9", 8080)])
+        remote_services.export_to_store(remote_backend, "cluster-b")
+        mesh.add_cluster("cluster-b", InMemoryBackend(remote_store, "local"))
+        mesh.pump()
+        # the local cluster has no such frontend → not programmed
+        tables = services.build_device()[4]
+        import numpy as np
+
+        assert not (np.asarray(tables.fe_bytes) == np.array(
+            [10, 96, 0, 99], np.int32
+        )).all(axis=1).any()
+
+    def test_export_is_lease_bound(self):
+        store = InMemoryStore()
+        agent = InMemoryBackend(store, "agent-b")
+        sm = ServiceManager()
+        sm.upsert(L3n4Addr("10.96.0.10", 80, "TCP"), [Backend("10.0.0.1", 80)])
+        sm.export_to_store(agent, "cluster-b")
+        reader = InMemoryBackend(store, "reader")
+        prefix = "cilium/state/services/v1/exports/cluster-b/"
+        assert len(reader.list_prefix(prefix)) == 1
+        store.revoke_lease(agent.lease_id)  # agent dies
+        assert reader.list_prefix(prefix) == {}
