@@ -141,27 +141,24 @@ def _bench_rule_update(engine, repo, rng) -> float:
 def _bench_lpm_50k(nrng: np.random.Generator) -> float:
     """50k-prefix LPM match rate (BASELINE.md north-star: the ipcache
     identity-derivation stage at production prefix counts,
-    bpf/node_config.h IPCACHE_MAP_SIZE envelope)."""
-    from cilium_tpu.ops.lpm import TrieBuilder, ipv4_to_bytes, lpm_lookup
+    bpf/node_config.h IPCACHE_MAP_SIZE envelope). Measures the wide
+    (dense-16-bit-first-stride) trie the IPv4 datapath actually runs."""
+    from cilium_tpu.ops.lpm import WideTrieBuilder, lpm_lookup_wide
 
-    tb = TrieBuilder(4)
+    tb = WideTrieBuilder()
     addrs = nrng.integers(0, 2**32, 50_000, dtype=np.uint64).astype(np.uint32)
     plens = nrng.choice(np.array([8, 12, 16, 20, 24, 28, 32]), 50_000)
     for a, pl in zip(addrs.tolist(), plens.tolist()):
-        a &= (0xFFFFFFFF << (32 - pl)) & 0xFFFFFFFF
-        tb.insert(a.to_bytes(4, "big"), pl, a % 65000)
-    child, info = tb.arrays()
-    child_j, info_j = jnp.asarray(child), jnp.asarray(info)
+        tb.insert(a, pl, a % 65000)
+    arrays = tuple(jnp.asarray(a) for a in tb.arrays())
     b = 1 << 20
-    q = jnp.asarray(
-        ipv4_to_bytes(nrng.integers(0, 2**32, b, dtype=np.uint64).astype(np.uint32))
-    )
-    r = lpm_lookup(child_j, info_j, q, levels=4)
+    q = jnp.asarray(nrng.integers(0, 2**32, b, dtype=np.uint64).astype(np.uint32))
+    r = lpm_lookup_wide(*arrays, q)
     jax.block_until_ready(r)
     iters = 10
     t0 = time.time()
     for _ in range(iters):
-        r = lpm_lookup(child_j, info_j, q, levels=4)
+        r = lpm_lookup_wide(*arrays, q)
     jax.block_until_ready(r)
     return iters * b / (time.time() - t0)
 

@@ -750,8 +750,11 @@ class Daemon:
             )
             try:
                 with os.fdopen(fd, "w") as f:
+                    from .state_migrate import SCHEMA_VERSION
+
                     json.dump(
                         {
+                            "schema": SCHEMA_VERSION,
                             "rules": rules,
                             "endpoints": eps,
                             "services": self.service_list(),
@@ -775,6 +778,10 @@ class Daemon:
             return 0
         with open(path) as f:
             snap = json.load(f)
+        # upgrade older snapshots in memory (cilium-map-migrate role)
+        from .state_migrate import migrate
+
+        snap = migrate(snap)
         rules = [rule_from_dict(d) for d in snap.get("rules", [])]
         if rules:
             self.repo.add_list(rules)

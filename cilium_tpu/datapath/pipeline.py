@@ -36,7 +36,12 @@ from ..identity.model import ID_WORLD
 from ..ipcache.ipcache import IPCache
 from ..ipcache.prefilter import PreFilter
 from ..ops.lookup import PolicymapTables, lookup_batch
-from ..ops.lpm import lpm_lookup, ipv4_to_bytes
+from ..ops.lpm import (
+    build_wide_trie,
+    ipv4_to_bytes,
+    lpm_lookup,
+    lpm_lookup_wide,
+)
 from ..ops.materialize import (
     EndpointPolicySnapshot,
     MaterializedState,
@@ -65,6 +70,53 @@ class DatapathTables:
     ip_info: jnp.ndarray
     world_row: jnp.ndarray  # [] int32
     policymap: PolicymapTables
+
+
+@chex.dataclass(frozen=True)
+class WideDatapathTables:
+    """IPv4 device state using the dense-16-bit-first-stride tries
+    (ops/lpm.py WideTrieBuilder) — 3 gathers per LPM instead of 4,
+    measured ~1.8× on the identity-derivation stage."""
+
+    pf_root_info: jnp.ndarray  # [65536] int32
+    pf_root_child: jnp.ndarray
+    pf_sub_child: jnp.ndarray  # [M, 256] int32
+    pf_sub_info: jnp.ndarray
+    ip_root_info: jnp.ndarray
+    ip_root_child: jnp.ndarray
+    ip_sub_child: jnp.ndarray
+    ip_sub_info: jnp.ndarray
+    world_row: jnp.ndarray  # [] int32
+    policymap: PolicymapTables
+
+
+def _verdict_tail(
+    policymap: PolicymapTables,
+    denied_pf: jnp.ndarray,
+    peer_row: jnp.ndarray,
+    ep_idx: jnp.ndarray,
+    dport: jnp.ndarray,
+    proto: jnp.ndarray,
+    ep_count: int,
+    block: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Shared post-LPM tail (policy lookup, prefilter override,
+    counter matmul) — traced inside both jitted entry points so the
+    v4/v6 paths cannot diverge."""
+    dec, red = lookup_batch(policymap, ep_idx, peer_row, dport, proto, block=block)
+    verdict = jnp.where(denied_pf, jnp.int8(DROP_PREFILTER), dec)
+    redirect = red & ~denied_pf
+
+    # counters via one-hot matmul [B, EP]ᵀ @ [B, 3]
+    ep_oh = (ep_idx[:, None] == jnp.arange(ep_count)[None, :]).astype(jnp.int8)
+    cls = jnp.stack(
+        [verdict == FORWARD, verdict == DROP_POLICY, verdict == DROP_PREFILTER],
+        axis=1,
+    ).astype(jnp.int8)
+    counters = jax.lax.dot_general(
+        ep_oh, cls, (((0,), (0,)), ((), ())), preferred_element_type=jnp.int32
+    )
+    return verdict, redirect, counters
 
 
 @functools.partial(
@@ -99,24 +151,45 @@ def process_flows(
         denied_pf = jnp.zeros(peer_bytes.shape[0], jnp.bool_)
     hit = lpm_lookup(t.ip_child, t.ip_info, peer_bytes, levels=levels)
     peer_row = jnp.where(hit > 0, hit - 1, t.world_row)
-    dec, red = lookup_batch(t.policymap, ep_idx, peer_row, dport, proto, block=block)
-    verdict = jnp.where(denied_pf, jnp.int8(DROP_PREFILTER), dec)
-    redirect = red & ~denied_pf
-
-    # counters via one-hot matmul [B, EP]ᵀ @ [B, 3]
-    ep_oh = (ep_idx[:, None] == jnp.arange(ep_count)[None, :]).astype(jnp.int8)
-    cls = jnp.stack(
-        [verdict == FORWARD, verdict == DROP_POLICY, verdict == DROP_PREFILTER],
-        axis=1,
-    ).astype(jnp.int8)
-    counters = jax.lax.dot_general(
-        ep_oh, cls, (((0,), (0,)), ((), ())), preferred_element_type=jnp.int32
+    return _verdict_tail(
+        t.policymap, denied_pf, peer_row, ep_idx, dport, proto, ep_count, block
     )
-    return verdict, redirect, counters
 
 
 # Backwards-compatible alias for the IPv4 path.
 process_ipv4 = process_flows
+
+
+@functools.partial(
+    jax.jit, static_argnames=("ep_count", "block", "prefilter")
+)
+def process_flows_wide(
+    t: WideDatapathTables,
+    peer_u32: jnp.ndarray,  # [B] uint32 host-order peer addresses
+    ep_idx: jnp.ndarray,
+    dport: jnp.ndarray,
+    proto: jnp.ndarray,
+    ep_count: int = 1,
+    block: int = 16384,
+    prefilter: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """IPv4 fast path over the wide tries — semantics identical to
+    process_flows(levels=4)."""
+    if prefilter:
+        denied_pf = lpm_lookup_wide(
+            t.pf_root_info, t.pf_root_child, t.pf_sub_child, t.pf_sub_info,
+            peer_u32,
+        ) > 0
+    else:
+        denied_pf = jnp.zeros(peer_u32.shape[0], jnp.bool_)
+    hit = lpm_lookup_wide(
+        t.ip_root_info, t.ip_root_child, t.ip_sub_child, t.ip_sub_info,
+        peer_u32,
+    )
+    peer_row = jnp.where(hit > 0, hit - 1, t.world_row)
+    return _verdict_tail(
+        t.policymap, denied_pf, peer_row, ep_idx, dport, proto, ep_count, block
+    )
 
 
 def _bucket(n: int, floor: int = 1024) -> int:
@@ -270,15 +343,27 @@ class DatapathPipeline:
                 or saw_row_event  # any row move can re-point trie targets
                 or not self._tables
             ):
-                (pf4, pf6) = self.prefilter.build_device()
-                ip4, ip6 = self.ipcache.build_device(
-                    lambda ident: compiled.id_to_row.get(ident)
+                (_pf4, pf6) = self.prefilter.build_device(build_v4=False)
+                _ip4, ip6 = self.ipcache.build_device(
+                    lambda ident: compiled.id_to_row.get(ident),
+                    build_v4=False,
+                )
+                # IPv4 rides the wide (dense-16-bit-first) tries
+                _, pf_cidrs = self.prefilter.dump()
+                pf_wide = build_wide_trie(
+                    (c, 0) for c in pf_cidrs if ":" not in c
+                )
+                ip_wide = build_wide_trie(
+                    (cidr, row)
+                    for cidr, e in self.ipcache.items()
+                    if ":" not in cidr
+                    and (row := compiled.id_to_row.get(e.identity)) is not None
                 )
                 world_row = compiled.id_to_row.get(ID_WORLD)
                 if world_row is None:
                     raise RuntimeError("reserved:world identity has no device row")
                 self._tries = (
-                    tuple(jnp.asarray(a) for a in (*pf4, *ip4)),
+                    tuple(jnp.asarray(a) for a in (*pf_wide, *ip_wide)),
                     tuple(jnp.asarray(a) for a in (*pf6, *ip6)),
                     jnp.asarray(np.int32(world_row)),
                 )
@@ -318,17 +403,28 @@ class DatapathPipeline:
             # Build complete, then assign once: _dispatch reads
             # self._tables without the lock and must never observe a
             # partially-populated dict.
-            tables: Dict[Tuple[int, int], DatapathTables] = {}
+            tables: Dict[Tuple[int, int], object] = {}
             for direction, mat in self._mat.items():
-                for fam, arrs in ((4, v4), (6, v6)):
-                    tables[(direction, fam)] = DatapathTables(
-                        pf_child=arrs[0],
-                        pf_info=arrs[1],
-                        ip_child=arrs[2],
-                        ip_info=arrs[3],
-                        world_row=world,
-                        policymap=mat.tables,
-                    )
+                tables[(direction, 4)] = WideDatapathTables(
+                    pf_root_info=v4[0],
+                    pf_root_child=v4[1],
+                    pf_sub_child=v4[2],
+                    pf_sub_info=v4[3],
+                    ip_root_info=v4[4],
+                    ip_root_child=v4[5],
+                    ip_sub_child=v4[6],
+                    ip_sub_info=v4[7],
+                    world_row=world,
+                    policymap=mat.tables,
+                )
+                tables[(direction, 6)] = DatapathTables(
+                    pf_child=v6[0],
+                    pf_info=v6[1],
+                    ip_child=v6[2],
+                    ip_info=v6[3],
+                    world_row=world,
+                    policymap=mat.tables,
+                )
             self._tables = tables
             if self.counters.shape[0] != len(self._endpoints):
                 self.counters = np.zeros((len(self._endpoints), 3), np.int64)
@@ -479,17 +575,33 @@ class DatapathPipeline:
             ep_idx = np.pad(ep_idx, (0, pad))
             dports = np.pad(dports, (0, pad))
             protos = np.pad(protos, (0, pad))
-        v, red, counters = process_flows(
-            t,
-            jnp.asarray(peer_bytes),
-            jnp.asarray(ep_idx),
-            jnp.asarray(dports),
-            jnp.asarray(protos),
-            ep_count=max(1, len(self._endpoints)),
-            levels=4 if family == 4 else 16,
-            # XDP prefilter guards traffic entering the node only
-            prefilter=ingress,
-        )
+        if family == 4:
+            b64 = peer_bytes.astype(np.uint32)
+            peer_u32 = (
+                (b64[:, 0] << 24) | (b64[:, 1] << 16)
+                | (b64[:, 2] << 8) | b64[:, 3]
+            )
+            v, red, counters = process_flows_wide(
+                t,
+                jnp.asarray(peer_u32),
+                jnp.asarray(ep_idx),
+                jnp.asarray(dports),
+                jnp.asarray(protos),
+                ep_count=max(1, len(self._endpoints)),
+                # XDP prefilter guards traffic entering the node only
+                prefilter=ingress,
+            )
+        else:
+            v, red, counters = process_flows(
+                t,
+                jnp.asarray(peer_bytes),
+                jnp.asarray(ep_idx),
+                jnp.asarray(dports),
+                jnp.asarray(protos),
+                ep_count=max(1, len(self._endpoints)),
+                levels=16,
+                prefilter=ingress,
+            )
         return (
             np.asarray(v)[:b],
             np.asarray(red)[:b],

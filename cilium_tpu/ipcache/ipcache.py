@@ -139,11 +139,14 @@ class IPCache:
 
     # -- device view ----------------------------------------------------
     def build_device(
-        self, row_of: Callable[[int], Optional[int]]
+        self, row_of: Callable[[int], Optional[int]], *, build_v4: bool = True
     ) -> Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]:
         """→ ((child4, info4), (child6, info6)) stride-8 tries holding
         identity rows (the datapath's cilium_ipcache equivalent).
-        Entries whose identity has no row yet are skipped."""
+        Entries whose identity has no row yet are skipped.
+        ``build_v4=False`` skips the v4 half (the pipeline's IPv4 path
+        uses the wide trie instead — rebuilding an unused 50k-prefix
+        stride-8 trie per ipcache move would dominate rebuild cost)."""
         with self._lock:
             v4, v6 = [], []
             for cidr, e in self._by_prefix.items():
@@ -151,4 +154,8 @@ class IPCache:
                 if row is None:
                     continue
                 (v6 if ":" in cidr else v4).append((cidr, int(row)))
-        return build_trie(v4, ipv6=False), build_trie(v6, ipv6=True)
+        empty = build_trie([], ipv6=False)
+        return (
+            build_trie(v4, ipv6=False) if build_v4 else empty,
+            build_trie(v6, ipv6=True),
+        )

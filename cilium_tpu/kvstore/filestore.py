@@ -91,20 +91,58 @@ class FileBackend(BackendOperations):
         class _Tx:
             def __enter__(tx):
                 backend._lock.acquire()
-                backend._conn.execute("BEGIN IMMEDIATE")
-                cur = backend._conn.cursor()
-                backend._sweep(cur)
+                try:
+                    backend._conn.execute("BEGIN IMMEDIATE")
+                    cur = backend._conn.cursor()
+                    backend._sweep(cur)
+                except BaseException:
+                    # a busy-timeout here must NOT leak the RLock — a
+                    # held lock with no __exit__ coming wedges every
+                    # other thread's kvstore op in this process
+                    try:
+                        backend._conn.rollback()
+                    except sqlite3.Error:
+                        pass
+                    backend._lock.release()
+                    raise
                 tx._cur = cur
                 return cur
 
             def __exit__(tx, exc_type, *_):
-                if exc_type is None:
-                    backend._conn.commit()
-                else:
-                    backend._conn.rollback()
-                backend._lock.release()
+                try:
+                    if exc_type is None:
+                        backend._conn.commit()
+                    else:
+                        backend._conn.rollback()
+                finally:
+                    backend._lock.release()
 
         return _Tx()
+
+    def _read(self):
+        """Read path: plain autocommit SELECTs (WAL readers never
+        block on writers — a BEGIN IMMEDIATE here would serialize all
+        readers across processes). Lease expiry is honored by
+        filtering in the query, not by sweeping."""
+        backend = self
+
+        class _Rd:
+            def __enter__(rd):
+                backend._lock.acquire()
+                return backend._conn.cursor()
+
+            def __exit__(rd, *_):
+                backend._lock.release()
+
+        return _Rd()
+
+    # WHERE fragment excluding keys whose lease has expired (sweeps
+    # happen on the write path; reads must not see zombie keys)
+    _LIVE = (
+        "(kv.lease_id IS NULL OR EXISTS ("
+        "SELECT 1 FROM leases WHERE leases.id = kv.lease_id "
+        "AND leases.expires >= ?))"
+    )
 
     def _sweep(self, cur) -> None:
         now = time.time()
@@ -151,22 +189,27 @@ class FileBackend(BackendOperations):
 
     # -- BackendOperations ----------------------------------------------
     def status(self) -> str:
-        with self._tx() as cur:
-            n = cur.execute("SELECT COUNT(*) FROM kv").fetchone()[0]
+        with self._read() as cur:
+            n = cur.execute(
+                f"SELECT COUNT(*) FROM kv WHERE {self._LIVE}",
+                (time.time(),),
+            ).fetchone()[0]
         return f"file:{self.path}: {n} keys"
 
     def get(self, key: str) -> Optional[bytes]:
-        with self._tx() as cur:
+        with self._read() as cur:
             row = cur.execute(
-                "SELECT value FROM kv WHERE key = ?", (key,)
+                f"SELECT value FROM kv WHERE key = ? AND {self._LIVE}",
+                (key, time.time()),
             ).fetchone()
             return row[0] if row else None
 
     def get_prefix(self, prefix: str) -> Optional[Tuple[str, bytes]]:
-        with self._tx() as cur:
+        with self._read() as cur:
             row = cur.execute(
-                "SELECT key, value FROM kv WHERE key >= ? AND key < ? "
-                "ORDER BY key LIMIT 1", (prefix, prefix + "\uffff")
+                f"SELECT key, value FROM kv WHERE key >= ? AND key < ? "
+                f"AND {self._LIVE} ORDER BY key LIMIT 1",
+                (prefix, prefix + "\uffff", time.time()),
             ).fetchone()
             return (row[0], row[1]) if row else None
 
@@ -228,11 +271,12 @@ class FileBackend(BackendOperations):
                 )
 
     def list_prefix(self, prefix: str) -> Dict[str, bytes]:
-        with self._tx() as cur:
+        with self._read() as cur:
             return {
                 k: v for k, v in cur.execute(
-                    "SELECT key, value FROM kv WHERE key >= ? AND key < ?",
-                    (prefix, prefix + "\uffff"),
+                    f"SELECT key, value FROM kv WHERE key >= ? AND key < ? "
+                    f"AND {self._LIVE}",
+                    (prefix, prefix + "\uffff", time.time()),
                 )
             }
 
@@ -257,13 +301,14 @@ class FileBackend(BackendOperations):
         consumers' upsert semantics absorb duplicates) rather than
         lost."""
         w = Watcher(name, prefix, chan_size)
-        with self._tx() as cur:
+        with self._read() as cur:
             start_rev = cur.execute(
                 "SELECT COALESCE(MAX(rev), 0) FROM events"
             ).fetchone()[0]
             snapshot = list(cur.execute(
-                "SELECT key, value FROM kv WHERE key >= ? AND key < ? "
-                "ORDER BY key", (prefix, prefix + "\uffff"),
+                f"SELECT key, value FROM kv WHERE key >= ? AND key < ? "
+                f"AND {self._LIVE} ORDER BY key",
+                (prefix, prefix + "\uffff", time.time()),
             ))
         for key, value in snapshot:
             w._emit(KVEvent(EventTypeCreate, key, value))

@@ -89,15 +89,33 @@ class KafkaACL:
         ver_ok = (self.version[None, :] < 0) | (self.version[None, :] == version[:, None])
         top_ok = (self.topic_id[None, :] < 0) | (self.topic_id[None, :] == topic[:, None])
         ok = key_ok & ver_ok & top_ok
-        # client-id + identity: host-side (strings / sets)
-        for i, req in enumerate(requests):
-            for j, (rule, idents) in enumerate(self._rules):
-                if not ok[i, j]:
-                    continue
-                if rule.client_id and rule.client_id != req.client_id:
-                    ok[i, j] = False
-                elif idents is not None and req.src_identity not in idents:
-                    ok[i, j] = False
+        # client-id: interned compare, vectorized over the batch
+        # (an O(B·R) Python loop here dominated the batch rate ~20×)
+        rule_cli = [rule.client_id for rule, _ in self._rules]
+        if any(rule_cli):
+            cli_ids = {c: k for k, c in enumerate(sorted(set(rule_cli)))}
+            rule_cli_id = np.array(
+                [cli_ids[c] if c else -1 for c in rule_cli], np.int32
+            )
+            req_cli_id = np.array(
+                [cli_ids.get(r.client_id, -2) for r in requests], np.int32
+            )
+            ok &= (rule_cli_id[None, :] < 0) | (
+                rule_cli_id[None, :] == req_cli_id[:, None]
+            )
+        # identity scoping: per scoped rule, one vectorized membership
+        scoped = [
+            (j, idents) for j, (_r, idents) in enumerate(self._rules)
+            if idents is not None
+        ]
+        if scoped:
+            src = np.array([r.src_identity for r in requests], np.int64)
+            for j, idents in scoped:
+                cand = ok[:, j]
+                if cand.any():
+                    ok[cand, j] = np.isin(
+                        src[cand], np.fromiter(idents, np.int64, len(idents))
+                    )
         return ok.any(axis=1)
 
     def rules_model(self) -> List[Dict]:

@@ -34,6 +34,12 @@ class L3n4Addr:
     port: int
     protocol: str = "TCP"  # TCP | UDP | ANY
 
+    def __post_init__(self) -> None:
+        # normalize ONCE at construction: frontends round-trip through
+        # string keys (clustermesh export paths, CLI args) and a
+        # case-mismatched protocol would make delete miss its upsert
+        object.__setattr__(self, "protocol", self.protocol.upper())
+
     @property
     def family(self) -> int:
         return 6 if ipaddress.ip_address(self.ip).version == 6 else 4

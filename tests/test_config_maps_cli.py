@@ -191,3 +191,56 @@ class TestRESTAndCLI:
                          "policy", "wait", "99999", "--timeout", "0.5"]) == 1
         finally:
             srv.stop()
+
+
+class TestStateMigration:
+    def test_v1_snapshot_migrates_on_restore(self, tmp_path):
+        """An unversioned (v1) state.json restores cleanly: services
+        field defaulted, legacy generated CIDRs become service-owned."""
+        from cilium_tpu.daemon import Daemon
+        from cilium_tpu.state_migrate import SCHEMA_VERSION, migrate
+
+        v1 = {
+            "rules": [{
+                "endpointSelector": {"matchLabels": {"k8s:app": "web"}},
+                "egress": [{
+                    "toServices": [{"k8sService": {
+                        "serviceName": "ext", "namespace": "default"}}],
+                    "toCIDRSet": [{"cidr": "192.0.2.8/32",
+                                   "generated": True}],
+                }],
+                "labels": ["k8s:policy=mig"],
+            }],
+            "endpoints": [{"id": 3, "labels": ["k8s:app=web"],
+                           "ipv4": "10.200.0.3"}],
+        }
+        import copy
+
+        out = migrate(copy.deepcopy(v1))  # deep: migrate mutates nested dicts
+        assert out["schema"] == SCHEMA_VERSION
+        cidr = out["rules"][0]["egress"][0]["toCIDRSet"][0]
+        assert cidr["generatedBy"] == "service"
+        assert out["services"] == []
+        # migration newer than the build is refused
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="newer"):
+            migrate({"schema": 99})
+        # end-to-end: daemon restores a v1 file and re-saves versioned
+        state = tmp_path / "state"
+        state.mkdir()
+        (state / "state.json").write_text(json.dumps(v1))
+        d = Daemon(state_dir=str(state))
+        assert d.endpoint_manager.lookup(3) is not None
+        d.save_state()
+        saved = json.loads((state / "state.json").read_text())
+        assert saved["schema"] == SCHEMA_VERSION
+        d.shutdown()
+
+    def test_cli_migrate_tool(self, tmp_path):
+        from cilium_tpu.state_migrate import main
+
+        p = tmp_path / "state.json"
+        p.write_text(json.dumps({"rules": [], "endpoints": []}))
+        assert main([str(p)]) == 0
+        assert json.loads(p.read_text())["schema"] >= 2
