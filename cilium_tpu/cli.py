@@ -251,7 +251,7 @@ def build_parser() -> argparse.ArgumentParser:
     for mname, mhelp in (
         ("ct", "conntrack entries"), ("ipcache", "IP→identity cache"),
         ("tunnel", "tunnel endpoints"), ("proxy", "proxy handoffs"),
-        ("metrics", "per-endpoint counters"),
+        ("metrics", "per-endpoint counters"), ("routes", "route table"),
     ):
         mp = bpf.add_parser(mname, help=mhelp).add_subparsers(
             dest="mapop", required=True
@@ -432,7 +432,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             _print(s.config_get())
     elif args.cmd == "bpf":
-        if args.sub in ("ct", "ipcache", "tunnel", "proxy", "metrics"):
+        if args.sub in ("ct", "ipcache", "tunnel", "proxy", "metrics",
+                        "routes"):
             _print(s.map_dump(args.sub))
         else:
             _print(s.policymap_get(args.endpoint, egress=args.egress))

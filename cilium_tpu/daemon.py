@@ -18,6 +18,7 @@ import json
 import os
 import tempfile
 import threading
+from dataclasses import asdict as dataclasses_asdict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import metrics
@@ -30,7 +31,9 @@ from .health import HealthProber, tcp_probe
 from .ipam import IPAM
 from .maps.lxcmap import LXCMap
 from .maps.proxymap import ProxyMap
+from .maps.routes import RouteTable
 from .maps.tunnel import TunnelMap
+from .mtu import MTUConfig
 from .utils.iputil import prefix_lengths_of
 from .utils.logging import get_logger
 from .utils.prefix_counter import PrefixLengthCounter
@@ -102,7 +105,9 @@ class Daemon:
         self.ipam = IPAM(pod_cidr)
         self.lxcmap = LXCMap()
         self.tunnel = TunnelMap()
+        self.routes = RouteTable()
         self.proxymap = ProxyMap()
+        self.mtu = MTUConfig()
         # distinct CIDR prefix lengths in force (pkg/counter) — a new
         # length forces a datapath trie rebuild (the compileBase
         # trigger of daemon/policy.go:184-195)
@@ -588,6 +593,9 @@ class Daemon:
             "tunnel": self.tunnel_dump,
             "proxy": self.proxymap_dump,
             "metrics": self.metricsmap_dump,
+            "routes": lambda: [
+                dataclasses_asdict(r) for r in self.routes.items()
+            ],
         }
         fn = dumps.get(name)
         if fn is None:
@@ -696,6 +704,9 @@ class Daemon:
         # just skip tunnel programming
         if hasattr(registry, "observe"):
             self.tunnel.observe_nodes(registry)
+            self.routes.observe_nodes(
+                registry, route_mtu=self.mtu.route_mtu
+            )
 
     def health_report(self) -> Dict:
         """GET /health (the cilium-health status surface)."""

@@ -8,76 +8,32 @@ the datapath's encap stage consults it for non-local destinations.
 
 from __future__ import annotations
 
-import ipaddress
-import threading
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
+
+from .prefixmap import PrefixMap, observe_node_cidrs
 
 
-class TunnelMap:
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._by_prefix: Dict[str, str] = {}  # CIDR → tunnel endpoint IP
-
-    @staticmethod
-    def _norm(prefix: str) -> str:
-        return str(ipaddress.ip_network(prefix, strict=False))
-
+class TunnelMap(PrefixMap):
     def upsert(self, prefix: str, endpoint_ip: str) -> None:
-        with self._lock:
-            self._by_prefix[self._norm(prefix)] = endpoint_ip
-
-    def delete(self, prefix: str) -> bool:
-        with self._lock:
-            return self._by_prefix.pop(self._norm(prefix), None) is not None
+        self.upsert_value(prefix, endpoint_ip)
 
     def lookup(self, ip: str) -> Optional[str]:
         """Longest-prefix match → tunnel endpoint for a destination."""
-        addr = ipaddress.ip_address(ip)
-        with self._lock:
-            best, best_len = None, -1
-            for prefix, ep in self._by_prefix.items():
-                net = ipaddress.ip_network(prefix)
-                if net.version == addr.version and addr in net:
-                    if net.prefixlen > best_len:
-                        best, best_len = ep, net.prefixlen
-            return best
+        return self.lookup_value(ip)
 
     def items(self) -> List[Tuple[str, str]]:
-        with self._lock:
-            return sorted(self._by_prefix.items())
+        return self.value_items()
 
     def observe_nodes(self, registry) -> None:
         """Wire to a NodeRegistry: REMOTE nodes' alloc CIDRs → their
-        node IP (node/manager.go nodeUpdated/nodeDeleted). The local
-        node is skipped — local pod prefixes must deliver locally,
-        never encapsulate back to ourselves. Tracks what each node
-        programmed so a node UPDATE that changes its CIDR also removes
-        the old prefix (stale entries would longest-prefix-match
-        traffic for prefixes later reassigned elsewhere)."""
-        local_key = registry.local.key_name
-        programmed: Dict[str, set] = {}
+        node IP (node/manager.go nodeUpdated/nodeDeleted). Shared
+        semantics (local-node skip, partial-registration guard, stale
+        CIDR removal) live in prefixmap.observe_node_cidrs."""
 
-        def on_node(node, live: bool) -> None:
-            if node.key_name == local_key:
-                return
-            host = node.ipv4 or node.ipv6
-            new = {
-                self._norm(c)
-                for c in (node.ipv4_alloc_cidr, node.ipv6_alloc_cidr)
-                if c
-            } if live and host else set()
-            old = programmed.get(node.key_name, set())
-            for cidr in old - new:
-                self.delete(cidr)
-            for cidr in new:
-                self.upsert(cidr, host)
-            if new:
-                programmed[node.key_name] = new
-            else:
-                programmed.pop(node.key_name, None)
+        def on_change(node, host, new, stale) -> None:
+            for prefix in stale:
+                self.delete(prefix)
+            for prefix in new:
+                self.upsert(prefix, host)
 
-        registry.observe(on_node, replay=True)
-
-    def __len__(self) -> int:
-        with self._lock:
-            return len(self._by_prefix)
+        observe_node_cidrs(registry, on_change)
