@@ -206,10 +206,16 @@ def build_parser() -> argparse.ArgumentParser:
     pw.add_argument("revision", type=int)
     pw.add_argument("--timeout", type=float, default=30.0)
     tr = pol.add_parser("trace", help="offline verdict + trace log")
-    tr.add_argument("-s", "--src", action="append", required=True,
+    tr.add_argument("-s", "--src", action="append", default=[],
                     help="source label (repeatable)")
-    tr.add_argument("-d", "--dst", action="append", required=True,
+    tr.add_argument("-d", "--dst", action="append", default=[],
                     help="destination label (repeatable)")
+    tr.add_argument("--src-identity", type=int, default=None,
+                    help="resolve source labels from a numeric identity")
+    tr.add_argument("--dst-identity", type=int, default=None)
+    tr.add_argument("--src-endpoint", type=int, default=None,
+                    help="resolve source labels from an endpoint id")
+    tr.add_argument("--dst-endpoint", type=int, default=None)
     tr.add_argument("--dport", action="append", default=[],
                     help="destination port 'port[/proto]' (repeatable)")
     tr.add_argument("--egress", action="store_true",
@@ -391,8 +397,46 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 1
         elif args.sub == "trace":
+            eps_by_id: dict = {}
+
+            def endpoints_once():
+                # one GET /endpoint serves both sides of the trace
+                if not eps_by_id:
+                    eps_by_id.update(
+                        {e["id"]: e for e in s.endpoint_list()}
+                    )
+                return eps_by_id
+
+            def resolve_side(labels, identity, endpoint, side):
+                # --src-identity / --src-endpoint sources mirror
+                # cilium/cmd/policy_trace.go (identity → GET
+                # /identity/<id>, endpoint → its labels)
+                out = list(labels)
+                if identity is not None:
+                    try:
+                        out += s.identity_get(identity)["labels"]
+                    except (SystemExit, Exception):
+                        raise SystemExit(
+                            f"{side} identity {identity} not found"
+                        ) from None
+                if endpoint is not None:
+                    eps = endpoints_once()
+                    if endpoint not in eps:
+                        raise SystemExit(f"{side} endpoint {endpoint} not found")
+                    out += eps[endpoint]["labels"]
+                if not out:
+                    raise SystemExit(
+                        f"no {side}: give -{side[0]}, --{side}-identity "
+                        f"or --{side}-endpoint"
+                    )
+                return out
+
             out = s.policy_resolve(
-                args.src, args.dst, args.dport,
+                resolve_side(args.src, args.src_identity,
+                             args.src_endpoint, "src"),
+                resolve_side(args.dst, args.dst_identity,
+                             args.dst_endpoint, "dst"),
+                args.dport,
                 ingress=not args.egress, verbose=args.verbose,
             )
             print(out["trace"], end="")

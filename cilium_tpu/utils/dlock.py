@@ -35,7 +35,10 @@ class DebugRLock:
         self.name = name
         self._lock = threading.RLock()
         self._holder_stack: Optional[str] = None
-        self._depth = 0  # reentrancy depth (mutated only while held)
+        # reentrancy depth: maintained UNCONDITIONALLY (mutations only
+        # happen while the lock is held, so they're race-free) — a
+        # detection toggle mid-hold must not desync it
+        self._depth = 0
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
         if not _DETECT or not blocking:
@@ -47,11 +50,15 @@ class DebugRLock:
             first = _TIMEOUT if timeout < 0 else min(_TIMEOUT, timeout)
             got = self._lock.acquire(True, first)
             if not got:
-                log.warning("possible deadlock", fields={
-                    "lock": self.name,
-                    "waited_s": first,
-                    "holder": self._holder_stack or "unknown",
-                })
+                # only a FULL detection deadline is suspicious — a
+                # short caller timeout expiring is normal contention,
+                # not a deadlock signal
+                if first >= _TIMEOUT:
+                    log.warning("possible deadlock", fields={
+                        "lock": self.name,
+                        "waited_s": first,
+                        "holder": self._holder_stack or "unknown",
+                    })
                 if timeout < 0:
                     got = self._lock.acquire(True, -1)
                 else:
@@ -60,16 +67,16 @@ class DebugRLock:
                         self._lock.acquire(True, remaining)
                         if remaining > 0 else False
                     )
-        if got and _DETECT:
+        if got:
             self._depth += 1
-            if self._depth == 1:
+            if self._depth == 1 and _DETECT:
                 self._holder_stack = "".join(
                     traceback.format_stack(limit=6)
                 )
         return got
 
     def release(self) -> None:
-        if _DETECT and self._depth > 0:
+        if self._depth > 0:
             self._depth -= 1
             if self._depth == 0:  # only the OUTERMOST release clears
                 self._holder_stack = None

@@ -33,7 +33,13 @@ def extract_v4(v6: str, prefix: str = DEFAULT_PREFIX) -> str:
 
 
 def is_nat46(v6: str, prefix: str = DEFAULT_PREFIX) -> bool:
+    """True when ``v6`` lies inside the NAT46 prefix. The prefix is
+    parsed with strict=False like embed/extract — the predicate must
+    accept every address those functions produce — and only a
+    malformed ADDRESS yields False."""
+    net = ipaddress.ip_network(prefix, strict=False)
     try:
-        return ipaddress.IPv6Address(v6) in ipaddress.ip_network(prefix)
+        addr = ipaddress.IPv6Address(v6)
     except ValueError:
         return False
+    return addr in net

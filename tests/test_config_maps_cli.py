@@ -244,3 +244,27 @@ class TestStateMigration:
         p.write_text(json.dumps({"rules": [], "endpoints": []}))
         assert main([str(p)]) == 0
         assert json.loads(p.read_text())["schema"] >= 2
+
+
+class TestTraceSourceSelectors:
+    def test_trace_by_identity_and_endpoint(self, daemon, tmp_path, capsys):
+        from cilium_tpu.cli import main
+
+        srv = APIServer(daemon, str(tmp_path / "t.sock"))
+        srv.start()
+        try:
+            lb_identity = daemon.endpoint_manager.lookup(9).identity.id
+            rc = main(["--socket", str(tmp_path / "t.sock"), "policy",
+                       "trace", "--src-identity", str(lb_identity),
+                       "--dst-endpoint", "7", "--dport", "80/tcp"])
+            out = capsys.readouterr().out
+            assert rc == 0 and "Final verdict: allowed" in out
+            rc = main(["--socket", str(tmp_path / "t.sock"), "policy",
+                       "trace", "--src-endpoint", "7",
+                       "--dst-endpoint", "9", "--dport", "80/tcp"])
+            assert rc == 1  # no rule allows web → lb
+            with pytest.raises(SystemExit, match="src"):
+                main(["--socket", str(tmp_path / "t.sock"), "policy",
+                      "trace", "-d", "k8s:app=web"])
+        finally:
+            srv.stop()
