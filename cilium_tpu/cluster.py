@@ -1,0 +1,164 @@
+"""Clustered-agent assembly: one Daemon joined to the kvstore fabric.
+
+The runDaemon wiring of the reference (daemon/main.go:818 →
+kvstore.Setup, InitIdentityAllocator, node registration,
+InitIPIdentityWatcher, clustermesh) as one composable object: given a
+Daemon and a kvstore backend, ClusterNode
+
+- swaps the daemon's identity allocation onto the cluster-wide CAS
+  allocator (every node numbers identities identically — which is
+  what keeps compiled policy tensor ROWS compatible across nodes),
+- registers the node and attaches the registry to the daemon (health
+  probing + tunnel/route programming ride the same observer),
+- announces local endpoint IPs on the ip→identity prefix and merges
+  every other node's announcements into the local ipcache,
+- exports the node's services and (optionally) merges remote
+  clusters' identities/ipcache/services via clustermesh.
+
+Convergence is pump()-driven (deterministic for tests, a controller
+loop in daemons), matching the rest of the kvstore layer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .identity.distributed import DistributedIdentityAllocator
+from .ipcache.ipcache import SOURCE_AGENT
+from .ipcache.kvstore_sync import IPIdentitySync
+from .kvstore.backend import BackendOperations
+from .kvstore.clustermesh import ClusterMesh
+from .nodes.registry import Node, NodeRegistry
+from .utils.logging import get_logger
+
+log = get_logger("cluster")
+
+
+class ClusterNode:
+    def __init__(
+        self,
+        daemon,
+        backend: BackendOperations,
+        node: Node,
+        *,
+        cluster: str = "default",
+        probe_interval: float = 60.0,
+    ) -> None:
+        self.daemon = daemon
+        self.backend = backend
+        self.cluster = cluster
+        # cluster-wide identity numbering (InitIdentityAllocator)
+        self.identities = DistributedIdentityAllocator(
+            backend, daemon.registry, node.name
+        )
+        daemon.allocate_identity = self.identities.allocate
+        daemon.release_identity = self.identities.release
+        # endpoints created BEFORE the join (standalone run, snapshot
+        # restore) carry local-cursor identity numbers the cluster
+        # never agreed on — re-allocate them through the CAS so their
+        # numbers (and the announcements below) are cluster-valid
+        self._adopt_existing_endpoints()
+        # node membership + health/tunnel/route programming
+        self.nodes = NodeRegistry(backend, node)
+        daemon.attach_node_registry(self.nodes, probe_interval=probe_interval)
+        # ip→identity announcements (InitIPIdentityWatcher)
+        self.ipsync = IPIdentitySync(backend, daemon.ipcache, cluster=cluster)
+        daemon.ipcache.add_listener(self._on_ipcache_change, replay=True)
+        # remote-cluster merge (identities + ipcache + services)
+        self.mesh = ClusterMesh(
+            daemon.registry, daemon.ipcache, services=daemon.services
+        )
+        log.info("joined cluster", fields={
+            "cluster": cluster, "nodeName": node.name,
+        })
+
+    def _adopt_existing_endpoints(self) -> None:
+        from collections import defaultdict
+
+        from .identity.model import MIN_USER_IDENTITY
+
+        daemon = self.daemon
+        by_ident = defaultdict(list)
+        for ep in daemon.endpoint_manager.endpoints():
+            if ep.identity is not None:
+                by_ident[ep.identity.id].append(ep)
+        renumbered = 0
+        for _ident_id, eps in by_ident.items():
+            old = eps[0].identity
+            # reserved (host/world/…) and local CIDR identities keep
+            # their fixed/local numbering — only user-range globals
+            # need cluster agreement
+            if old.id < MIN_USER_IDENTITY or old.is_local:
+                continue
+            # the local standalone binding must go FIRST: the registry
+            # (rightly) refuses the same labels under two numbers
+            for _ in eps:
+                daemon.registry.release(old)
+            new = self.identities.allocate(old.labels)
+            for _ in eps[1:]:
+                self.identities.allocate(old.labels)  # one ref per ep
+            for ep in eps:
+                ep.identity = new
+                if new.id != old.id:
+                    for ip, plen in ((ep.ipv4, 32), (ep.ipv6, 128)):
+                        if ip:
+                            daemon.ipcache.upsert(
+                                f"{ip}/{plen}", new.id, source=SOURCE_AGENT
+                            )
+            if new.id != old.id:
+                renumbered += len(eps)
+        if renumbered:
+            daemon._sync_pipeline_endpoints()
+            daemon._regenerate("cluster join renumbering")
+            log.info("renumbered endpoints at cluster join",
+                     fields={"count": renumbered})
+
+    # -- local endpoint announcements -----------------------------------
+    def _on_ipcache_change(self, cidr, old, new) -> None:
+        """Announce ONLY agent-sourced entries (this node's endpoints).
+        kvstore-sourced entries are other nodes' announcements echoed
+        back — re-announcing them would loop; the ipcache's source
+        priority (agent > kvstore) already keeps our local truth from
+        being clobbered by our own echo."""
+        host = self.nodes.local.ipv4 or self.nodes.local.ipv6
+        if new is not None and new.source == SOURCE_AGENT:
+            self.ipsync.announce(cidr, new.identity, host_ip=host)
+        elif new is None and old is not None and old.source == SOURCE_AGENT:
+            self.ipsync.withdraw(cidr)
+
+    # -- services -------------------------------------------------------
+    def export_services(self) -> int:
+        """Publish this node's service table for remote clusters
+        (the clustermesh services export)."""
+        return self.daemon.services.export_to_store(self.backend, self.cluster)
+
+    def add_remote_cluster(self, name: str, backend: BackendOperations):
+        return self.mesh.add_cluster(name, backend)
+
+    # -- convergence ----------------------------------------------------
+    def pump(self) -> int:
+        """Drain every subscription (identities, ipcache, nodes,
+        remote clusters); the next pipeline rebuild picks up the new
+        state. Returns events applied."""
+        n = self.identities.pump()
+        n += self.ipsync.pump()
+        n += self.nodes.pump()
+        n += self.mesh.pump()
+        return n
+
+    def close(self) -> None:
+        """Leave the cluster SYMMETRICALLY to __init__: the daemon
+        keeps serving standalone afterwards — allocation falls back to
+        the local registry, announcements stop, and the prober is
+        halted rather than probing a frozen node list forever."""
+        daemon = self.daemon
+        daemon.allocate_identity = daemon.registry.allocate
+        daemon.release_identity = daemon.registry.release
+        daemon.ipcache.remove_listener(self._on_ipcache_change)
+        daemon.health.stop()
+        daemon.health.nodes = None
+        self.mesh.close()
+        self.ipsync.close()
+        self.nodes.unregister()
+        self.nodes.close()
+        self.identities.close()

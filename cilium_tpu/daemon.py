@@ -149,6 +149,11 @@ class Daemon:
         # serializes snapshot writers: API threads AND the background
         # DNS poller both reach save_state
         self._save_lock = threading.Lock()
+        # identity allocation is pluggable: clustered deployments
+        # (cluster.py ClusterNode) swap in the kvstore CAS allocator
+        # so the whole cluster numbers identities identically
+        self.allocate_identity = self.registry.allocate
+        self.release_identity = self.registry.release
         # node connectivity prober (cilium-health launch,
         # daemon/main.go:927-945); probes the node registry when one
         # is attached, reports empty standalone
@@ -271,7 +276,10 @@ class Daemon:
         tmp = []
         for have, lbls in ((src_id, src), (dst_id, dst)):
             if have is None:
-                tmp.append(self.registry.allocate(lbls))
+                # the PLUGGABLE allocator: clustered daemons must not
+                # mint local-cursor numbers that collide with the
+                # cluster's CAS numbering
+                tmp.append(self.allocate_identity(lbls))
         src_id = src_id or self.registry.lookup_by_labels(src)
         dst_id = dst_id or self.registry.lookup_by_labels(dst)
         subj, peer = (dst_id, src_id) if ingress else (src_id, dst_id)
@@ -292,7 +300,7 @@ class Daemon:
                 )[0] == 1
             )
         for ident in tmp:
-            self.registry.release(ident)
+            self.release_identity(ident)
 
         oracle_allowed = oracle == Decision.ALLOWED
         return {
@@ -325,7 +333,7 @@ class Daemon:
             # CREATING → WAITING_FOR_IDENTITY → READY (endpoint.go
             # lifecycle) so the first regeneration is legal.
             ep.set_state(EndpointState.WAITING_FOR_IDENTITY)
-            ep.identity = self.registry.allocate(lbls)
+            ep.identity = self.allocate_identity(lbls)
             ep.set_state(EndpointState.READY)
             self.endpoint_manager.insert(ep)
             if ipv4:
@@ -360,7 +368,7 @@ class Daemon:
             if ep.ipv6:
                 self.ipcache.delete(f"{ep.ipv6}/128", SOURCE_AGENT)
             if ep.identity is not None:
-                self.registry.release(ep.identity)
+                self.release_identity(ep.identity)
             self._sync_pipeline_endpoints()
             # release the endpoint's L7 redirects (and their proxy
             # ports) BEFORE the fleet regen republishes NPDS

@@ -66,6 +66,15 @@ class IPCache:
                 for cidr, e in self._by_prefix.items():
                     fn(cidr, None, e)
 
+    def remove_listener(self, fn: Listener) -> bool:
+        """Detach a listener (cluster leave must stop announcements)."""
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+                return True
+            except ValueError:
+                return False
+
     def upsert(
         self,
         cidr: str,
