@@ -186,6 +186,17 @@ def build_parser() -> argparse.ArgumentParser:
     # daemon
     d = sub.add_parser("daemon", help="run the agent + API server")
     d.add_argument("--no-conntrack", action="store_true")
+    d.add_argument("--join", default=None, metavar="KVSTORE_DB",
+                   help="join a cluster via a shared kvstore file "
+                        "(SQLite path; all agents pass the same file)")
+    d.add_argument("--node-name", default=None,
+                   help="cluster node name (default: hostname)")
+    d.add_argument("--node-ip", default=None,
+                   help="this node's reachable address (tunnel endpoint)")
+    d.add_argument("--cluster", default="default")
+    d.add_argument("--pod-cidr", default="10.200.0.0/16")
+    d.add_argument("--sync-interval", type=float, default=1.0,
+                   help="cluster pump interval in seconds")
 
     # status / metrics
     sub.add_parser("status", help="agent status")
@@ -305,8 +316,42 @@ def main(argv: Optional[List[str]] = None) -> int:
         logging_setup(os.environ.get("CILIUM_TPU_LOG_LEVEL", "info"))
 
         daemon = Daemon(
-            state_dir=args.state, conntrack=not args.no_conntrack
+            state_dir=args.state, conntrack=not args.no_conntrack,
+            pod_cidr=args.pod_cidr,
         )
+        cluster_node = None
+        cluster_pump = None
+        if args.join:
+            if not args.node_ip:
+                # a node without an address cannot serve as a tunnel
+                # endpoint — peers would learn unroutable announcements
+                print("--join requires --node-ip (this node's reachable "
+                      "address for tunnels)", file=sys.stderr)
+                return 2
+            import socket as _socket
+
+            from .cluster import ClusterNode
+            from .kvstore.filestore import FileBackend
+            from .nodes.registry import Node as _Node
+            from .utils.controller import Controller
+
+            name = args.node_name or _socket.gethostname()
+            cluster_node = ClusterNode(
+                daemon,
+                FileBackend(args.join, name),
+                _Node(name=name, ipv4=args.node_ip,
+                      ipv4_alloc_cidr=args.pod_cidr),
+                cluster=args.cluster,
+            )
+            cluster_node.export_services()
+            # convergence controller: drain cluster subscriptions on
+            # an interval (the kvstore watch pump of the reference's
+            # controller loops)
+            cluster_pump = Controller(
+                "cluster-sync",
+                lambda: (cluster_node.pump(), cluster_node.export_services()),
+                run_interval=args.sync_interval,
+            )
         server = APIServer(daemon, args.socket)
         monitor = MonitorServer(daemon.monitor, args.socket + ".monitor")
         monitor.start()
@@ -320,15 +365,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             # once a node registry is attached; a standalone daemon
             # has no peers and would spin an empty sweep forever
             daemon.health.start()
+        cluster_note = f", cluster: {args.cluster}@{args.join}" if args.join else ""
         print(f"cilium-tpu daemon serving on {args.socket} "
               f"(monitor: {args.socket}.monitor, xds: {args.socket}.xds, "
-              f"state: {args.state})")
+              f"state: {args.state}{cluster_note})")
         try:
             server.serve_forever()
         except KeyboardInterrupt:
             xds.stop()
             monitor.stop()
             server.stop()
+            if cluster_pump is not None:
+                cluster_pump.stop()  # BEFORE close: no pump mid-teardown
+            if cluster_node is not None:
+                cluster_node.close()
             daemon.shutdown()
         return 0
 

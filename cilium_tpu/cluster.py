@@ -147,16 +147,26 @@ class ClusterNode:
         return n
 
     def close(self) -> None:
-        """Leave the cluster SYMMETRICALLY to __init__: the daemon
-        keeps serving standalone afterwards — allocation falls back to
-        the local registry, announcements stop, and the prober is
-        halted rather than probing a frozen node list forever."""
+        """Leave the cluster SYMMETRICALLY to __init__ (idempotent):
+        the daemon keeps serving standalone afterwards — allocation
+        falls back to the local registry, this node's announcements
+        are WITHDRAWN (not left to lease expiry: peers must stop
+        routing here immediately), learned tunnel/route state is
+        flushed, and the prober is halted rather than probing a
+        frozen node list forever."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
         daemon = self.daemon
         daemon.allocate_identity = daemon.registry.allocate
         daemon.release_identity = daemon.registry.release
         daemon.ipcache.remove_listener(self._on_ipcache_change)
         daemon.health.stop()
         daemon.health.nodes = None
+        self.ipsync.withdraw_all()
+        # registry-learned encap state must not outlive the membership
+        daemon.tunnel.clear()
+        daemon.routes.clear()
         self.mesh.close()
         self.ipsync.close()
         self.nodes.unregister()

@@ -65,6 +65,15 @@ class IPIdentitySync:
         )
         self._announced[cidr] = payload
 
+    def withdraw_all(self) -> int:
+        """Withdraw every announcement this node made (cluster leave —
+        relying on lease expiry would leave peers routing to the
+        departed node for a full TTL)."""
+        cidrs = list(self._announced)
+        for cidr in cidrs:
+            self.withdraw(cidr)
+        return len(cidrs)
+
     def withdraw(self, cidr: str) -> None:
         cidr = self.ipcache._norm(cidr)
         self.backend.delete(self._key(cidr))

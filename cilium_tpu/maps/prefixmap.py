@@ -52,6 +52,12 @@ class PrefixMap:
                 for prefix, (_net, value) in self._entries.items()
             )
 
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            return n
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)

@@ -155,7 +155,19 @@ class TestClusterConvergence:
         _pump_all(cb)
         assert db.ipcache.lookup_by_ip("10.1.0.50") is None
         assert da.health.nodes is None
-        ca._closed_already = True  # fixture close() tolerance
+        # learned encap state flushed with the membership
+        assert da.tunnel.lookup("10.2.0.5") is None
+        assert da.routes.lookup("10.2.0.5") is None
+        ca.close()  # idempotent (fixture closes again)
+
+    def test_leave_withdraws_announcements(self, cluster):
+        _store, (da, ca), (db, cb) = cluster
+        da.endpoint_add(6, ["k8s:app=gone"], ipv4="10.1.0.60")
+        _pump_all(ca, cb)
+        assert db.ipcache.lookup_by_ip("10.1.0.60") is not None
+        ca.close()  # leave: peers must stop routing here IMMEDIATELY
+        _pump_all(cb)
+        assert db.ipcache.lookup_by_ip("10.1.0.60") is None
 
     def test_service_export_between_clusters(self, cluster):
         """Global services: node A's cluster exports, a second
