@@ -164,9 +164,17 @@ class ClusterNode:
         daemon.health.stop()
         daemon.health.nodes = None
         self.ipsync.withdraw_all()
-        # registry-learned encap state must not outlive the membership
+        # learned state must not outlive the membership: encap tables
+        # AND the kvstore-sourced ip→identity entries (with the
+        # watcher gone they would never update again — a reused peer
+        # IP would keep the departed cluster's identity forever)
         daemon.tunnel.clear()
         daemon.routes.clear()
+        from .ipcache.ipcache import SOURCE_KVSTORE
+
+        for cidr, e in daemon.ipcache.items():
+            if e.source == SOURCE_KVSTORE:
+                daemon.ipcache.delete(cidr, SOURCE_KVSTORE)
         self.mesh.close()
         self.ipsync.close()
         self.nodes.unregister()

@@ -93,6 +93,11 @@ def load() -> ctypes.CDLL:
         c.c_void_p, c.c_int, i32p, i32p, c.c_int32, c.c_int,
     ]
     lib.nf_ct_flush.argtypes = [c.c_void_p]
+    lib.nf_set_endpoint_ids.argtypes = [c.c_void_p, c.c_int64, u32p]
+    lib.nf_load_lb.argtypes = [
+        c.c_void_p, c.c_int32, c.c_int, u32p, i32p, i32p, i32p, i32p,
+        i32p, c.c_int32, u32p, i32p,
+    ]
     lib.nf_eval_batch.argtypes = [
         c.c_void_p, c.c_int64, u8p, c.c_int, i32p, i32p, i32p, i32p,
         c.c_uint8, i8p, u8p,

@@ -153,21 +153,57 @@ struct Conntrack {
   }
 };
 
+// LB service tables (IPv4): mirrors lb/device.py LBTables — dense
+// frontend compare + per-service selection sequence + backend rows
+struct LBTables {
+  std::vector<uint32_t> fe_addr;   // [F] VIP (host order)
+  std::vector<int32_t> fe_port;    // [F] (-1 = empty slot)
+  std::vector<int32_t> fe_proto;   // [F] (0 = ANY)
+  std::vector<int32_t> fe_seq;     // [F * seq_width]
+  std::vector<int32_t> fe_seq_len; // [F]
+  std::vector<int32_t> fe_revnat;  // [F]
+  std::vector<uint32_t> be_addr;   // [NB]
+  std::vector<int32_t> be_port;    // [NB]
+  int seq_width = 0;
+  bool loaded = false;
+};
+
 struct Fastpath {
   HashTable policy;     // ka = identity, kb = ep<<32|dport<<16|proto<<8|dir
   Trie ip4, ip6;        // value = identity (not row: standalone table)
   Trie deny4, deny6;    // prefilter
   Conntrack ct;
+  LBTables lb;
   bool ct_enabled = false;
   uint64_t world_identity = 2;
   uint32_t ep_count = 0;
   std::vector<int64_t> counters;  // [ep][3] fwd/drop_policy/drop_prefilter
+  std::vector<uint32_t> ep_ids;   // [ep] stable endpoint ids (hash input)
 };
 
 // verdict codes — match datapath/pipeline.py
 constexpr int8_t FORWARD = 1;
 constexpr int8_t DROP_POLICY = 2;
 constexpr int8_t DROP_PREFILTER = 3;
+constexpr int8_t DROP_NO_SERVICE = 4;
+
+// per-flow hash — MUST match lb/device.py flow_hash32 exactly (the
+// translated CT key depends on deterministic backend selection, and
+// native/device parity requires identical picks)
+inline int32_t flow_hash32(const uint8_t* addr, int stride, int32_t sport,
+                           int32_t dport, int32_t proto, uint32_t ep_id,
+                           bool has_sport) {
+  uint32_t x = 0;
+  for (int i = 0; i < stride; ++i) x = (x * 0x01000193u) ^ addr[i];
+  if (has_sport) x ^= uint32_t(sport) << 16;
+  x ^= uint32_t(dport);
+  x ^= uint32_t(proto) << 8;
+  x ^= ep_id << 24;
+  x ^= x >> 16; x *= 0x85EBCA6Bu;
+  x ^= x >> 13; x *= 0xC2B2AE35u;
+  x ^= x >> 16;
+  return int32_t(x & 0x7FFFFFFFu);
+}
 
 inline uint64_t policy_kb(uint32_t ep, uint32_t dport, uint32_t proto,
                           uint32_t dir) {
@@ -227,6 +263,32 @@ void nf_load_trie(void* h, int which, const int32_t* child,
 
 void nf_ct_flush(void* h) { static_cast<Fastpath*>(h)->ct.flush(); }
 
+void nf_set_endpoint_ids(void* h, int64_t n, const uint32_t* ids) {
+  auto* fp = static_cast<Fastpath*>(h);
+  fp->ep_ids.assign(ids, ids + n);
+}
+
+// IPv4 LB tables; any (re)load flushes CT in the WRAPPER (caller).
+void nf_load_lb(void* h, int32_t n_fe, int seq_width,
+                const uint32_t* fe_addr, const int32_t* fe_port,
+                const int32_t* fe_proto, const int32_t* fe_seq,
+                const int32_t* fe_seq_len, const int32_t* fe_revnat,
+                int32_t n_be, const uint32_t* be_addr,
+                const int32_t* be_port) {
+  auto* fp = static_cast<Fastpath*>(h);
+  LBTables& t = fp->lb;
+  t.fe_addr.assign(fe_addr, fe_addr + n_fe);
+  t.fe_port.assign(fe_port, fe_port + n_fe);
+  t.fe_proto.assign(fe_proto, fe_proto + n_fe);
+  t.fe_seq.assign(fe_seq, fe_seq + size_t(n_fe) * seq_width);
+  t.fe_seq_len.assign(fe_seq_len, fe_seq_len + n_fe);
+  t.fe_revnat.assign(fe_revnat, fe_revnat + n_fe);
+  t.be_addr.assign(be_addr, be_addr + n_be);
+  t.be_port.assign(be_port, be_port + n_be);
+  t.seq_width = seq_width;
+  t.loaded = n_fe > 0;
+}
+
 // addr: n * stride bytes (stride 4 = v4, 16 = v6), big-endian address
 // bytes (the trie's walk order). sports may be null (disables CT).
 void nf_eval_batch(void* h, int64_t n, const uint8_t* addr, int stride,
@@ -244,6 +306,59 @@ void nf_eval_batch(void* h, int64_t n, const uint8_t* addr, int stride,
 
   for (int64_t i = 0; i < n; ++i) {
     const uint8_t* a = addr + size_t(i) * stride;
+    int32_t dport_i = dport[i];
+
+    // ── LB stage (egress, IPv4): VIP→backend translate BEFORE CT
+    // and policy, exactly like DatapathPipeline._process. The flow
+    // hash uses the PRE-NAT address + stable endpoint id so the pick
+    // matches the device path bit for bit.
+    uint8_t abuf[4];
+    bool no_service = false;
+    if (!ingress && !v6 && fp->lb.loaded) {
+      uint32_t dst = (uint32_t(a[0]) << 24) | (uint32_t(a[1]) << 16) |
+                     (uint32_t(a[2]) << 8) | a[3];
+      const LBTables& t = fp->lb;
+      for (size_t f = 0; f < t.fe_addr.size(); ++f) {
+        if (t.fe_addr[f] != dst || t.fe_port[f] != dport_i) continue;
+        if (t.fe_proto[f] != 0 && t.fe_proto[f] != proto[i]) continue;
+        if (t.fe_seq_len[f] <= 0) {
+          no_service = true;
+          break;
+        }
+        // mirror pipeline.py's np.clip fallback exactly: with a
+        // non-empty id table, out-of-range indices CLAMP (not raw)
+        uint32_t ep_id;
+        if (fp->ep_ids.empty()) {
+          ep_id = uint32_t(ep_idx[i]);
+        } else {
+          int64_t ci = ep_idx[i];
+          if (ci < 0) ci = 0;
+          if (ci >= int64_t(fp->ep_ids.size()))
+            ci = int64_t(fp->ep_ids.size()) - 1;
+          ep_id = fp->ep_ids[ci];
+        }
+        int32_t hsh = flow_hash32(
+            a, 4, sport ? sport[i] : 0, dport_i, proto[i], ep_id,
+            sport != nullptr);
+        int32_t be = t.fe_seq[f * t.seq_width + (hsh % t.fe_seq_len[f])];
+        uint32_t ba = t.be_addr[be];
+        abuf[0] = (ba >> 24) & 0xFF;
+        abuf[1] = (ba >> 16) & 0xFF;
+        abuf[2] = (ba >> 8) & 0xFF;
+        abuf[3] = ba & 0xFF;
+        a = abuf;
+        dport_i = t.be_port[be];
+        break;
+      }
+      if (no_service) {
+        verdict_out[i] = DROP_NO_SERVICE;
+        redirect_out[i] = 0;
+        if (uint32_t(ep_idx[i]) < fp->ep_count)
+          fp->counters[size_t(ep_idx[i]) * 3 + 2]++;  // dropped_other
+        continue;
+      }
+    }
+
     uint64_t ct_a = 0, ct_b = 0, ct_c = 0;
     if (use_ct) {
       // pack_keys layout (datapath/conntrack.py)
@@ -255,7 +370,7 @@ void nf_eval_batch(void* h, int64_t n, const uint8_t* addr, int stride,
                (uint64_t(a[2]) << 8) | a[3];
       }
       ct_c = (uint64_t(ep_idx[i]) << 41) | (uint64_t(sport[i]) << 25) |
-             (uint64_t(dport[i]) << 9) | (uint64_t(proto[i]) << 1) | dir;
+             (uint64_t(dport_i) << 9) | (uint64_t(proto[i]) << 1) | dir;
       if (fp->ct.probe(ct_a, ct_b, ct_c, now)) {
         verdict_out[i] = FORWARD;
         redirect_out[i] = 0;
@@ -274,14 +389,14 @@ void nf_eval_batch(void* h, int64_t n, const uint8_t* addr, int stride,
       // __policy_can_access probe order (bpf/lib/policy.h:46):
       // exact {id,dport,proto} -> L3-only {id} -> L4-only {dport,proto}
       int val = fp->policy.find(
-          ident, policy_kb(uint32_t(ep_idx[i]), uint32_t(dport[i]),
+          ident, policy_kb(uint32_t(ep_idx[i]), uint32_t(dport_i),
                            uint32_t(proto[i]), dir));
       if (val < 0)
         val = fp->policy.find(ident,
                               policy_kb(uint32_t(ep_idx[i]), 0, 0, dir));
       if (val < 0)
         val = fp->policy.find(
-            0, policy_kb(uint32_t(ep_idx[i]), uint32_t(dport[i]),
+            0, policy_kb(uint32_t(ep_idx[i]), uint32_t(dport_i),
                          uint32_t(proto[i]), dir));
       if (val > 0) {
         v = FORWARD;

@@ -129,6 +129,71 @@ class NativeFastpath:
     def ct_flush(self) -> None:
         self._lib.nf_ct_flush(self._h)
 
+    def set_endpoint_ids(self, ids: Sequence[int]) -> None:
+        """Stable endpoint ids per datapath index — the LB flow hash
+        input (a positional index would re-pick backends on unrelated
+        endpoint churn, same invariant as the device path)."""
+        arr = np.ascontiguousarray(ids, np.uint32)
+        self._lib.nf_set_endpoint_ids(
+            self._h, arr.shape[0], _ptr(arr, ctypes.c_uint32)
+        )
+
+    def load_lb(self, manager) -> None:
+        """Load the IPv4 service tables from a lb.ServiceManager —
+        built through the SAME build_device() used by the device path
+        so frontend order, selection sequences, and backend rows are
+        bit-identical (deterministic hash ⇒ identical picks). Flushes
+        conntrack (translated CT keys change with the tables).
+        IPv6 service tables are NOT supported natively — refusing
+        loudly beats silently diverging from the device path."""
+        tables = manager.build_device()
+        if tables.get(6) is not None:
+            raise RuntimeError(
+                "native front-end does not support IPv6 service tables"
+            )
+        t = tables.get(4)
+        if t is None:
+            self._lib.nf_load_lb(
+                self._h, 0, 1,
+                _ptr(np.zeros(1, np.uint32), ctypes.c_uint32),
+                _ptr(np.zeros(1, np.int32), ctypes.c_int32),
+                _ptr(np.zeros(1, np.int32), ctypes.c_int32),
+                _ptr(np.zeros(1, np.int32), ctypes.c_int32),
+                _ptr(np.zeros(1, np.int32), ctypes.c_int32),
+                _ptr(np.zeros(1, np.int32), ctypes.c_int32),
+                0,
+                _ptr(np.zeros(1, np.uint32), ctypes.c_uint32),
+                _ptr(np.zeros(1, np.int32), ctypes.c_int32),
+            )
+            self.ct_flush()
+            return
+        fe_bytes = np.asarray(t.fe_bytes, np.uint32)
+        fe_addr = np.ascontiguousarray(
+            (fe_bytes[:, 0] << 24) | (fe_bytes[:, 1] << 16)
+            | (fe_bytes[:, 2] << 8) | fe_bytes[:, 3], np.uint32
+        )
+        be_bytes = np.asarray(t.be_bytes, np.uint32)
+        be_addr = np.ascontiguousarray(
+            (be_bytes[:, 0] << 24) | (be_bytes[:, 1] << 16)
+            | (be_bytes[:, 2] << 8) | be_bytes[:, 3], np.uint32
+        )
+        fe_port = np.ascontiguousarray(t.fe_port, np.int32)
+        fe_proto = np.ascontiguousarray(t.fe_proto, np.int32)
+        fe_seq = np.ascontiguousarray(t.fe_seq, np.int32)
+        fe_seq_len = np.ascontiguousarray(t.fe_seq_len, np.int32)
+        fe_revnat = np.ascontiguousarray(t.fe_revnat, np.int32)
+        be_port = np.ascontiguousarray(t.be_port, np.int32)
+        self._lib.nf_load_lb(
+            self._h, fe_addr.shape[0], fe_seq.shape[1],
+            _ptr(fe_addr, ctypes.c_uint32), _ptr(fe_port, ctypes.c_int32),
+            _ptr(fe_proto, ctypes.c_int32), _ptr(fe_seq, ctypes.c_int32),
+            _ptr(fe_seq_len, ctypes.c_int32),
+            _ptr(fe_revnat, ctypes.c_int32),
+            be_addr.shape[0], _ptr(be_addr, ctypes.c_uint32),
+            _ptr(be_port, ctypes.c_int32),
+        )
+        self.ct_flush()
+
     # -- evaluation -----------------------------------------------------
     def process(
         self,
@@ -209,4 +274,7 @@ class NativeFastpath:
         nf.load_policy_snapshots(merged)
         nf.load_ipcache(pipeline.ipcache)
         nf.load_prefilter(pipeline.prefilter)
+        nf.set_endpoint_ids(pipeline._endpoint_ids)
+        if pipeline.lb is not None:
+            nf.load_lb(pipeline.lb)
         return nf

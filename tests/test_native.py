@@ -159,6 +159,93 @@ class TestConntrack:
         assert nf.counters[0, 1] == 3
 
 
+class TestLBParity:
+    def _lb_world(self):
+        from cilium_tpu.lb import Backend, L3n4Addr, ServiceManager
+
+        repo = Repository()
+        repo.add_list([
+            rule(
+                ["k8s:app=web"],
+                egress=[EgressRule(
+                    to_endpoints=(EndpointSelector.make(["k8s:app=db"]),),
+                    to_ports=(PortRule(ports=(PortProtocol(8080, "TCP"),)),),
+                )],
+                labels=["k8s:policy=nlb"],
+            ),
+        ])
+        reg = IdentityRegistry()
+        web = reg.allocate(parse_label_array(["k8s:app=web"]))
+        db = reg.allocate(parse_label_array(["k8s:app=db"]))
+        other = reg.allocate(parse_label_array(["k8s:app=other"]))
+        cache = IPCache()
+        cache.upsert("10.0.0.3/32", db.id, source="k8s")
+        cache.upsert("10.0.0.4/32", db.id, source="k8s")
+        cache.upsert("10.0.0.9/32", other.id, source="k8s")
+        lbm = ServiceManager()
+        lbm.upsert(L3n4Addr("10.96.0.10", 80, "TCP"),
+                   [Backend("10.0.0.3", 8080, weight=1),
+                    Backend("10.0.0.4", 8080, weight=3)])
+        lbm.upsert(L3n4Addr("10.96.0.99", 53, "UDP"), [])  # no backends
+        pipe = DatapathPipeline(PolicyEngine(repo, reg), cache,
+                                PreFilter(), lb=lbm)
+        pipe.set_endpoints([(7, web.id)])
+        return pipe, lbm
+
+    def test_vip_translation_parity(self):
+        """The native LB stage must pick the SAME backends as the
+        device path (shared hash + shared tables), so verdicts match
+        flow-for-flow including the weighted spread."""
+        pipe, lbm = self._lb_world()
+        nf = NativeFastpath.from_pipeline(pipe, ct_bits=0)
+        rng = np.random.default_rng(5)
+        n = 512
+        pool = ip_strings_to_u32(
+            ["10.96.0.10", "10.96.0.99", "10.0.0.3", "10.0.0.9", "8.8.8.8"]
+        )
+        ips = pool[rng.integers(0, len(pool), n)].astype(np.uint32)
+        eps = np.zeros(n, np.int32)
+        dports = rng.choice(np.array([80, 53, 8080], np.int32), n)
+        protos = np.where(dports == 53, 17, 6).astype(np.int32)
+        pv, pr = pipe.process(ips, eps, dports, protos, ingress=False)
+        nv, nr = nf.process(ips, eps, dports, protos, ingress=False)
+        assert np.array_equal(pv, nv) and np.array_equal(pr, nr)
+        # the batch exercised translate-allow, no-service, and deny
+        from cilium_tpu.datapath.pipeline import DROP_NO_SERVICE
+
+        assert {FORWARD, DROP_POLICY, DROP_NO_SERVICE} <= set(pv.tolist())
+
+    def test_lb_reload_flushes_ct_and_retranslates(self):
+        """Establish a flow via the VIP, then swap the service's
+        backends to a DENIED identity: the reload must flush CT (no
+        stale bypass) and the next packet re-translates to the new
+        backend and gets dropped by policy."""
+        from cilium_tpu.lb import Backend, L3n4Addr
+
+        pipe, lbm = self._lb_world()
+        nf = NativeFastpath.from_pipeline(pipe, ct_bits=12)
+        ips = ip_strings_to_u32(["10.96.0.10"])
+        args = (ips, np.zeros(1, np.int32), np.array([80], np.int32),
+                np.array([6], np.int32))
+        v1, _ = nf.process(*args, ingress=False, sports=np.array([4242]))
+        assert v1.tolist() == [FORWARD]
+        lbm.upsert(L3n4Addr("10.96.0.10", 80, "TCP"),
+                   [Backend("10.0.0.9", 8080)])  # 'other': denied
+        nf.load_lb(lbm)
+        v2, _ = nf.process(*args, ingress=False, sports=np.array([4242]))
+        assert v2.tolist() == [DROP_POLICY]  # no CT bypass survived
+
+    def test_v6_service_tables_rejected(self):
+        from cilium_tpu.lb import Backend, L3n4Addr
+
+        pipe, lbm = self._lb_world()
+        lbm.upsert(L3n4Addr("fd00::10", 80, "TCP"),
+                   [Backend("fd00::1", 8080)])
+        nf = NativeFastpath(ep_count=1, ct_bits=0)
+        with pytest.raises(RuntimeError, match="IPv6"):
+            nf.load_lb(lbm)
+
+
 class TestReload:
     def test_policy_reload_flushes_conntrack(self):
         from cilium_tpu.ops.materialize import EndpointPolicySnapshot
