@@ -365,6 +365,10 @@ class Daemon:
             self.endpoint_manager.remove(ep)
             if ep.ipv4:
                 self.ipcache.delete(f"{ep.ipv4}/32", SOURCE_AGENT)
+                # REST/CLI deletes must return the address to the pool
+                # or the pod CIDR leaks dry. release() is a no-op False
+                # for addresses IPAM never allocated (static IPs).
+                self.ipam.release(ep.ipv4)
             if ep.ipv6:
                 self.ipcache.delete(f"{ep.ipv6}/128", SOURCE_AGENT)
             if ep.identity is not None:

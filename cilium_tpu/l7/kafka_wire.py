@@ -235,7 +235,11 @@ def reject_response(
                 body += struct.pack(">ihq", p, error_code, -1)  # high watermark
                 if v >= 4:
                     body += struct.pack(">q", -1)  # last_stable_offset
-                    body += struct.pack(">i", 0)  # aborted txn count... -1?
+                    if v >= 5:
+                        body += struct.pack(">q", -1)  # log_start_offset
+                    # aborted_transactions is a NULLABLE array: null
+                    # encodes as count -1 (not an empty array)
+                    body += struct.pack(">i", -1)
                 body += struct.pack(">i", 0)  # message set size
     elif k == API_METADATA:
         if v >= 3:
@@ -267,6 +271,8 @@ def reject_response(
             for p in parts(t):
                 body += struct.pack(">ih", p, error_code)
     elif k == API_OFFSET_FETCH:
+        if v >= 3:
+            body += struct.pack(">i", 0)  # throttle_time
         body += struct.pack(">i", len(req.topics))
         for t in req.topics:
             body += _w_str(t) + struct.pack(">i", len(parts(t)))
@@ -274,6 +280,9 @@ def reject_response(
                 body += struct.pack(">iq", p, -1) + _w_str("") + struct.pack(
                     ">h", error_code
                 )
+        if v >= 2:
+            # v2+ carries a top-level error code after the topic array
+            body += struct.pack(">h", error_code)
     # other api keys: header-only frame (still unblocks the client)
     return _frame(req.correlation_id, body)
 

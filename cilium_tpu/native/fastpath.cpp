@@ -134,6 +134,22 @@ struct Conntrack {
     return false;
   }
 
+  // forward tuple, then the flipped reply tuple (swapped sport/dport,
+  // inverted direction bit) — the same pair FlowConntrack.lookup_batch
+  // probes via flip_kc, mirroring the kernel's forward/reverse tuple
+  // pair (bpf/lib/conntrack.h ct_lookup)
+  inline bool probe_pair(uint64_t a, uint64_t b, uint64_t c, double now) {
+    if (probe(a, b, c, now)) return true;
+    uint64_t ep = c >> 41;
+    uint64_t sport = (c >> 25) & 0xFFFF;
+    uint64_t dport = (c >> 9) & 0xFFFF;
+    uint64_t proto = (c >> 1) & 0xFF;
+    uint64_t dir = c & 1;
+    uint64_t flipped = (ep << 41) | (dport << 25) | (sport << 9) |
+                       (proto << 1) | (dir ^ 1);
+    return probe(a, b, flipped, now);
+  }
+
   inline void insert(uint64_t a, uint64_t b, uint64_t c, double now) {
     uint64_t h = hash(a, b, c);
     for (int p = 0; p < kProbes; ++p) {
@@ -371,7 +387,7 @@ void nf_eval_batch(void* h, int64_t n, const uint8_t* addr, int stride,
       }
       ct_c = (uint64_t(ep_idx[i]) << 41) | (uint64_t(sport[i]) << 25) |
              (uint64_t(dport_i) << 9) | (uint64_t(proto[i]) << 1) | dir;
-      if (fp->ct.probe(ct_a, ct_b, ct_c, now)) {
+      if (fp->ct.probe_pair(ct_a, ct_b, ct_c, now)) {
         verdict_out[i] = FORWARD;
         redirect_out[i] = 0;
         if (uint32_t(ep_idx[i]) < fp->ep_count)

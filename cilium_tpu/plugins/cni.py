@@ -65,12 +65,11 @@ def cni_del(daemon, container_id: str) -> bool:
     """CNI DEL: tear down the endpoint and release its IP. Idempotent
     (the CNI spec requires DEL to succeed for unknown containers)."""
     ep_id = endpoint_id_for(container_id)
-    ep = daemon.endpoint_manager.lookup(ep_id)
-    ip = ep.ipv4 if ep is not None else None
-    deleted = daemon.endpoint_delete(ep_id)
-    if ip:
-        daemon.ipam.release(ip)
-    return deleted
+    # endpoint_delete releases the endpoint's IPAM address itself; a
+    # second release here would race a concurrent ADD that was just
+    # handed the freed address and release it out from under the new
+    # endpoint.
+    return daemon.endpoint_delete(ep_id)
 
 
 def endpoint_id_for(container_id: str) -> int:

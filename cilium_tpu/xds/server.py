@@ -41,14 +41,38 @@ def _send_msg(conn: socket.socket, msg: dict) -> None:
     conn.sendall(struct.pack("<I", len(data)) + data)
 
 
-def _recv_msg(conn: socket.socket) -> Optional[dict]:
+def _recv_msg(
+    conn: socket.socket,
+    stop: Optional[threading.Event] = None,
+    frame_deadline: float = 30.0,
+) -> Optional[dict]:
     """Read one length-framed JSON message. socket.timeout escapes
     ONLY between frames: once any byte of a frame is consumed, a
     timeout mid-frame keeps reading — surfacing it would discard the
     consumed bytes and permanently desync the stream (the next read
-    would parse body bytes as a length header)."""
+    would parse body bytes as a length header). The mid-frame retries
+    are bounded: a set ``stop`` event or ``frame_deadline`` seconds
+    without completing the frame aborts the connection (a client that
+    stalls mid-frame must not pin its server thread forever)."""
+    import time as _time
+
+    started: Optional[float] = None  # set when the first byte lands
+
+    def _give_up() -> bool:
+        if stop is not None and stop.is_set():
+            return True
+        return (
+            started is not None
+            and _time.monotonic() - started > frame_deadline
+        )
+
     hdr = b""
     while len(hdr) < 4:
+        # checked every iteration, not just on timeout — a client
+        # trickling bytes faster than the socket timeout must not
+        # bypass the deadline
+        if _give_up():
+            return None
         try:
             chunk = conn.recv(4 - len(hdr))
         except socket.timeout:
@@ -57,16 +81,20 @@ def _recv_msg(conn: socket.socket) -> Optional[dict]:
             continue
         if not chunk:
             return None
+        if started is None:
+            started = _time.monotonic()
         hdr += chunk
     (n,) = struct.unpack("<I", hdr)
     if n > _MAX_FRAME:
         raise ValueError(f"xds frame too large ({n})")
     buf = b""
     while len(buf) < n:
+        if _give_up():
+            return None
         try:
             chunk = conn.recv(n - len(buf))
         except socket.timeout:
-            continue  # mid-frame: never abandon consumed bytes
+            continue  # mid-frame: keep the stream in sync
         if not chunk:
             return None
         buf += chunk
@@ -172,7 +200,15 @@ class XDSServer:
         ACK/NACK of the previous response and a (re)subscription."""
         node = "unknown"
         try:
-            hello = _recv_msg(conn)
+            conn.settimeout(0.2)
+            hello = None
+            while hello is None and not self._stop.is_set():
+                try:
+                    hello = _recv_msg(conn, self._stop)
+                except socket.timeout:
+                    continue
+                if hello is None:
+                    return  # EOF or mid-frame stall: drop the stream
             if not hello:
                 return
             node = hello.get("node", "unknown")
@@ -180,7 +216,6 @@ class XDSServer:
             subs: Dict[str, Optional[List[str]]] = {}
             sent_version: Dict[str, int] = {}
             sent_nonce: Dict[str, str] = {}
-            conn.settimeout(0.2)
 
             def push(type_url: str) -> None:
                 version, resources = self.cache.get(
@@ -200,7 +235,7 @@ class XDSServer:
 
             while not self._stop.is_set():
                 try:
-                    req = _recv_msg(conn)
+                    req = _recv_msg(conn, self._stop)
                 except socket.timeout:
                     # version moved since last push? re-push
                     # (version() is copy-free — this runs 5×/s)

@@ -49,11 +49,18 @@ def produce_req(cid=7, client="cli", topics=(("orders", (0, 1)),), version=0):
 def fetch_req(cid=9, client="cons", topics=(("logs", (0,)),), version=0):
     body = struct.pack(">hhi", API_FETCH, version, cid) + _s(client)
     body += struct.pack(">iii", -1, 500, 1)  # replica, max_wait, min_bytes
+    if version >= 3:
+        body += struct.pack(">i", 1 << 21)  # max_bytes
+    if version >= 4:
+        body += struct.pack(">b", 0)  # isolation_level
     body += struct.pack(">i", len(topics))
     for t, parts in topics:
         body += _s(t) + struct.pack(">i", len(parts))
         for p in parts:
-            body += struct.pack(">iqi", p, 0, 1 << 20)  # offset, max_bytes
+            body += struct.pack(">iq", p, 0)  # partition, fetch_offset
+            if version >= 5:
+                body += struct.pack(">q", 0)  # log_start_offset
+            body += struct.pack(">i", 1 << 20)  # max_bytes
     return _frame(body)
 
 
@@ -149,6 +156,78 @@ class TestReject:
         (ntop,) = struct.unpack(">i", resp[off:off + 4]); off += 4
         (err,) = struct.unpack(">h", resp[off:off + 2]); off += 2
         assert ntop == 1 and err == ERR_TOPIC_AUTHORIZATION_FAILED
+
+    def test_offset_fetch_v2_trailing_error_and_v3_throttle(self):
+        """OffsetFetch v2+ carries a top-level error_code after the
+        topic array (and v3+ a leading throttle_time) — clients on
+        those versions parse the whole frame or fail."""
+        def build(version):
+            body = struct.pack(">hhi", API_OFFSET_FETCH, version, 5)
+            body += _s("c") + _s("g1")
+            body += struct.pack(">i", 1) + _s("logs")
+            body += struct.pack(">i", 1) + struct.pack(">i", 0)
+            return parse_request(_frame(body))
+
+        def walk_topics(resp, off):
+            (ntop,) = struct.unpack(">i", resp[off:off + 4]); off += 4
+            for _ in range(ntop):
+                (tlen,) = struct.unpack(">h", resp[off:off + 2])
+                off += 2 + tlen
+                (nparts,) = struct.unpack(">i", resp[off:off + 4]); off += 4
+                for _ in range(nparts):
+                    off += 4 + 8  # partition, offset
+                    (mlen,) = struct.unpack(">h", resp[off:off + 2])
+                    off += 2 + max(0, mlen) + 2  # metadata, error_code
+            return off
+
+        resp = reject_response(build(2))
+        off = walk_topics(resp, 8)
+        (top_err,) = struct.unpack(">h", resp[off:off + 2]); off += 2
+        assert top_err == ERR_TOPIC_AUTHORIZATION_FAILED
+        assert off == len(resp)  # nothing unparsed
+
+        resp = reject_response(build(3))
+        (throttle,) = struct.unpack(">i", resp[8:12])
+        assert throttle == 0
+        off = walk_topics(resp, 12)
+        (top_err,) = struct.unpack(">h", resp[off:off + 2]); off += 2
+        assert top_err == ERR_TOPIC_AUTHORIZATION_FAILED
+        assert off == len(resp)
+
+        # v0/v1 keep the legacy shape: no trailing error code
+        resp = reject_response(build(0))
+        assert walk_topics(resp, 8) == len(resp)
+
+    def test_fetch_v4_v5_null_aborted_transactions(self):
+        """Fetch v4+ aborted_transactions is a NULLABLE array — null
+        encodes as count -1; v5 adds log_start_offset before it."""
+        def build(version):
+            return parse_request(fetch_req(version=version))
+
+        for version in (4, 5):
+            resp = reject_response(build(version))
+            off = 8
+            (throttle,) = struct.unpack(">i", resp[off:off + 4]); off += 4
+            assert throttle == 0
+            (ntop,) = struct.unpack(">i", resp[off:off + 4]); off += 4
+            assert ntop == 1
+            (tlen,) = struct.unpack(">h", resp[off:off + 2]); off += 2 + tlen
+            (nparts,) = struct.unpack(">i", resp[off:off + 4]); off += 4
+            for _ in range(nparts):
+                p, err, hw = struct.unpack(">ihq", resp[off:off + 14])
+                off += 14
+                assert err == ERR_TOPIC_AUTHORIZATION_FAILED
+                (lso,) = struct.unpack(">q", resp[off:off + 8]); off += 8
+                assert lso == -1
+                if version >= 5:
+                    (log_start,) = struct.unpack(">q", resp[off:off + 8])
+                    off += 8
+                    assert log_start == -1
+                (ntxn,) = struct.unpack(">i", resp[off:off + 4]); off += 4
+                assert ntxn == -1  # null, not empty
+                (msize,) = struct.unpack(">i", resp[off:off + 4]); off += 4
+                assert msize == 0
+            assert off == len(resp)
 
     def test_unknown_api_key_header_only(self):
         body = struct.pack(">hhi", 18, 0, 77) + _s("x")  # ApiVersions

@@ -147,6 +147,39 @@ class TestConntrack:
         v3, _ = nf.process(*args, sports=np.array([5555]))
         assert v3.tolist() == [FORWARD]
 
+    def test_reply_direction_bypass_parity(self):
+        """A reply packet of an established egress flow must hit CT via
+        the flipped tuple and forward — even when ingress policy would
+        deny it — exactly like FlowConntrack.lookup_batch's flip_kc
+        path (bpf/lib/conntrack.h reverse-tuple lookup)."""
+        from cilium_tpu.datapath.conntrack import FlowConntrack
+
+        pipe, ids = _world()
+        pipe.conntrack = FlowConntrack(capacity_bits=12)
+        nf = NativeFastpath.from_pipeline(pipe, ct_bits=12)
+        # web (ep 0) egress to db:5432 — allowed, creates CT state
+        db_ip = ip_strings_to_u32(["10.0.0.3"])
+        eg = (db_ip, np.zeros(1, np.int32), np.array([5432], np.int32),
+              np.array([6], np.int32))
+        pv, _ = pipe.process(*eg, ingress=False, sports=np.array([40000]))
+        nv, _ = nf.process(*eg, ingress=False, sports=np.array([40000]))
+        assert pv.tolist() == [FORWARD] and nv.tolist() == [FORWARD]
+        # reply: ingress from db, sport 5432, dport 40000 — web's
+        # ingress policy only allows lb on 80, so a policy verdict
+        # would DROP; the reverse-tuple CT hit must forward instead
+        rep = (db_ip, np.zeros(1, np.int32), np.array([40000], np.int32),
+               np.array([6], np.int32))
+        pv, _ = pipe.process(*rep, ingress=True, sports=np.array([5432]))
+        nv, _ = nf.process(*rep, ingress=True, sports=np.array([5432]))
+        assert pv.tolist() == [FORWARD], "device reply path regressed"
+        assert nv.tolist() == [FORWARD], "native missed the reply tuple"
+        # same packet WITHOUT prior state drops in both engines
+        pipe.conntrack.flush()
+        nf.ct_flush()
+        pv, _ = pipe.process(*rep, ingress=True, sports=np.array([5432]))
+        nv, _ = nf.process(*rep, ingress=True, sports=np.array([5432]))
+        assert pv.tolist() == nv.tolist() == [DROP_POLICY]
+
     def test_denied_flow_never_cached(self):
         pipe, ids = _world()
         nf = NativeFastpath.from_pipeline(pipe, ct_bits=12)
