@@ -138,6 +138,45 @@ def _bench_rule_update(engine, repo, rng) -> float:
     return sorted(samples)[len(samples) // 2] * 1000
 
 
+def _bench_rule_delete(engine, repo, rng) -> float:
+    """Median blocking time for a single-rule delete to be live
+    (refcounted in-place retraction — the incremental path of
+    repository.go DeleteByLabels:286)."""
+    from cilium_tpu.labels import parse_label_array
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        rule,
+    )
+
+    samples = []
+    for i in range(8):
+        lbl = f"k8s:policy=bench-del-{i}"
+        r = rule(
+            [f"k8s:app=a{rng.randrange(512)}"],
+            ingress=[
+                IngressRule(
+                    from_endpoints=(
+                        EndpointSelector.make([f"k8s:app=a{rng.randrange(512)}"]),
+                    ),
+                    to_ports=(PortRule(ports=(PortProtocol(443, "TCP"),)),),
+                )
+            ],
+            labels=[lbl],
+        )
+        repo.add_list([r])
+        engine.refresh()
+        jax.block_until_ready(engine.device_policy.sel_match)
+        t0 = time.time()
+        repo.delete_by_labels(parse_label_array([lbl]))
+        engine.refresh()
+        jax.block_until_ready(engine.device_policy.ingress.allow_t)
+        samples.append(time.time() - t0)
+    return sorted(samples)[len(samples) // 2] * 1000
+
+
 def _bench_lpm_50k(nrng: np.random.Generator) -> float:
     """50k-prefix LPM match rate (BASELINE.md north-star: the ipcache
     identity-derivation stage at production prefix counts,
@@ -208,15 +247,18 @@ def _bench_kafka_acl() -> float:
     return iters * len(reqs) / (time.time() - t0)
 
 
-def _bench_native(snaps, idents, nrng: np.random.Generator) -> float:
+def _bench_native(snaps, idents, nrng: np.random.Generator):
     """Native C++ front-end rate on the SAME materialized state (the
-    per-node enforcement loop; SURVEY native census item 1)."""
+    per-node enforcement loop; SURVEY native census item 1). Returns
+    (single_thread_vps, {n_threads: vps}) — the multi-thread sweep
+    exercises the snapshot-read/atomic-counter eval path (one loader /
+    N evaluators)."""
     from cilium_tpu.identity.model import ID_WORLD
     from cilium_tpu.ipcache.ipcache import IPCache
     from cilium_tpu.native import NativeFastpath, native_available
 
     if not native_available():
-        return 0.0
+        return 0.0, {}
     cache = IPCache()
     for i, ident in enumerate(idents):
         cache.upsert(f"10.{(i >> 8) & 255}.{i & 255}.1/32", ident.id, source="k8s")
@@ -240,6 +282,92 @@ def _bench_native(snaps, idents, nrng: np.random.Generator) -> float:
     t0 = time.time()
     for _ in range(iters):
         nf.process(ips, eps, dports, protos)
+    single = iters * b / (time.time() - t0)
+
+    import threading
+
+    def run_threads(k: int) -> float:
+        barrier = threading.Barrier(k + 1)
+
+        def worker():
+            barrier.wait()
+            for _ in range(iters):
+                nf.process(ips, eps, dports, protos)
+
+        ts = [threading.Thread(target=worker) for _ in range(k)]
+        for t in ts:
+            t.start()
+        barrier.wait()
+        t0 = time.time()
+        for t in ts:
+            t.join()
+        return k * iters * b / (time.time() - t0)
+
+    ncpu = os.cpu_count() or 1
+    mt = {}
+    for k in (4, 8):
+        if ncpu >= 2:  # scaling is meaningless on one core
+            mt[k] = run_threads(k)
+    return single, mt
+
+
+def _bench_native_l7() -> float:
+    """Native L7 HTTP enforcement rate (DFA walk + rule chain in C++,
+    the envoy/cilium_l7policy.cc role; SURVEY native census item 3)."""
+    from cilium_tpu.l7.http_policy import HTTPPolicy, HTTPRequest
+    from cilium_tpu.native import NativeFastpath, native_available
+    from cilium_tpu.policy.api import HTTPRule
+
+    if not native_available():
+        return 0.0
+    pol = HTTPPolicy(
+        [(HTTPRule(path=f"/api/v{i}/[a-z0-9]*"), None) for i in range(8)]
+        + [(HTTPRule(path=f"/svc{i}/.*"), {100 + i}) for i in range(8)]
+    )
+    nf = NativeFastpath(ep_count=1, ct_bits=0)
+    nf.load_l7_http(7, 80, pol)
+    b = 1 << 17
+    reqs = [
+        HTTPRequest(
+            method="GET", path=f"/api/v{i % 8}/obj{i % 97}",
+            src_identity=100 + (i % 16),
+        )
+        for i in range(b)
+    ]
+    nf.check_http_batch(7, 80, reqs[:1000])
+    # pre-marshal once: in production the wire front-end hands the
+    # enforcer packed buffers; re-encoding Python strings per iteration
+    # would measure the test harness, not the DFA walk
+    import ctypes
+
+    from cilium_tpu.ops.dfa import strings_to_batch
+
+    mb, ml = strings_to_batch([r.method.encode() for r in reqs], 16)
+    pb, pl = strings_to_batch([r.path.encode() for r in reqs], 256)
+    hb, hl = strings_to_batch([r.host.encode() for r in reqs], 256)
+    src = np.ascontiguousarray([r.src_identity for r in reqs], np.uint64)
+    mb = np.ascontiguousarray(mb, np.uint8)
+    pb = np.ascontiguousarray(pb, np.uint8)
+    hb = np.ascontiguousarray(hb, np.uint8)
+    ml = np.ascontiguousarray(ml, np.int32)
+    pl = np.ascontiguousarray(pl, np.int32)
+    hl = np.ascontiguousarray(hl, np.int32)
+    allow = np.empty(b, np.uint8)
+
+    def ptr(a, ct):
+        return a.ctypes.data_as(ctypes.POINTER(ct))
+
+    c = ctypes
+    iters = 5
+    t0 = time.time()
+    for _ in range(iters):
+        nf._lib.nf_l7_http_batch(
+            nf._h, 7, 80, 1, b,
+            ptr(mb, c.c_uint8), 16, ptr(ml, c.c_int32),
+            ptr(pb, c.c_uint8), 256, ptr(pl, c.c_int32),
+            ptr(hb, c.c_uint8), 256, ptr(hl, c.c_int32),
+            ptr(src, c.c_uint64), ptr(allow, c.c_uint8),
+        )
     return iters * b / (time.time() - t0)
 
 
@@ -323,6 +451,7 @@ def main() -> None:
     # single-rule import (pkg/endpoint/policy.go:506 analog).
     update_ident_ms, update_ident_host_ms = _bench_ident_update(engine, reg)
     update_rule_ms = _bench_rule_update(engine, repo, rng)
+    update_rule_delete_ms = _bench_rule_delete(engine, repo, rng)
     dispatch_rtt_ms = _bench_dispatch_rtt()
 
     # ── the other north-star configs (BASELINE.md): LPM at 50k
@@ -333,9 +462,11 @@ def main() -> None:
     lpm50k = _bench_lpm_50k(np.random.default_rng(3)) if extra else 0.0
     l7_dfa = _bench_l7_dfa() if extra else 0.0
     kafka_acl = _bench_kafka_acl() if extra else 0.0
-    native_vps = (
-        _bench_native(_snaps, idents, np.random.default_rng(5)) if extra else 0.0
+    native_vps, native_mt = (
+        _bench_native(_snaps, idents, np.random.default_rng(5))
+        if extra else (0.0, {})
     )
+    native_l7_rps = _bench_native_l7() if extra else 0.0
     t0 = time.time()
     tables2, _ = materialize_endpoints(
         compiled, engine.device_policy, ep_ids, ingress=True
@@ -353,10 +484,13 @@ def main() -> None:
         "update_ident_ms": round(update_ident_ms, 1),
         "update_ident_host_ms": round(update_ident_host_ms, 1),
         "update_rule_ms": round(update_rule_ms, 1),
+        "update_rule_delete_ms": round(update_rule_delete_ms, 1),
         "lpm50k_lps": round(lpm50k),
         "l7_dfa_rps": round(l7_dfa),
         "kafka_acl_rps": round(kafka_acl),
         "native_vps": round(native_vps),
+        "native_vps_mt": {k: round(v) for k, v in native_mt.items()},
+        "native_l7_rps": round(native_l7_rps),
         "rebuild_warm_s": round(rebuild_warm_s, 2),
     }
     print(json.dumps(result))
@@ -374,6 +508,7 @@ def main() -> None:
                     "endpoints": N_ENDPOINTS,
                     "batch": BATCH,
                     "dispatch_rtt_ms": round(dispatch_rtt_ms, 1),
+                    "host_cpus": os.cpu_count(),
                 }
             }
         ),

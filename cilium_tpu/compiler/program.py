@@ -99,6 +99,23 @@ def _bucket_slack(n: int, minimum: int = 8) -> int:
     return _bucket(n + max(4, n // 4), minimum)
 
 
+def _remove_occurrences(items: list, removed: list) -> list:
+    """Remove each element of ``removed`` once from ``items``
+    (multiset subtraction, order-preserving)."""
+    if not removed:
+        return items
+    from collections import Counter
+
+    need = Counter(removed)
+    kept = []
+    for x in items:
+        if need.get(x, 0) > 0:
+            need[x] -= 1
+        else:
+            kept.append(x)
+    return kept
+
+
 def _pad_bool(values: Sequence[bool], size: int) -> np.ndarray:
     out = np.zeros(size, dtype=bool)
     out[: len(values)] = values
@@ -248,13 +265,31 @@ class DirectionPacker:
     of later rule batches, provided every axis stays inside its padded
     bucket. This is the incremental half of the regeneration protocol
     (pkg/endpoint/policy.go:506-552): a single rule import mutates a
-    few matrix cells instead of recompiling the world."""
+    few matrix cells instead of recompiling the world.
+
+    Cells are **reference-counted per contributing rule** so rule
+    deletion is also incremental (repository.go DeleteByLabels:286
+    deletes in place): ``remove_rule`` decrements each cell the rule
+    contributed and clears cells reaching zero, logging value-0 writes
+    the engine scatters to the device — no recompile, no reshape.
+    Orphaned selector columns / port-vocab ids / combo slots stay
+    allocated (they can never activate with their cells cleared) and
+    are reclaimed by the next natural full rebuild."""
 
     def __init__(self, raw: _RawDirection, s_pad: int) -> None:
         self.s_pad = s_pad
         self.n_groups = len(raw.group_no_peers)
         self.entries: List[Tuple[int, int, int, int, bool, int]] = []
         self.l7_list: List[Tuple[int, int, int]] = []
+        # cell → number of rule contributions still referencing it
+        self.cell_refs: Dict[Tuple[str, int, int], int] = {}
+        # per-rule attribution (key = id(rule)): cells (with
+        # multiplicity), owned group ids, entry/l7 tuples
+        self.rule_cells: Dict[int, List[Tuple[str, int, int]]] = {}
+        self.rule_groups: Dict[int, List[int]] = {}
+        self.rule_entries: Dict[int, List[tuple]] = {}
+        self.rule_l7: Dict[int, List[tuple]] = {}
+        self._attr_key: Optional[int] = None
 
         # Port vocabulary over entries ∪ L7 ports (L7 is always TCP).
         self.port_id: Dict[Tuple[int, int], int] = {}
@@ -311,12 +346,76 @@ class DirectionPacker:
         # their writes here so the engine can patch device tables with
         # tiny scatters instead of re-uploading whole matrices.
         self.writes: List[Tuple[str, int, int, int]] = []
-        self._write(raw, group_offset=0)
-        self.writes.clear()  # initial build uploads wholesale
 
     def take_writes(self) -> List[Tuple[str, int, int, int]]:
         w, self.writes = self.writes, []
         return w
+
+    def _mat_by_name(self, name: str) -> np.ndarray:
+        p = self.prog
+        return {
+            "deny": p.deny_mat, "allow": p.allow_mat,
+            "s1": p.s1_mat, "p1": p.p1_mat,
+            "en": p.en_mat, "ee": p.ee_mat,
+            "gpn": p.gpn_mat, "gpe": p.gpe_mat,
+            "s7": p.s7_mat, "p7": p.p7_mat, "g7": p.g7_mat,
+        }[name]
+
+    def write_rule(self, rule_key: int, raw: _RawDirection) -> None:
+        """Write ONE rule's raw extraction, attributing every cell,
+        group, and entry to ``rule_key`` for later removal. Callers
+        must call refresh_entry_views() after a batch."""
+        self._attr_key = rule_key
+        self.rule_cells.setdefault(rule_key, [])
+        self.rule_groups.setdefault(rule_key, []).extend(
+            range(self.n_groups, self.n_groups + len(raw.group_no_peers))
+        )
+        n_ent, n_l7 = len(self.entries), len(self.l7_list)
+        self._write(raw, group_offset=self.n_groups)
+        self.rule_entries.setdefault(rule_key, []).extend(self.entries[n_ent:])
+        self.rule_l7.setdefault(rule_key, []).extend(self.l7_list[n_l7:])
+        self._attr_key = None
+
+    def remove_rule(self, rule_key: int) -> bool:
+        """Retract one rule's contributions in place. False when the
+        rule is unknown to this packer (caller must full-rebuild).
+        Callers must call refresh_entry_views() after a batch."""
+        cells = self.rule_cells.pop(rule_key, None)
+        if cells is None:
+            return False
+        for key in cells:
+            n = self.cell_refs.get(key, 0) - 1
+            if n > 0:
+                self.cell_refs[key] = n
+            else:
+                self.cell_refs.pop(key, None)
+                name, i, j = key
+                self._mat_by_name(name)[i, j] = 0
+                self.writes.append((name, i, j, 0))
+        for g in self.rule_groups.pop(rule_key, []):
+            # groups are per-rule unique: disable outright (with its
+            # gpn/gpe/g7 cells cleared above the group can never pass)
+            if self.prog.group_no_peers[g]:
+                self.prog.group_no_peers[g] = False
+                self.writes.append(("group_no_peers", g, 0, 0))
+        self.entries = _remove_occurrences(
+            self.entries, self.rule_entries.pop(rule_key, [])
+        )
+        self.l7_list = _remove_occurrences(
+            self.l7_list, self.rule_l7.pop(rule_key, [])
+        )
+        return True
+
+    def refresh_entry_views(self) -> None:
+        """Rebuild the raw entry arrays host-side consumers read
+        (policymap slot discovery) — called once per write/remove
+        batch, not per rule, to stay linear."""
+        p = self.prog
+        p.e_subj = np.asarray([e[0] for e in self.entries], np.int32)
+        p.e_port = np.asarray([e[2] for e in self.entries], np.int32)
+        p.e_proto = np.asarray([e[3] for e in self.entries], np.int32)
+        p.l7_subj = np.asarray([l[0] for l in self.l7_list], np.int32)
+        p.l7_port = np.asarray([l[1] for l in self.l7_list], np.int32)
 
     # ------------------------------------------------------------------
     def can_append(self, raw: _RawDirection) -> bool:
@@ -360,10 +459,6 @@ class DirectionPacker:
             max_sel = max(max_sel, sid)
         return max_sel < self.s_pad
 
-    def append(self, raw: _RawDirection) -> None:
-        """In-place append (caller must have checked ``can_append``)."""
-        self._write(raw, group_offset=self.n_groups)
-
     # ------------------------------------------------------------------
     def _port(self, port: int, proto: int) -> int:
         key = (port, proto)
@@ -377,7 +472,12 @@ class DirectionPacker:
         return pid
 
     def _set(self, name: str, mat: np.ndarray, i: int, j: int) -> None:
-        if not mat[i, j]:
+        key = (name, i, j)
+        n = self.cell_refs.get(key, 0)
+        self.cell_refs[key] = n + 1
+        if self._attr_key is not None:
+            self.rule_cells[self._attr_key].append(key)
+        if n == 0:
             mat[i, j] = 1
             self.writes.append((name, i, j, 1))
 
@@ -417,16 +517,28 @@ class DirectionPacker:
             self._set("g7", p.g7_mat, group + group_offset, k)
             self.l7_list.append((subj, port, group + group_offset))
 
-        # refresh raw entry views for host-side consumers
-        p.e_subj = np.asarray([e[0] for e in self.entries], np.int32)
-        p.e_port = np.asarray([e[2] for e in self.entries], np.int32)
-        p.e_proto = np.asarray([e[3] for e in self.entries], np.int32)
-        p.l7_subj = np.asarray([l[0] for l in self.l7_list], np.int32)
-        p.l7_port = np.asarray([l[1] for l in self.l7_list], np.int32)
 
-
-def _pack_direction(raw: _RawDirection, s_pad: int) -> DirectionProgram:
-    return DirectionPacker(raw, s_pad).prog
+def _merge_raws(raws: Sequence[_RawDirection]) -> _RawDirection:
+    """Concatenate per-rule raws into one batch raw, renumbering group
+    ids globally (the shape the packer sizes its buckets from)."""
+    deny: List[Tuple[int, int]] = []
+    allow: List[Tuple[int, int]] = []
+    entries: List[Tuple[int, int, int, int, bool, int]] = []
+    gnp: List[bool] = []
+    gp: List[Tuple[int, int, bool]] = []
+    l7: List[Tuple[int, int, int]] = []
+    off = 0
+    for raw in raws:
+        deny.extend(raw.deny)
+        allow.extend(raw.allow)
+        entries.extend(
+            (s, sid, p, pr, e, g + off) for (s, sid, p, pr, e, g) in raw.entries
+        )
+        gp.extend((g + off, sid, e) for (g, sid, e) in raw.gp)
+        l7.extend((s, p, g + off) for (s, p, g) in raw.l7_ports)
+        gnp.extend(raw.group_no_peers)
+        off += len(raw.group_no_peers)
+    return _RawDirection(deny, allow, entries, gnp, gp, l7)
 
 
 @dataclasses.dataclass
@@ -455,8 +567,13 @@ def compile_policy_state(
     with repo._lock:
         rules = list(repo.rules)
         revision = repo.revision
-    raw_ingress = _extract_direction(rules, table, ingress=True)
-    raw_egress = _extract_direction(rules, table, ingress=False)
+    # Per-rule raws (same intern/group order as one batch extraction)
+    # so every matrix cell is attributed to its contributing rule —
+    # the basis for incremental deletion.
+    raws_ingress = [_extract_direction([r], table, ingress=True) for r in rules]
+    raws_egress = [_extract_direction([r], table, ingress=False) for r in rules]
+    raw_ingress = _merge_raws(raws_ingress)
+    raw_egress = _merge_raws(raws_egress)
 
     # Selector axis padded to a multiple of 128 (MXU tile) — the padded
     # tail never matches (no conjuncts) and relation matrices are zero
@@ -464,6 +581,13 @@ def compile_policy_state(
     s_pad = max(128, ((len(table) + 127) // 128) * 128)
     ing_packer = DirectionPacker(raw_ingress, s_pad)
     eg_packer = DirectionPacker(raw_egress, s_pad)
+    for r, raw_i, raw_e in zip(rules, raws_ingress, raws_egress):
+        ing_packer.write_rule(id(r), raw_i)
+        eg_packer.write_rule(id(r), raw_e)
+    ing_packer.refresh_entry_views()
+    eg_packer.refresh_entry_views()
+    ing_packer.writes.clear()  # initial build uploads wholesale
+    eg_packer.writes.clear()
 
     vocab = registry.vocab
     lowered = table.lower_bits(vocab)
@@ -519,8 +643,10 @@ def try_append_rules(
     """
     table = state.table
     old_len = len(table)
-    raw_in = _extract_direction(rules, table, ingress=True)
-    raw_eg = _extract_direction(rules, table, ingress=False)
+    raws_in = [_extract_direction([r], table, ingress=True) for r in rules]
+    raws_eg = [_extract_direction([r], table, ingress=False) for r in rules]
+    raw_in = _merge_raws(raws_in)
+    raw_eg = _merge_raws(raws_eg)
     if len(table) > compiled.ingress.s_pad:
         return None
     vocab = registry.vocab
@@ -535,8 +661,11 @@ def try_append_rules(
     if not (state.ingress.can_append(raw_in) and state.egress.can_append(raw_eg)):
         return None
 
-    state.ingress.append(raw_in)
-    state.egress.append(raw_eg)
+    for r, ri, re in zip(rules, raws_in, raws_eg):
+        state.ingress.write_rule(id(r), ri)
+        state.egress.write_rule(id(r), re)
+    state.ingress.refresh_entry_views()
+    state.egress.refresh_entry_views()
     for i, conjs in enumerate(new_lowered):
         sid = old_len + i
         for j, (require, forbid) in enumerate(conjs):

@@ -142,7 +142,9 @@ class PolicyEngine:
             rule_ops = []
             if c.revision != self.repo.revision:
                 rule_ops = self.repo.changes_since(c.revision)
-                if rule_ops is None or any(op != "add" for _, op, _ in rule_ops):
+                if rule_ops is None or any(
+                    op not in ("add", "delete") for _, op, _ in rule_ops
+                ):
                     return self._full_refresh()
 
             if not self._apply_identity_delta():
@@ -152,10 +154,16 @@ class PolicyEngine:
             # between changes_since() and here must stay stale so the
             # next refresh picks it up (otherwise its rules — including
             # deny rules → fail-open — would never compile).
-            for rev, _op, payload in rule_ops:
-                # "add" payload is the tuple of rules added at that rev
-                if not self._apply_rule_append(list(payload), rev):
-                    return self._full_refresh()
+            for rev, op, payload in rule_ops:
+                if op == "add":
+                    # payload is the tuple of rules added at that rev
+                    if not self._apply_rule_append(list(payload), rev):
+                        return self._full_refresh()
+                else:  # "delete": payload = (labels, deleted_rules)
+                    if len(payload) < 2 or not self._apply_rule_delete(
+                        list(payload[1]), rev
+                    ):
+                        return self._full_refresh()
             return c
 
     def _full_refresh(self) -> CompiledPolicy:
@@ -304,16 +312,23 @@ class PolicyEngine:
         for name, items in by_name.items():
             ii = np.asarray([x[0] for x in items])
             jj = np.asarray([x[1] for x in items])
+            # value carried per write: 1 for appends, 0 for deletion
+            # retractions (DirectionPacker.remove_rule)
+            vv8 = jnp.asarray(
+                np.asarray([x[2] for x in items], np.int8)
+            )
             if name in transposed:
                 field = transposed[name]
                 mat = getattr(tables, field)
-                reps[field] = mat.at[jj, ii].set(jnp.int8(1))
+                reps[field] = mat.at[jj, ii].set(vv8)
             elif name in direct:
                 field = direct[name]
                 mat = getattr(tables, field)
-                reps[field] = mat.at[ii, jj].set(jnp.int8(1))
+                reps[field] = mat.at[ii, jj].set(vv8)
             elif name == "group_no_peers":
-                reps["group_no_peers"] = tables.group_no_peers.at[ii].set(True)
+                reps["group_no_peers"] = tables.group_no_peers.at[ii].set(
+                    jnp.asarray(np.asarray([x[2] for x in items], bool))
+                )
             elif name == "port_vocab":
                 # (pid, port, proto): jj = port, third = proto
                 vv = np.asarray([x[2] for x in items])
@@ -366,6 +381,38 @@ class PolicyEngine:
             ),
         )
         self._log_delta("rules", (tuple(rules),))
+        return True
+
+    def _apply_rule_delete(self, rules, revision: int) -> bool:
+        """Retract a deleted rule batch in place (the incremental
+        counterpart of repository.go DeleteByLabels:286): refcounted
+        matrix cells drop to zero and are scattered to the device as
+        value-0 writes — no recompile, no re-upload. False → full
+        rebuild needed (a rule this compile never attributed)."""
+        c = self._compiled
+        state = self._state
+        assert c is not None and state is not None
+        ing, eg = state.ingress, state.egress
+        keys = [id(r) for r in rules]
+        # check attribution FIRST: a partial removal (ingress done,
+        # egress unknown) would leave the two directions inconsistent
+        if any(k not in ing.rule_cells or k not in eg.rule_cells for k in keys):
+            return False
+        for k in keys:
+            ing.remove_rule(k)
+            eg.remove_rule(k)
+        ing.refresh_entry_views()
+        eg.refresh_entry_views()
+        device = self._device
+        assert device is not None
+        self._device = DevicePolicy(
+            id_bits=device.id_bits,
+            sel_match=device.sel_match,
+            ingress=self._patch_tables(device.ingress, ing.take_writes()),
+            egress=self._patch_tables(device.egress, eg.take_writes()),
+        )
+        c.revision = revision
+        self._log_delta("rules", ())
         return True
 
     def _set_row_index(self, ident_id: int, row: int) -> None:

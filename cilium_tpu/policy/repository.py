@@ -162,7 +162,10 @@ class Repository:
             self.rules = kept
             if deleted:
                 self._bump()
-                self._log_op("delete", (labels,))
+                # payload carries the removed Rule objects themselves:
+                # incremental compilers retract exactly these (their
+                # cell attribution is keyed by object identity)
+                self._log_op("delete", (labels, tuple(deleted)))
             return self._revision, deleted
 
     def translate_rules(self, translator) -> Tuple[int, int]:

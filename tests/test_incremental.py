@@ -181,7 +181,9 @@ class TestRuleDeltas:
         assert "rules" in _kinds(engine)[1:]
         _assert_parity(engine, repo, reg, idents)
 
-    def test_delete_forces_full_rebuild(self):
+    def test_delete_is_incremental(self):
+        """Deleting a rule retracts its matrix cells in place — no full
+        rebuild (repository.go DeleteByLabels:286 deletes in place)."""
         repo, reg, idents = _world(5)
         engine = PolicyEngine(repo, reg)
         engine.refresh()
@@ -195,8 +197,112 @@ class TestRuleDeltas:
         rev, n = repo.delete_by_labels(parse_label_array(["k8s:policy=temp"]))
         assert n == 1
         engine.refresh()
-        assert _kinds(engine)[-1] == "full"
+        kinds = _kinds(engine)
+        assert kinds[-1] == "rules" and "full" not in kinds[1:]
         _assert_parity(engine, repo, reg, idents)
+
+    def test_delete_shared_cells_survive(self):
+        """Two rules contributing the SAME (subj, peer) allow cell:
+        deleting one must keep the verdict allowed (refcount, not
+        clear)."""
+        repo = Repository()
+        reg = IdentityRegistry()
+        mk = lambda lbl: rule(
+            ["k8s:app=web"],
+            ingress=[IngressRule(
+                from_endpoints=(EndpointSelector.make(["k8s:app=lb"]),)
+            )],
+            labels=[lbl],
+        )
+        repo.add_list([mk("k8s:policy=p1"), mk("k8s:policy=p2")])
+        web = reg.allocate(parse_label_array(["k8s:app=web"]))
+        lb = reg.allocate(parse_label_array(["k8s:app=lb"]))
+        engine = PolicyEngine(repo, reg)
+        engine.refresh()
+        assert engine.verdict_one(web.id, lb.id, l4=False)[0] == 1
+        repo.delete_by_labels(parse_label_array(["k8s:policy=p1"]))
+        engine.refresh()
+        assert "full" not in _kinds(engine)[1:]
+        assert engine.verdict_one(web.id, lb.id, l4=False)[0] == 1, (
+            "shared allow cell cleared by refcounted delete"
+        )
+        repo.delete_by_labels(parse_label_array(["k8s:policy=p2"]))
+        engine.refresh()
+        assert engine.verdict_one(web.id, lb.id, l4=False)[0] != 1
+        _assert_parity(engine, repo, reg, [web, lb])
+
+    def test_delete_l4_and_l7_rule(self):
+        """Deleting an L4+L7 rule retracts combos, groups, and L7
+        presence; remaining rules keep their verdicts."""
+        from cilium_tpu.policy.api import HTTPRule, L7Rules
+
+        repo = Repository()
+        reg = IdentityRegistry()
+        keep = rule(
+            ["k8s:app=web"],
+            ingress=[IngressRule(
+                from_endpoints=(EndpointSelector.make(["k8s:app=lb"]),),
+                to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),)),),
+            )],
+            labels=["k8s:policy=keep"],
+        )
+        temp = rule(
+            ["k8s:app=web"],
+            ingress=[IngressRule(
+                from_endpoints=(EndpointSelector.make(["k8s:app=cli"]),),
+                to_ports=(PortRule(
+                    ports=(PortProtocol(8080, "TCP"),),
+                    rules=L7Rules(http=(HTTPRule(path="/api/.*"),)),
+                ),),
+            )],
+            labels=["k8s:policy=temp"],
+        )
+        repo.add_list([keep, temp])
+        web = reg.allocate(parse_label_array(["k8s:app=web"]))
+        lb = reg.allocate(parse_label_array(["k8s:app=lb"]))
+        cli = reg.allocate(parse_label_array(["k8s:app=cli"]))
+        engine = PolicyEngine(repo, reg)
+        engine.refresh()
+        assert engine.verdict_one(web.id, cli.id, 8080)[0] == 1
+        repo.delete_by_labels(parse_label_array(["k8s:policy=temp"]))
+        engine.refresh()
+        assert "full" not in _kinds(engine)[1:]
+        assert engine.verdict_one(web.id, cli.id, 8080)[0] != 1
+        assert engine.verdict_one(web.id, lb.id, 80)[0] == 1
+        _assert_parity(engine, repo, reg, [web, lb, cli])
+
+    @pytest.mark.parametrize("seed", [11, 12])
+    def test_random_delete_sequences_parity(self, seed):
+        """Interleaved adds + deletes through the incremental path stay
+        bit-identical to a fresh compile."""
+        rng = random.Random(seed)
+        repo, reg, idents = _world(seed)
+        engine = PolicyEngine(repo, reg)
+        engine.refresh()
+        for step in range(6):
+            if rng.random() < 0.5:
+                lbl = f"k8s:policy=step{step}"
+                r = rule(
+                    [f"k8s:app=a{rng.randrange(10)}"],
+                    ingress=[IngressRule(
+                        from_endpoints=(
+                            EndpointSelector.make([f"k8s:app=a{rng.randrange(10)}"]),
+                        ),
+                        to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),)),)
+                        if rng.random() < 0.5 else (),
+                    )],
+                    labels=[lbl],
+                )
+                repo.add_list([r])
+            else:
+                # delete one random earlier step's rule (may be a no-op)
+                lbl = f"k8s:policy=step{rng.randrange(step + 1)}"
+                repo.delete_by_labels(parse_label_array([lbl]))
+            engine.refresh()
+            _assert_parity(engine, repo, reg, idents, seed + step)
+        assert "full" not in _kinds(engine)[1:], (
+            "adds+deletes should all take the incremental path"
+        )
 
     def test_mixed_identity_and_rule_deltas(self):
         repo, reg, idents = _world(6)
