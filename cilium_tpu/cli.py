@@ -197,6 +197,9 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--pod-cidr", default="10.200.0.0/16")
     d.add_argument("--sync-interval", type=float, default=1.0,
                    help="cluster pump interval in seconds")
+    d.add_argument("--launch-proxy", action="store_true",
+                   help="spawn + supervise the external L7 proxy "
+                        "process (python -m cilium_tpu.proxy)")
 
     # status / metrics
     sub.add_parser("status", help="agent status")
@@ -359,6 +362,20 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         xds = XDSServer(daemon.xds_cache, args.socket + ".xds")
         xds.start()
+        accesslog_rx = None
+        proxy_launcher = None
+        if args.launch_proxy:
+            # external proxy: accesslog receiver + supervised child
+            # (pkg/envoy/envoy.go:76-143 + pkg/launcher)
+            from .proxy.accesslog import AccessLogSocketServer
+            from .proxy.launcher import ProxyLauncher
+
+            accesslog_rx = AccessLogSocketServer(
+                daemon.proxy.accesslog, args.socket + ".accesslog"
+            ).start()
+            proxy_launcher = ProxyLauncher(
+                args.socket + ".xds", args.socket + ".accesslog"
+            ).start()
         daemon.fqdn_start()  # ToFQDNs DNS poll loop (daemon/main.go:808)
         if daemon.health.nodes is not None:
             # node prober (daemon/main.go:927-945) — only meaningful
@@ -372,6 +389,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         try:
             server.serve_forever()
         except KeyboardInterrupt:
+            if proxy_launcher is not None:
+                proxy_launcher.stop()
+            if accesslog_rx is not None:
+                accesslog_rx.stop()
             xds.stop()
             monitor.stop()
             server.stop()

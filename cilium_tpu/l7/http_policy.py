@@ -27,6 +27,11 @@ from ..policy.api import HTTPRule
 from .regex_compile import MultiDFA, RegexError, compile_patterns
 
 
+class NativeL7Unsupported(ValueError):
+    """This policy needs host-side evaluation (demoted regex / header
+    matchers) and must not be offloaded to the native enforcer."""
+
+
 @dataclasses.dataclass(frozen=True)
 class HTTPRequest:
     method: str
@@ -224,6 +229,57 @@ class HTTPPolicy:
 
     def check(self, request: HTTPRequest) -> bool:
         return bool(self.check_batch([request])[0])
+
+    def native_tables(self):
+        """Export the compiled state for the native (C++) enforcer:
+        → (method_dfa, path_dfa, host_dfa, rules) where each dfa is a
+        MultiDFA or None and rules are (m_bit, p_bit, h_bit, idents)
+        tuples — bit = the pattern's accept-bit slot in that field's
+        DFA, -1 = wildcard. Raises NativeL7Unsupported when any rule
+        depends on host-only evaluation (a pattern demoted from the
+        DFA, or header matchers) — those policies must stay on the
+        Python path, loudly."""
+        def bit_of(ps: _PatternSet, pid: int) -> int:
+            if pid < 0:
+                return -1
+            if pid in ps.host_pids:
+                raise NativeL7Unsupported(
+                    f"pattern {ps.patterns[pid]!r} is host-demoted"
+                )
+            return ps.dfa_pids.index(pid)
+
+        rules = []
+        for cr in self._rules:
+            if cr.rule.headers:
+                raise NativeL7Unsupported("header matchers are host-only")
+            rules.append((
+                bit_of(self._methods, cr.method_pid),
+                bit_of(self._paths, cr.path_pid),
+                bit_of(self._hosts, cr.host_pid),
+                cr.allowed_identities,
+            ))
+        return (
+            self._methods.dfa, self._paths.dfa, self._hosts.dfa, rules
+        )
+
+    @classmethod
+    def from_model(cls, rules: List[Dict]) -> "HTTPPolicy":
+        """Rebuild a policy from the rules_model() JSON an NPDS
+        subscriber received — the external proxy's deserialization
+        side (the C++ filter parses the NetworkPolicy proto the same
+        way, envoy/cilium_network_policy.cc)."""
+        pairs = []
+        for d in rules:
+            pairs.append((
+                HTTPRule(
+                    method=d.get("method", ""),
+                    path=d.get("path", ""),
+                    host=d.get("host", ""),
+                    headers=tuple(d.get("headers", ())),
+                ),
+                set(d["remote_policies"]) if "remote_policies" in d else None,
+            ))
+        return cls(pairs)
 
     def rules_model(self) -> List[Dict]:
         """JSON-able view of the compiled rules — the NPDS

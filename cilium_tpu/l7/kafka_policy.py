@@ -118,6 +118,25 @@ class KafkaACL:
                     )
         return ok.any(axis=1)
 
+    @classmethod
+    def from_model(cls, rules: List[Dict]) -> "KafkaACL":
+        """Rebuild an ACL from the rules_model() JSON an NPDS
+        subscriber received (the external proxy's deserialization
+        side)."""
+        pairs = []
+        for d in rules:
+            pairs.append((
+                KafkaRule(
+                    role=d.get("role", ""),
+                    api_key=d.get("api_key", ""),
+                    api_version=d.get("api_version", ""),
+                    client_id=d.get("client_id", ""),
+                    topic=d.get("topic", ""),
+                ),
+                set(d["remote_policies"]) if "remote_policies" in d else None,
+            ))
+        return cls(pairs)
+
     def rules_model(self) -> List[Dict]:
         """JSON-able view of the rules + their identity scopes (the
         NPDS kafka_rules shape, mirroring HTTPPolicy.rules_model)."""

@@ -84,6 +84,10 @@ class ParsedRequest:
     topics: Tuple[str, ...]
     partitions: Dict[str, Tuple[int, ...]]
     raw: bytes
+    # False only for Produce with acks=0: the client expects NO
+    # response frame (pkg/kafka/request.go tracks the same bit so the
+    # proxy neither waits on the broker nor synthesizes a reject)
+    expect_response: bool = True
 
 
 def _parse_topic_partitions(r: _Reader, with_partition_body) -> Dict[str, Tuple[int, ...]]:
@@ -124,10 +128,12 @@ def parse_request(data: bytes) -> ParsedRequest:
     client_id = r.string() or ""
     topics: Dict[str, Tuple[int, ...]] = {}
     try:
+        expect_response = True
         if api_key == API_PRODUCE:
             if api_version >= 3:
                 r.string()  # transactional_id
-            r.i16()  # acks
+            acks = r.i16()
+            expect_response = acks != 0
             r.i32()  # timeout
             # partition body: message set size + bytes
             topics = _parse_topic_partitions(
@@ -190,6 +196,7 @@ def parse_request(data: bytes) -> ParsedRequest:
         topics=tuple(topics),
         partitions=topics,
         raw=bytes(data[:4 + size]),
+        expect_response=expect_response,
     )
 
 

@@ -1,0 +1,110 @@
+"""Subprocess supervision for the external proxy.
+
+Reference: pkg/launcher (the generic restarting subprocess supervisor
+the agent uses for cilium-node-monitor and cilium-envoy) and
+pkg/envoy/envoy.go:121-143 (the restart loop: if the child exits while
+the agent is running, relaunch it after a pause)."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("launcher")
+
+
+class ProxyLauncher:
+    """Spawn ``python -m cilium_tpu.proxy`` and keep it alive."""
+
+    def __init__(
+        self,
+        xds_socket: str,
+        accesslog_socket: Optional[str] = None,
+        extra_args: Optional[List[str]] = None,
+        restart_backoff_s: float = 0.5,
+        max_backoff_s: float = 30.0,
+    ) -> None:
+        self.argv = [sys.executable, "-m", "cilium_tpu.proxy", "--xds", xds_socket]
+        if accesslog_socket:
+            self.argv += ["--accesslog", accesslog_socket]
+        self.argv += list(extra_args or ())
+        self.restart_backoff_s = restart_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._proc: Optional[subprocess.Popen] = None
+        self._thread: Optional[threading.Thread] = None
+        self.restarts = 0
+
+    def start(self) -> "ProxyLauncher":
+        self._thread = threading.Thread(target=self._supervise, daemon=True)
+        self._thread.start()
+        return self
+
+    def _spawn(self) -> subprocess.Popen:
+        return subprocess.Popen(
+            self.argv,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    def _supervise(self) -> None:
+        backoff = self.restart_backoff_s
+        first = True
+        while not self._stop.is_set():
+            with self._lock:
+                self._proc = self._spawn()
+                proc = self._proc
+            if self._stop.is_set():
+                # stop() raced the spawn: it saw the PREVIOUS (dead)
+                # proc under the lock, so this fresh child is ours to
+                # reap or it leaks holding the sockets
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                return
+            if not first:
+                self.restarts += 1
+            first = False
+            while not self._stop.is_set():
+                try:
+                    proc.wait(timeout=0.2)
+                    break
+                except subprocess.TimeoutExpired:
+                    continue
+            if self._stop.is_set():
+                return
+            rc = proc.returncode
+            log.warning(
+                "external proxy exited; restarting",
+                fields={"rc": rc, "backoff_s": backoff},
+            )
+            # interruptible sleep: a stop during backoff must not spawn
+            if self._stop.wait(backoff):
+                return
+            backoff = min(backoff * 2, self.max_backoff_s)
+
+    def pid(self) -> Optional[int]:
+        with self._lock:
+            return self._proc.pid if self._proc and self._proc.poll() is None else None
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        with self._lock:
+            proc = self._proc
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=timeout)
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)

@@ -1,0 +1,525 @@
+"""Out-of-process L7 proxy: NPDS/NPHDS subscriber + wire enforcement.
+
+The role of the external cilium-envoy process (pkg/envoy/envoy.go:76-143
+bootstrap/lifecycle): a SEPARATE process that
+
+- subscribes NPDS (per-endpoint L7 policy) and NPHDS (identity → host
+  addresses) from the agent's xDS socket (xds/client.py — the
+  subscription side of envoy/cilium_network_policy.cc and
+  envoy/cilium_host_map.cc),
+- listens on every redirect's proxy port, parses HTTP/1.1 request
+  heads or Kafka request frames off real TCP connections, resolves the
+  peer's identity from the NPHDS map (the cilium_host_map.cc role;
+  the reference's bpf_metadata recovers it from the proxymap), and
+  enforces the per-port rules: 403 / Kafka reject on deny, forward to
+  the upstream (or synthesize a 200 when terminating) on allow
+  (envoy/cilium_l7policy.cc AccessFilter::decodeHeaders),
+- streams one access-log record per request back to the agent over the
+  accesslog unix socket (envoy/accesslog.cc → accesslog_server.go:50).
+
+Run as ``python -m cilium_tpu.proxy --xds <sock> --accesslog <sock>``;
+the agent supervises it with proxy/launcher.py (pkg/launcher restart
+semantics).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..l7.http_policy import HTTPPolicy, HTTPRequest
+from ..l7.kafka_policy import KafkaACL, KafkaRequest
+from ..utils.logging import get_logger
+from ..xds.cache import NETWORK_POLICY_HOSTS_TYPE, NETWORK_POLICY_TYPE
+from ..xds.client import XDSClient
+from ..xds.server import _send_msg
+
+log = get_logger("proxy-standalone")
+
+ID_WORLD = 2
+
+
+class NPHDSMap:
+    """identity ← longest-prefix-match over the NPHDS host addresses
+    (the in-proxy mirror of envoy/cilium_host_map.cc)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # prefixlen-descending list of (network, identity)
+        self._nets: List[Tuple[ipaddress._BaseNetwork, int]] = []
+
+    def replace(self, resources: Dict[str, dict]) -> None:
+        nets = []
+        for _name, res in resources.items():
+            ident = int(res.get("policy", 0))
+            for prefix in res.get("host_addresses", ()):
+                try:
+                    nets.append((ipaddress.ip_network(prefix, strict=False), ident))
+                except ValueError:
+                    continue
+        nets.sort(key=lambda t: t[0].prefixlen, reverse=True)
+        with self._lock:
+            self._nets = nets
+
+    def identity_of(self, addr: str) -> int:
+        try:
+            ip = ipaddress.ip_address(addr)
+        except ValueError:
+            return ID_WORLD
+        with self._lock:
+            for net, ident in self._nets:
+                if ip.version == net.version and ip in net:
+                    return ident
+        return ID_WORLD
+
+
+class _PortPolicy:
+    """Enforcement state for one redirect (one proxy port)."""
+
+    def __init__(self, entry: dict) -> None:
+        self.endpoint_id = int(entry.get("endpoint_id", 0))
+        self.port = int(entry["port"])
+        self.ingress = bool(entry.get("ingress", True))
+        self.parser = entry.get("parser", "http")
+        self.proxy_port = int(entry["proxy_port"])
+        self.http: Optional[HTTPPolicy] = (
+            HTTPPolicy.from_model(entry["http_rules"])
+            if "http_rules" in entry
+            else None
+        )
+        self.kafka: Optional[KafkaACL] = (
+            KafkaACL.from_model(entry["kafka_rules"])
+            if "kafka_rules" in entry
+            else None
+        )
+
+
+def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _read_http_head(conn: socket.socket, limit: int = 65536) -> Optional[bytes]:
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        if len(buf) > limit:
+            return None
+        chunk = conn.recv(4096)
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class StandaloneProxy:
+    """One process-wide proxy: listeners keyed by proxy port, policies
+    swapped atomically on every NPDS push."""
+
+    def __init__(
+        self,
+        xds_socket: str,
+        accesslog_socket: Optional[str] = None,
+        node: str = "external-proxy",
+        listen_host: str = "127.0.0.1",
+        upstream: Optional[Tuple[str, int]] = None,
+    ) -> None:
+        self.listen_host = listen_host
+        self.upstream = upstream
+        self.hosts = NPHDSMap()
+        self._lock = threading.Lock()
+        self._policies: Dict[int, _PortPolicy] = {}  # proxy_port → policy
+        self._listeners: Dict[int, socket.socket] = {}
+        self._stop = threading.Event()
+        self._accesslog_path = accesslog_socket
+        self._accesslog_sock: Optional[socket.socket] = None
+        self._al_lock = threading.Lock()
+        self.client = XDSClient(xds_socket, node)
+        self.client.subscribe(NETWORK_POLICY_TYPE, self._on_npds)
+        self.client.subscribe(NETWORK_POLICY_HOSTS_TYPE, self._on_nphds)
+
+    # -- subscriptions --------------------------------------------------
+    def _on_nphds(self, version: int, resources: Dict[str, dict]) -> None:
+        self.hosts.replace(resources)
+
+    def _on_npds(self, version: int, resources: Dict[str, dict]) -> None:
+        desired: Dict[int, _PortPolicy] = {}
+        for name, res in resources.items():
+            for entry in res.get("l7_ports", ()):
+                e = dict(entry)
+                e["endpoint_id"] = res.get("endpoint_id", name)
+                pp = _PortPolicy(e)
+                desired[pp.proxy_port] = pp
+        with self._lock:
+            self._policies = desired
+            live = set(self._listeners)
+        for port in set(desired) - live:
+            self._start_listener(port)
+        for port in live - set(desired):
+            self._stop_listener(port)
+
+    # -- listeners ------------------------------------------------------
+    def _start_listener(self, port: int) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            srv.bind((self.listen_host, port))
+        except OSError as e:
+            log.warning("proxy port bind failed", fields={"port": port, "err": str(e)})
+            srv.close()
+            return
+        srv.listen(64)
+        srv.settimeout(0.2)
+        with self._lock:
+            self._listeners[port] = srv
+        threading.Thread(
+            target=self._accept_loop, args=(srv, port), daemon=True
+        ).start()
+
+    def _stop_listener(self, port: int) -> None:
+        with self._lock:
+            srv = self._listeners.pop(port, None)
+        if srv is not None:
+            try:
+                srv.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self, srv: socket.socket, port: int) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, peer = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn, peer, port), daemon=True
+            ).start()
+
+    # -- enforcement ----------------------------------------------------
+    def _policy(self, port: int) -> Optional[_PortPolicy]:
+        with self._lock:
+            return self._policies.get(port)
+
+    def _serve_conn(self, conn: socket.socket, peer, port: int) -> None:
+        try:
+            pol = self._policy(port)
+            if pol is None:
+                return
+            src_identity = self.hosts.identity_of(peer[0])
+            if pol.parser == "kafka":
+                self._serve_kafka(conn, pol, src_identity)
+            else:
+                self._serve_http(conn, pol, src_identity)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_http(
+        self, conn: socket.socket, pol: _PortPolicy, src_identity: int
+    ) -> None:
+        head = _read_http_head(conn)
+        if head is None:
+            return
+        try:
+            head_text, _, body_rest = head.partition(b"\r\n\r\n")
+            lines = head_text.decode("latin1").split("\r\n")
+            method, path, _version = lines[0].split(" ", 2)
+            headers: List[Tuple[str, str]] = []
+            host = ""
+            for ln in lines[1:]:
+                if not ln:
+                    continue
+                name, _, value = ln.partition(":")
+                headers.append((name.strip(), value.strip()))
+                if name.strip().lower() == "host":
+                    host = value.strip()
+        except (ValueError, IndexError):
+            conn.sendall(b"HTTP/1.1 400 Bad Request\r\ncontent-length: 0\r\n\r\n")
+            return
+        req = HTTPRequest(
+            method=method, path=path, host=host,
+            headers=tuple(headers), src_identity=src_identity,
+        )
+        hdr_map = {k.lower(): v for k, v in headers}
+        if "chunked" in hdr_map.get("transfer-encoding", "").lower():
+            conn.sendall(
+                b"HTTP/1.1 501 Not Implemented\r\ncontent-length: 0\r\n\r\n"
+            )
+            return
+        try:
+            content_length = int(hdr_map.get("content-length", "0"))
+        except ValueError:
+            content_length = 0
+        # body bytes not yet read off the client socket when the head
+        # completed — the forward path must drain + relay them
+        body_pending = max(0, content_length - len(body_rest))
+        allowed = pol.http is None or bool(pol.http.check(req))
+        code = 200 if allowed else 403
+        if allowed:
+            if self.upstream is not None:
+                code = self._forward_http(conn, head, body_pending, pol)
+            else:
+                body = b"OK\n"
+                conn.sendall(
+                    b"HTTP/1.1 200 OK\r\ncontent-length: "
+                    + str(len(body)).encode() + b"\r\n\r\n" + body
+                )
+        else:
+            body = b"Access denied\r\n"
+            conn.sendall(
+                b"HTTP/1.1 403 Forbidden\r\ncontent-length: "
+                + str(len(body)).encode() + b"\r\n\r\n" + body
+            )
+        self._log_record({
+            "type": "Request",
+            "verdict": "Forwarded" if allowed else "Denied",
+            "timestamp": time.time(),
+            "src_identity": src_identity,
+            "dst_port": pol.port,
+            "proto": "http",
+            "http": {"method": method, "path": path, "host": host, "code": code},
+        })
+
+    def _forward_http(
+        self, conn: socket.socket, head: bytes, body_pending: int,
+        pol: _PortPolicy,
+    ) -> int:
+        """Relay the buffered request (plus any request body still in
+        flight from the client) to the upstream, stream the reply
+        back. Returns the upstream status code (best effort)."""
+        assert self.upstream is not None
+        code = 502
+        try:
+            up = socket.create_connection(self.upstream, timeout=5.0)
+        except OSError:
+            conn.sendall(b"HTTP/1.1 502 Bad Gateway\r\ncontent-length: 0\r\n\r\n")
+            return code
+        try:
+            up.sendall(head)
+            conn.settimeout(5.0)
+            while body_pending > 0:
+                chunk = conn.recv(min(65536, body_pending))
+                if not chunk:
+                    break
+                up.sendall(chunk)
+                body_pending -= len(chunk)
+            up.settimeout(5.0)
+            first = True
+            while True:
+                try:
+                    chunk = up.recv(65536)
+                except socket.timeout:
+                    break
+                if not chunk:
+                    break
+                if first:
+                    try:
+                        code = int(chunk.split(b" ", 2)[1])
+                    except (ValueError, IndexError):
+                        pass
+                    first = False
+                conn.sendall(chunk)
+        finally:
+            up.close()
+        return code
+
+    def _serve_kafka(
+        self, conn: socket.socket, pol: _PortPolicy, src_identity: int
+    ) -> None:
+        """Transparent Kafka request/response proxy with per-request
+        ACL (pkg/proxy/kafka.go handleRequest): denied requests get a
+        synthesized reject frame, allowed ones are forwarded upstream
+        (when configured) and the broker reply relayed back."""
+        from ..l7.kafka_wire import (
+            KafkaParseError,
+            parse_request,
+            reject_response,
+        )
+
+        up: Optional[socket.socket] = None
+        try:
+            while not self._stop.is_set():
+                hdr = _recv_exact(conn, 4)
+                if hdr is None:
+                    return
+                (size,) = struct.unpack(">i", hdr)
+                if size <= 0 or size > (64 << 20):
+                    return
+                body = _recv_exact(conn, size)
+                if body is None:
+                    return
+                try:
+                    parsed = parse_request(hdr + body)
+                except KafkaParseError:
+                    return
+                reqs = [
+                    KafkaRequest(
+                        api_key=parsed.api_key,
+                        api_version=parsed.api_version,
+                        client_id=parsed.client_id,
+                        topic=t,
+                        src_identity=src_identity,
+                    )
+                    for t in (parsed.topics or ("",))
+                ]
+                allows = (
+                    pol.kafka.check_batch(reqs)
+                    if pol.kafka is not None
+                    else [True] * len(reqs)
+                )
+                allowed = all(bool(a) for a in allows)
+                self._log_record({
+                    "type": "Request",
+                    "verdict": "Forwarded" if allowed else "Denied",
+                    "timestamp": time.time(),
+                    "src_identity": src_identity,
+                    "dst_port": pol.port,
+                    "proto": "kafka",
+                    "kafka": {
+                        "api_key": parsed.api_key,
+                        "topic": parsed.topics[0] if parsed.topics else "",
+                        "error_code": 0 if allowed else 29,
+                    },
+                })
+                if not allowed:
+                    # Produce acks=0 clients expect NO frame — a
+                    # synthesized reject would desync their correlation
+                    # matching (pkg/kafka handles acks=0 the same way)
+                    if parsed.expect_response:
+                        conn.sendall(reject_response(parsed))
+                    continue
+                if self.upstream is None:
+                    # terminating mode: ack with an empty-body frame so
+                    # the client unblocks (when it expects one)
+                    if parsed.expect_response:
+                        conn.sendall(
+                            struct.pack(">ii", 4, parsed.correlation_id)
+                        )
+                    continue
+                if up is None:
+                    up = socket.create_connection(self.upstream, timeout=5.0)
+                up.sendall(parsed.raw)
+                if not parsed.expect_response:
+                    continue  # acks=0: fire-and-forget upstream
+                rhdr = _recv_exact(up, 4)
+                if rhdr is None:
+                    return
+                (rsize,) = struct.unpack(">i", rhdr)
+                rbody = _recv_exact(up, rsize)
+                if rbody is None:
+                    return
+                conn.sendall(rhdr + rbody)
+        finally:
+            if up is not None:
+                try:
+                    up.close()
+                except OSError:
+                    pass
+
+    # -- access log streaming ------------------------------------------
+    def _log_record(self, record: dict) -> None:
+        if self._accesslog_path is None:
+            return
+        with self._al_lock:
+            for _attempt in (0, 1):
+                if self._accesslog_sock is None:
+                    try:
+                        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                        s.connect(self._accesslog_path)
+                        self._accesslog_sock = s
+                    except OSError:
+                        self._accesslog_sock = None
+                        return
+                try:
+                    _send_msg(self._accesslog_sock, record)
+                    return
+                except OSError:
+                    try:
+                        self._accesslog_sock.close()
+                    except OSError:
+                        pass
+                    self._accesslog_sock = None  # reconnect once
+
+    # -- lifecycle ------------------------------------------------------
+    def wait_ready(self, timeout: float = 5.0) -> bool:
+        """Block until the first NPDS version is applied and every
+        advertised proxy port has a bound listener."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                have = set(self._listeners)
+                want = set(self._policies)
+            if self.client.applied.get(NETWORK_POLICY_TYPE, -1) >= 0 and want <= have:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def ports(self) -> List[int]:
+        with self._lock:
+            return sorted(self._listeners)
+
+    def close(self) -> None:
+        self._stop.set()
+        self.client.close()
+        with self._lock:
+            listeners = list(self._listeners.values())
+            self._listeners.clear()
+        for srv in listeners:
+            try:
+                srv.close()
+            except OSError:
+                pass
+        with self._al_lock:
+            if self._accesslog_sock is not None:
+                try:
+                    self._accesslog_sock.close()
+                except OSError:
+                    pass
+                self._accesslog_sock = None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(
+        prog="python -m cilium_tpu.proxy",
+        description="standalone L7 proxy (NPDS/NPHDS subscriber)",
+    )
+    ap.add_argument("--xds", required=True, help="agent xDS unix socket")
+    ap.add_argument("--accesslog", default=None, help="agent accesslog unix socket")
+    ap.add_argument("--node", default="external-proxy")
+    ap.add_argument("--listen-host", default="127.0.0.1")
+    ap.add_argument("--upstream", default=None, help="host:port to forward allowed traffic to")
+    args = ap.parse_args(argv)
+    upstream = None
+    if args.upstream:
+        host, _, port = args.upstream.rpartition(":")
+        upstream = (host, int(port))
+    proxy = StandaloneProxy(
+        args.xds, args.accesslog, node=args.node,
+        listen_host=args.listen_host, upstream=upstream,
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    proxy.wait_ready()
+    print("READY", flush=True)
+    stop.wait()
+    proxy.close()
+    return 0

@@ -34,9 +34,10 @@ def _frame(body: bytes) -> bytes:
     return struct.pack(">i", len(body)) + body
 
 
-def produce_req(cid=7, client="cli", topics=(("orders", (0, 1)),), version=0):
+def produce_req(cid=7, client="cli", topics=(("orders", (0, 1)),), version=0,
+                acks=1):
     body = struct.pack(">hhi", API_PRODUCE, version, cid) + _s(client)
-    body += struct.pack(">hi", 1, 30000)  # acks, timeout
+    body += struct.pack(">hi", acks, 30000)  # acks, timeout
     body += struct.pack(">i", len(topics))
     for t, parts in topics:
         body += _s(t) + struct.pack(">i", len(parts))
@@ -112,6 +113,15 @@ class TestParse:
         body += struct.pack(">i", 2_000_000)
         with pytest.raises(KafkaParseError):
             parse_request(_frame(body))
+
+    def test_produce_acks0_expects_no_response(self):
+        """Produce acks=0 clients never read a response frame — the
+        proxy must know not to wait on the broker nor synthesize a
+        reject (pkg/kafka tracks the same bit)."""
+        assert parse_request(produce_req(acks=0)).expect_response is False
+        assert parse_request(produce_req(acks=1)).expect_response is True
+        assert parse_request(produce_req(acks=-1)).expect_response is True
+        assert parse_request(fetch_req()).expect_response is True
 
     def test_raw_is_exact_frame(self):
         data = produce_req()
