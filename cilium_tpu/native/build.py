@@ -95,8 +95,30 @@ def load() -> ctypes.CDLL:
     lib.nf_ct_flush.argtypes = [c.c_void_p]
     lib.nf_set_endpoint_ids.argtypes = [c.c_void_p, c.c_int64, u32p]
     lib.nf_load_lb.argtypes = [
-        c.c_void_p, c.c_int32, c.c_int, u32p, i32p, i32p, i32p, i32p,
-        i32p, c.c_int32, u32p, i32p,
+        c.c_void_p, c.c_int, c.c_int32, c.c_int, u8p, i32p, i32p, i32p,
+        i32p, i32p, c.c_int32, u8p, i32p,
+    ]
+    lib.nf_l7_set_http.argtypes = [
+        c.c_void_p, c.c_uint32, c.c_uint32, c.c_uint8,
+        i32p, u64p, c.c_int32, c.c_int32,  # method DFA
+        i32p, u64p, c.c_int32, c.c_int32,  # path DFA
+        i32p, u64p, c.c_int32, c.c_int32,  # host DFA
+        c.c_int32, i32p, i32p, i32p, u8p, i64p, u64p,
+    ]
+    lib.nf_l7_set_kafka.argtypes = [
+        c.c_void_p, c.c_uint32, c.c_uint32, c.c_uint8,
+        c.c_int32, u32p, u8p, i32p, i32p, i32p, u8p, i64p, u64p,
+        c.c_int32, u8p, i64p, c.c_int32, u8p, i64p,
+    ]
+    lib.nf_l7_http_batch.argtypes = [
+        c.c_void_p, c.c_uint32, c.c_uint32, c.c_uint8, c.c_int64,
+        u8p, c.c_int32, i32p, u8p, c.c_int32, i32p, u8p, c.c_int32, i32p,
+        u64p, u8p,
+    ]
+    lib.nf_l7_kafka_batch.argtypes = [
+        c.c_void_p, c.c_uint32, c.c_uint32, c.c_uint8, c.c_int64,
+        i32p, i32p, u8p, c.c_int32, i32p, u8p, c.c_int32, i32p,
+        u64p, u8p,
     ]
     lib.nf_eval_batch.argtypes = [
         c.c_void_p, c.c_int64, u8p, c.c_int, i32p, i32p, i32p, i32p,

@@ -1,33 +1,52 @@
 // Native batch enforcement front-end.
 //
-// The role of the reference's in-kernel eBPF datapath (SURVEY native
-// census item 1): consume the control plane's compiled state — the
-// TPU-materialized policymap rows, the ipcache/prefilter stride-8
-// tries — and enforce verdicts for flow batches at memory speed with
-// no interpreter in the loop. Mirrors the per-packet path of
-// bpf/bpf_lxc.c + bpf/lib/policy.h:
+// The role of the reference's in-kernel eBPF datapath plus its C++
+// Envoy filters (SURVEY native census items 1 and 3): consume the
+// control plane's compiled state — the TPU-materialized policymap
+// rows, the ipcache/prefilter stride-8 tries, the LB selection
+// sequences, and the L7 DFA/ACL tables — and enforce verdicts for
+// flow batches at memory speed with no interpreter in the loop.
+// Mirrors the per-packet path of bpf/bpf_lxc.c + bpf/lib/policy.h:
 //
-//   conntrack probe (one hash)            conntrack.h ct_lookup
+//   conntrack probe (fwd + reply tuple)   conntrack.h ct_lookup
+//   LB VIP->backend translate (egress)    lb.h lb4_local / lb6_local
 //   prefilter deny LPM (ingress only)     bpf_xdp.c check_filters
 //   identity LPM, world on miss           bpf_netdev.c secctx
 //   policymap: exact -> L3 -> L4          policy.h __policy_can_access
 //   CT create on allow (not on redirect)  ct_create4
 //
+// and the per-request path of envoy/cilium_l7policy.cc (HTTP DFA rule
+// match) + pkg/kafka/policy.go (Kafka ACL).
+//
 // Exposed as a C ABI consumed through ctypes (no pybind11 in the
-// image). All tables are copied in at load time; eval runs without
-// allocation or locks (one loader thread / N eval threads is the
-// supported pattern, same as pinned BPF maps: writers swap, readers
-// race-free on the snapshot they started with).
+// image).
+//
+// CONCURRENCY MODEL — one loader / N eval threads, for real:
+//   - All lookup tables (policy, tries, LB, L7) live in an immutable
+//     `Tables` snapshot held by shared_ptr. Loaders build a modified
+//     copy under the load mutex and swap the pointer; evals pin the
+//     snapshot they started with (read-only, race-free), exactly the
+//     pinned-BPF-map replace semantics.
+//   - Per-endpoint counters are relaxed atomics.
+//   - Conntrack is shared and mutable: slots use an acquire/release
+//     publish protocol on the key word (claim with a busy sentinel,
+//     write the payload, publish the key) with a re-validation read,
+//     so concurrent eval threads insert/refresh without locks.
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <ctime>
+#include <map>
+#include <memory>
+#include <mutex>
 #include <vector>
 
 namespace {
 
 constexpr int kProbes = 16;
 constexpr uint64_t kEmpty = ~0ull;
+constexpr uint64_t kBusy = ~1ull;
 
 inline uint64_t mix64(uint64_t x) {
   x ^= x >> 30; x *= 0xbf58476d1ce4e5b9ull;
@@ -36,13 +55,13 @@ inline uint64_t mix64(uint64_t x) {
   return x;
 }
 
-inline double now_s() {
+inline uint64_t now_ns() {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
-  return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+  return uint64_t(ts.tv_sec) * 1000000000ull + uint64_t(ts.tv_nsec);
 }
 
-// open-addressing (ka, kb) -> uint8 value table
+// open-addressing (ka, kb) -> uint8 value table (immutable post-build)
 struct HashTable {
   std::vector<uint64_t> ka, kb;
   std::vector<uint8_t> val;
@@ -86,7 +105,6 @@ struct Trie {
   int levels = 0;
   bool loaded = false;
 
-  // walk -> deepest non-zero info (value+1), 0 = miss
   inline int32_t lookup(const uint8_t* addr) const {
     int32_t node = 0, best = 0;
     for (int l = 0; l < levels; ++l) {
@@ -100,36 +118,55 @@ struct Trie {
   }
 };
 
-// conntrack: (ka, kb, kc) keys with expiry; same tuple packing as
-// datapath/conntrack.py so behavior is comparable
+// ── conntrack ────────────────────────────────────────────────────────
+// (ka, kb, kc) keys with expiry; same tuple packing as
+// datapath/conntrack.py. Shared-mutable: ka is the published atomic
+// key word; kb/kc/expires are valid only while ka holds the key
+// (seqlock-lite: readers re-validate ka after reading the payload).
 struct Conntrack {
-  std::vector<uint64_t> ka, kb, kc;
-  std::vector<double> expires;
+  std::unique_ptr<std::atomic<uint64_t>[]> ka;
+  std::vector<uint64_t> kb, kc;
+  std::unique_ptr<std::atomic<uint64_t>[]> expires;  // monotonic ns
   uint64_t mask = 0;
-  double tcp_life = 21600.0, other_life = 60.0;
+  uint64_t tcp_life_ns = 21600ull * 1000000000ull;
+  uint64_t other_life_ns = 60ull * 1000000000ull;
+  bool enabled = false;
 
   void init(int bits) {
     size_t cap = 1ull << bits;
-    ka.assign(cap, kEmpty);
+    ka = std::make_unique<std::atomic<uint64_t>[]>(cap);
+    expires = std::make_unique<std::atomic<uint64_t>[]>(cap);
+    for (size_t i = 0; i < cap; ++i) {
+      ka[i].store(kEmpty, std::memory_order_relaxed);
+      expires[i].store(0, std::memory_order_relaxed);
+    }
     kb.assign(cap, 0);
     kc.assign(cap, 0);
-    expires.assign(cap, 0.0);
     mask = cap - 1;
+    enabled = true;
   }
 
   inline uint64_t hash(uint64_t a, uint64_t b, uint64_t c) const {
     return mix64(a ^ mix64(b ^ mix64(c)));
   }
 
-  inline bool probe(uint64_t a, uint64_t b, uint64_t c, double now) {
+  inline uint64_t life_ns(uint64_t c) const {
+    return ((c >> 1) & 0xff) == 6 ? tcp_life_ns : other_life_ns;
+  }
+
+  inline bool probe(uint64_t a, uint64_t b, uint64_t c, uint64_t now) {
     uint64_t h = hash(a, b, c);
     for (int p = 0; p < kProbes; ++p) {
       uint64_t s = (h + p) & mask;
-      if (ka[s] == kEmpty) return false;
-      if (ka[s] == a && kb[s] == b && kc[s] == c && expires[s] > now) {
-        expires[s] = now + (((c >> 1) & 0xff) == 6 ? tcp_life : other_life);
-        return true;
-      }
+      uint64_t cur = ka[s].load(std::memory_order_acquire);
+      if (cur == kEmpty) return false;
+      if (cur != a) continue;
+      if (kb[s] != b || kc[s] != c) continue;
+      if (expires[s].load(std::memory_order_relaxed) <= now) continue;
+      // payload read under a possibly-concurrent rewrite: re-validate
+      if (ka[s].load(std::memory_order_acquire) != a) continue;
+      expires[s].store(now + life_ns(c), std::memory_order_relaxed);
+      return true;
     }
     return false;
   }
@@ -138,7 +175,7 @@ struct Conntrack {
   // inverted direction bit) — the same pair FlowConntrack.lookup_batch
   // probes via flip_kc, mirroring the kernel's forward/reverse tuple
   // pair (bpf/lib/conntrack.h ct_lookup)
-  inline bool probe_pair(uint64_t a, uint64_t b, uint64_t c, double now) {
+  inline bool probe_pair(uint64_t a, uint64_t b, uint64_t c, uint64_t now) {
     if (probe(a, b, c, now)) return true;
     uint64_t ep = c >> 41;
     uint64_t sport = (c >> 25) & 0xFFFF;
@@ -150,51 +187,199 @@ struct Conntrack {
     return probe(a, b, flipped, now);
   }
 
-  inline void insert(uint64_t a, uint64_t b, uint64_t c, double now) {
+  inline void insert(uint64_t a, uint64_t b, uint64_t c, uint64_t now) {
     uint64_t h = hash(a, b, c);
     for (int p = 0; p < kProbes; ++p) {
       uint64_t s = (h + p) & mask;
-      if (ka[s] == kEmpty || expires[s] <= now ||
-          (ka[s] == a && kb[s] == b && kc[s] == c)) {
-        ka[s] = a; kb[s] = b; kc[s] = c;
-        expires[s] = now + (((c >> 1) & 0xff) == 6 ? tcp_life : other_life);
-        return;
-      }
+      uint64_t cur = ka[s].load(std::memory_order_acquire);
+      if (cur == kBusy) continue;  // another writer owns the slot
+      bool reusable = cur == kEmpty ||
+                      expires[s].load(std::memory_order_relaxed) <= now ||
+                      (cur == a && kb[s] == b && kc[s] == c);
+      if (!reusable) continue;
+      if (!ka[s].compare_exchange_strong(cur, kBusy,
+                                         std::memory_order_acq_rel))
+        continue;  // lost the claim race; try the next slot
+      kb[s] = b;
+      kc[s] = c;
+      expires[s].store(now + life_ns(c), std::memory_order_relaxed);
+      ka[s].store(a, std::memory_order_release);
+      return;
     }
     // full neighborhood: drop (flow re-verdicts next packet)
   }
 
   void flush() {
-    std::fill(ka.begin(), ka.end(), kEmpty);
+    if (!enabled) return;
+    for (size_t i = 0; i <= mask; ++i)
+      ka[i].store(kEmpty, std::memory_order_release);
   }
 };
 
-// LB service tables (IPv4): mirrors lb/device.py LBTables — dense
-// frontend compare + per-service selection sequence + backend rows
-struct LBTables {
-  std::vector<uint32_t> fe_addr;   // [F] VIP (host order)
-  std::vector<int32_t> fe_port;    // [F] (-1 = empty slot)
+// ── LB tables ────────────────────────────────────────────────────────
+// byte-addressed so IPv4 (stride 4) and IPv6 (stride 16) share the
+// code path; mirrors lb/device.py LBTables / bpf/lib/lb.h:36-83
+struct LBT {
+  int stride = 4;
+  std::vector<uint8_t> fe_addr;    // [F * stride] VIP address bytes
+  std::vector<int32_t> fe_port;    // [F]
   std::vector<int32_t> fe_proto;   // [F] (0 = ANY)
   std::vector<int32_t> fe_seq;     // [F * seq_width]
   std::vector<int32_t> fe_seq_len; // [F]
   std::vector<int32_t> fe_revnat;  // [F]
-  std::vector<uint32_t> be_addr;   // [NB]
+  std::vector<uint8_t> be_addr;    // [NB * stride]
   std::vector<int32_t> be_port;    // [NB]
   int seq_width = 0;
+  size_t n_fe = 0;
   bool loaded = false;
 };
 
-struct Fastpath {
-  HashTable policy;     // ka = identity, kb = ep<<32|dport<<16|proto<<8|dir
-  Trie ip4, ip6;        // value = identity (not row: standalone table)
-  Trie deny4, deny6;    // prefilter
-  Conntrack ct;
-  LBTables lb;
-  bool ct_enabled = false;
+// ── L7 ───────────────────────────────────────────────────────────────
+// One multi-pattern DFA (l7/regex_compile.py MultiDFA): trans[Q][256],
+// accept[Q] u64 pattern mask. Q == 0 means the field is unused.
+struct DFA {
+  std::vector<int32_t> trans;
+  std::vector<uint64_t> accept;
+  int32_t start = 0;
+  int32_t q = 0;
+
+  inline uint64_t run(const uint8_t* s, int32_t len) const {
+    if (len < 0) return 0;  // overlong: fail closed (strings_to_batch)
+    int32_t state = start;
+    for (int32_t i = 0; i < len; ++i) {
+      state = trans[size_t(state) * 256 + s[i]];
+      if (!state) return 0;  // dead state
+    }
+    return accept[state];
+  }
+};
+
+// HTTP policy for one (endpoint, port, direction): the
+// envoy/cilium_network_policy.h:68-202 rule chain with the regex
+// matchers compiled to DFAs host-side.
+struct HTTPPolicyN {
+  DFA method, path, host;
+  std::vector<int32_t> m_bit, p_bit, h_bit;  // [R] accept-bit or -1
+  std::vector<uint8_t> scoped;               // [R] identity-scoped?
+  std::vector<int64_t> ident_off;            // [R+1]
+  std::vector<uint64_t> idents;              // sorted per rule
+  size_t n_rules = 0;
+
+  inline bool ident_ok(size_t r, uint64_t id) const {
+    if (!scoped[r]) return true;
+    const uint64_t* lo = idents.data() + ident_off[r];
+    const uint64_t* hi = idents.data() + ident_off[r + 1];
+    while (lo < hi) {  // binary search
+      const uint64_t* mid = lo + (hi - lo) / 2;
+      if (*mid == id) return true;
+      if (*mid < id) lo = mid + 1; else hi = mid;
+    }
+    return false;
+  }
+
+  inline bool check(uint64_t m_mask, uint64_t p_mask, uint64_t h_mask,
+                    uint64_t src_identity) const {
+    if (n_rules == 0) return true;  // no L7 rules: pure L4 redirect
+    for (size_t r = 0; r < n_rules; ++r) {
+      if (!ident_ok(r, src_identity)) continue;
+      if (m_bit[r] >= 0 && !((m_mask >> m_bit[r]) & 1)) continue;
+      if (p_bit[r] >= 0 && !((p_mask >> p_bit[r]) & 1)) continue;
+      if (h_bit[r] >= 0 && !((h_mask >> h_bit[r]) & 1)) continue;
+      return true;
+    }
+    return false;
+  }
+};
+
+// Kafka ACL for one (endpoint, port, direction): pkg/kafka/policy.go
+// MatchesRule as dense vectors + interned topic/client strings.
+struct KafkaACLN {
+  std::vector<uint32_t> key_mask;  // [R]
+  std::vector<uint8_t> key_wild;   // [R]
+  std::vector<int32_t> version;    // [R] (-1 wildcard)
+  std::vector<int32_t> topic_id;   // [R] (-1 wildcard)
+  std::vector<int32_t> client_id;  // [R] (-1 wildcard)
+  std::vector<uint8_t> scoped;     // [R]
+  std::vector<int64_t> ident_off;  // [R+1]
+  std::vector<uint64_t> idents;
+  std::vector<std::string> topics;   // interned topic strings
+  std::vector<std::string> clients;  // interned client ids
+  size_t n_rules = 0;
+
+  inline int32_t intern_of(const std::vector<std::string>& tbl,
+                           const uint8_t* s, int32_t len) const {
+    for (size_t i = 0; i < tbl.size(); ++i)
+      if (int32_t(tbl[i].size()) == len &&
+          std::memcmp(tbl[i].data(), s, size_t(len)) == 0)
+        return int32_t(i);
+    return -2;  // unknown string: matches only wildcard rules
+  }
+
+  inline bool ident_ok(size_t r, uint64_t id) const {
+    if (!scoped[r]) return true;
+    const uint64_t* lo = idents.data() + ident_off[r];
+    const uint64_t* hi = idents.data() + ident_off[r + 1];
+    while (lo < hi) {
+      const uint64_t* mid = lo + (hi - lo) / 2;
+      if (*mid == id) return true;
+      if (*mid < id) lo = mid + 1; else hi = mid;
+    }
+    return false;
+  }
+
+  inline bool check(int32_t api_key, int32_t api_version, int32_t tid,
+                    int32_t cid, uint64_t src_identity) const {
+    if (n_rules == 0) return true;
+    for (size_t r = 0; r < n_rules; ++r) {
+      if (!key_wild[r]) {
+        if (api_key < 0 || api_key >= 32) continue;
+        if (!((key_mask[r] >> api_key) & 1)) continue;
+      }
+      if (version[r] >= 0 && version[r] != api_version) continue;
+      if (topic_id[r] >= 0 && topic_id[r] != tid) continue;
+      if (client_id[r] >= 0 && client_id[r] != cid) continue;
+      if (!ident_ok(r, src_identity)) continue;
+      return true;
+    }
+    return false;
+  }
+};
+
+inline uint64_t l7_key(uint32_t ep, uint32_t port, uint32_t dir) {
+  return (uint64_t(ep) << 32) | (uint64_t(port) << 8) | dir;
+}
+
+// ── the immutable snapshot ───────────────────────────────────────────
+struct Tables {
+  HashTable policy;  // ka = identity, kb = ep<<32|dport<<16|proto<<8|dir
+  Trie ip4, ip6;     // value = identity (standalone table)
+  Trie deny4, deny6; // prefilter
+  LBT lb4, lb6;
   uint64_t world_identity = 2;
+  std::vector<uint32_t> ep_ids;  // stable endpoint ids (LB hash input)
+  std::map<uint64_t, HTTPPolicyN> http;   // (ep,port,dir) -> policy
+  std::map<uint64_t, KafkaACLN> kafka;
+};
+
+struct Fastpath {
+  std::shared_ptr<const Tables> tables;
+  std::mutex load_mu;   // serializes loaders (copy-mutate-swap)
+  Conntrack ct;
   uint32_t ep_count = 0;
-  std::vector<int64_t> counters;  // [ep][3] fwd/drop_policy/drop_prefilter
-  std::vector<uint32_t> ep_ids;   // [ep] stable endpoint ids (hash input)
+  // [ep][3] fwd / drop_policy / drop_other — relaxed atomics
+  std::unique_ptr<std::atomic<int64_t>[]> counters;
+
+  std::shared_ptr<const Tables> snap() const {
+    return std::atomic_load_explicit(&tables, std::memory_order_acquire);
+  }
+  void swap(std::shared_ptr<const Tables> t) {
+    std::atomic_store_explicit(&tables, std::move(t),
+                               std::memory_order_release);
+  }
+  // copy-on-write: clone the current snapshot for mutation
+  std::shared_ptr<Tables> clone() const {
+    return std::make_shared<Tables>(*snap());
+  }
 };
 
 // verdict codes — match datapath/pipeline.py
@@ -227,25 +412,43 @@ inline uint64_t policy_kb(uint32_t ep, uint32_t dport, uint32_t proto,
          (uint64_t(proto) << 8) | dir;
 }
 
+void load_dfa(DFA& d, const int32_t* trans, const uint64_t* accept,
+              int32_t q, int32_t start) {
+  d.q = q;
+  d.start = start;
+  if (q > 0) {
+    d.trans.assign(trans, trans + size_t(q) * 256);
+    d.accept.assign(accept, accept + q);
+  } else {
+    d.trans.clear();
+    d.accept.clear();
+  }
+}
+
 }  // namespace
 
 extern "C" {
 
 void* nf_create(uint32_t ep_count, int ct_bits) {
   auto* fp = new Fastpath();
+  fp->tables = std::make_shared<Tables>();
   fp->ep_count = ep_count;
-  fp->counters.assign(size_t(ep_count ? ep_count : 1) * 3, 0);
-  if (ct_bits > 0) {
-    fp->ct.init(ct_bits);
-    fp->ct_enabled = true;
-  }
+  size_t n = size_t(ep_count ? ep_count : 1) * 3;
+  fp->counters = std::make_unique<std::atomic<int64_t>[]>(n);
+  for (size_t i = 0; i < n; ++i)
+    fp->counters[i].store(0, std::memory_order_relaxed);
+  if (ct_bits > 0) fp->ct.init(ct_bits);
   return fp;
 }
 
 void nf_destroy(void* h) { delete static_cast<Fastpath*>(h); }
 
 void nf_set_world(void* h, uint64_t identity) {
-  static_cast<Fastpath*>(h)->world_identity = identity;
+  auto* fp = static_cast<Fastpath*>(h);
+  std::lock_guard<std::mutex> g(fp->load_mu);
+  auto t = fp->clone();
+  t->world_identity = identity;
+  fp->swap(std::move(t));
 }
 
 // entries: parallel arrays — identity u64, ep u32, dport u32, proto
@@ -255,13 +458,16 @@ int64_t nf_load_policy(void* h, int64_t n, const uint64_t* identity,
                        const uint32_t* proto, const uint32_t* dir,
                        const uint8_t* redirect) {
   auto* fp = static_cast<Fastpath*>(h);
-  fp->policy.init(size_t(n));
+  std::lock_guard<std::mutex> g(fp->load_mu);
+  auto t = fp->clone();
+  t->policy.init(size_t(n));
   int64_t loaded = 0;
   for (int64_t i = 0; i < n; ++i) {
-    loaded += fp->policy.insert(
+    loaded += t->policy.insert(
         identity[i], policy_kb(ep[i], dport[i], proto[i], dir[i]),
         redirect[i] ? 2 : 1);
   }
+  fp->swap(std::move(t));
   return loaded;
 }
 
@@ -269,108 +475,253 @@ int64_t nf_load_policy(void* h, int64_t n, const uint64_t* identity,
 void nf_load_trie(void* h, int which, const int32_t* child,
                   const int32_t* info, int32_t n_nodes, int levels) {
   auto* fp = static_cast<Fastpath*>(h);
-  Trie* t = which == 0 ? &fp->ip4 : which == 1 ? &fp->ip6
-            : which == 2 ? &fp->deny4 : &fp->deny6;
-  t->child.assign(child, child + size_t(n_nodes) * 256);
-  t->info.assign(info, info + size_t(n_nodes) * 256);
-  t->levels = levels;
-  t->loaded = true;
+  std::lock_guard<std::mutex> g(fp->load_mu);
+  auto t = fp->clone();
+  Trie* tr = which == 0 ? &t->ip4 : which == 1 ? &t->ip6
+             : which == 2 ? &t->deny4 : &t->deny6;
+  tr->child.assign(child, child + size_t(n_nodes) * 256);
+  tr->info.assign(info, info + size_t(n_nodes) * 256);
+  tr->levels = levels;
+  tr->loaded = true;
+  fp->swap(std::move(t));
 }
 
 void nf_ct_flush(void* h) { static_cast<Fastpath*>(h)->ct.flush(); }
 
 void nf_set_endpoint_ids(void* h, int64_t n, const uint32_t* ids) {
   auto* fp = static_cast<Fastpath*>(h);
-  fp->ep_ids.assign(ids, ids + n);
+  std::lock_guard<std::mutex> g(fp->load_mu);
+  auto t = fp->clone();
+  t->ep_ids.assign(ids, ids + n);
+  fp->swap(std::move(t));
 }
 
-// IPv4 LB tables; any (re)load flushes CT in the WRAPPER (caller).
-void nf_load_lb(void* h, int32_t n_fe, int seq_width,
-                const uint32_t* fe_addr, const int32_t* fe_port,
+// LB tables for one family (stride 4 = IPv4, 16 = IPv6); fe_addr /
+// be_addr are n*stride big-endian address bytes. Any (re)load flushes
+// CT in the WRAPPER (caller).
+void nf_load_lb(void* h, int stride, int32_t n_fe, int seq_width,
+                const uint8_t* fe_addr, const int32_t* fe_port,
                 const int32_t* fe_proto, const int32_t* fe_seq,
                 const int32_t* fe_seq_len, const int32_t* fe_revnat,
-                int32_t n_be, const uint32_t* be_addr,
+                int32_t n_be, const uint8_t* be_addr,
                 const int32_t* be_port) {
   auto* fp = static_cast<Fastpath*>(h);
-  LBTables& t = fp->lb;
-  t.fe_addr.assign(fe_addr, fe_addr + n_fe);
+  std::lock_guard<std::mutex> g(fp->load_mu);
+  auto tt = fp->clone();
+  LBT& t = stride == 16 ? tt->lb6 : tt->lb4;
+  t.stride = stride;
+  t.fe_addr.assign(fe_addr, fe_addr + size_t(n_fe) * stride);
   t.fe_port.assign(fe_port, fe_port + n_fe);
   t.fe_proto.assign(fe_proto, fe_proto + n_fe);
   t.fe_seq.assign(fe_seq, fe_seq + size_t(n_fe) * seq_width);
   t.fe_seq_len.assign(fe_seq_len, fe_seq_len + n_fe);
   t.fe_revnat.assign(fe_revnat, fe_revnat + n_fe);
-  t.be_addr.assign(be_addr, be_addr + n_be);
+  t.be_addr.assign(be_addr, be_addr + size_t(n_be) * stride);
   t.be_port.assign(be_port, be_port + n_be);
   t.seq_width = seq_width;
+  t.n_fe = size_t(n_fe);
   t.loaded = n_fe > 0;
+  fp->swap(std::move(tt));
 }
+
+// ── L7 loading ───────────────────────────────────────────────────────
+
+// HTTP policy for one (ep, port, dir). DFAs: trans [q][256] + accept
+// [q] u64 + start; q = 0 marks an unused field. Rules: per-rule accept
+// BIT index per field (-1 = wildcard), identity scoping as sorted
+// flattened u64 lists.
+void nf_l7_set_http(void* h, uint32_t ep, uint32_t port, uint8_t ingress,
+                    const int32_t* m_trans, const uint64_t* m_accept,
+                    int32_t m_q, int32_t m_start,
+                    const int32_t* p_trans, const uint64_t* p_accept,
+                    int32_t p_q, int32_t p_start,
+                    const int32_t* h_trans, const uint64_t* h_accept,
+                    int32_t h_q, int32_t h_start,
+                    int32_t n_rules, const int32_t* m_bit,
+                    const int32_t* p_bit, const int32_t* h_bit,
+                    const uint8_t* scoped, const int64_t* ident_off,
+                    const uint64_t* idents) {
+  auto* fp = static_cast<Fastpath*>(h);
+  std::lock_guard<std::mutex> g(fp->load_mu);
+  auto t = fp->clone();
+  HTTPPolicyN pol;
+  load_dfa(pol.method, m_trans, m_accept, m_q, m_start);
+  load_dfa(pol.path, p_trans, p_accept, p_q, p_start);
+  load_dfa(pol.host, h_trans, h_accept, h_q, h_start);
+  pol.n_rules = size_t(n_rules);
+  pol.m_bit.assign(m_bit, m_bit + n_rules);
+  pol.p_bit.assign(p_bit, p_bit + n_rules);
+  pol.h_bit.assign(h_bit, h_bit + n_rules);
+  pol.scoped.assign(scoped, scoped + n_rules);
+  pol.ident_off.assign(ident_off, ident_off + n_rules + 1);
+  pol.idents.assign(idents, idents + ident_off[n_rules]);
+  t->http[l7_key(ep, port, ingress ? 0u : 1u)] = std::move(pol);
+  fp->swap(std::move(t));
+}
+
+// Kafka ACL for one (ep, port, dir): rule vectors + interned topic /
+// client string tables (concatenated bytes + offsets).
+void nf_l7_set_kafka(void* h, uint32_t ep, uint32_t port, uint8_t ingress,
+                     int32_t n_rules, const uint32_t* key_mask,
+                     const uint8_t* key_wild, const int32_t* version,
+                     const int32_t* topic_id, const int32_t* client_id,
+                     const uint8_t* scoped, const int64_t* ident_off,
+                     const uint64_t* idents,
+                     int32_t n_topics, const uint8_t* topic_bytes,
+                     const int64_t* topic_off,
+                     int32_t n_clients, const uint8_t* client_bytes,
+                     const int64_t* client_off) {
+  auto* fp = static_cast<Fastpath*>(h);
+  std::lock_guard<std::mutex> g(fp->load_mu);
+  auto t = fp->clone();
+  KafkaACLN acl;
+  acl.n_rules = size_t(n_rules);
+  acl.key_mask.assign(key_mask, key_mask + n_rules);
+  acl.key_wild.assign(key_wild, key_wild + n_rules);
+  acl.version.assign(version, version + n_rules);
+  acl.topic_id.assign(topic_id, topic_id + n_rules);
+  acl.client_id.assign(client_id, client_id + n_rules);
+  acl.scoped.assign(scoped, scoped + n_rules);
+  acl.ident_off.assign(ident_off, ident_off + n_rules + 1);
+  acl.idents.assign(idents, idents + ident_off[n_rules]);
+  for (int32_t i = 0; i < n_topics; ++i)
+    acl.topics.emplace_back(
+        reinterpret_cast<const char*>(topic_bytes) + topic_off[i],
+        size_t(topic_off[i + 1] - topic_off[i]));
+  for (int32_t i = 0; i < n_clients; ++i)
+    acl.clients.emplace_back(
+        reinterpret_cast<const char*>(client_bytes) + client_off[i],
+        size_t(client_off[i + 1] - client_off[i]));
+  t->kafka[l7_key(ep, port, ingress ? 0u : 1u)] = std::move(acl);
+  fp->swap(std::move(t));
+}
+
+// ── L7 evaluation ────────────────────────────────────────────────────
+
+// strings: [n, max_len] padded bytes + [n] lengths (-1 = overlong →
+// fail closed, matching ops/dfa.strings_to_batch)
+void nf_l7_http_batch(void* h, uint32_t ep, uint32_t port, uint8_t ingress,
+                      int64_t n,
+                      const uint8_t* methods, int32_t m_len,
+                      const int32_t* m_lens,
+                      const uint8_t* paths, int32_t p_len,
+                      const int32_t* p_lens,
+                      const uint8_t* hosts, int32_t h_len,
+                      const int32_t* h_lens,
+                      const uint64_t* src_identity, uint8_t* allow_out) {
+  auto* fp = static_cast<Fastpath*>(h);
+  auto t = fp->snap();
+  auto it = t->http.find(l7_key(ep, port, ingress ? 0u : 1u));
+  if (it == t->http.end()) {
+    std::memset(allow_out, 1, size_t(n));  // no policy: pure L4 redirect
+    return;
+  }
+  const HTTPPolicyN& pol = it->second;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t mm = pol.method.q
+        ? pol.method.run(methods + size_t(i) * m_len, m_lens[i]) : 0;
+    uint64_t pm = pol.path.q
+        ? pol.path.run(paths + size_t(i) * p_len, p_lens[i]) : 0;
+    uint64_t hm = pol.host.q
+        ? pol.host.run(hosts + size_t(i) * h_len, h_lens[i]) : 0;
+    allow_out[i] = pol.check(mm, pm, hm, src_identity[i]) ? 1 : 0;
+  }
+}
+
+void nf_l7_kafka_batch(void* h, uint32_t ep, uint32_t port, uint8_t ingress,
+                       int64_t n, const int32_t* api_key,
+                       const int32_t* api_version,
+                       const uint8_t* topics, int32_t t_len,
+                       const int32_t* topic_lens,
+                       const uint8_t* clients, int32_t c_len,
+                       const int32_t* client_lens,
+                       const uint64_t* src_identity, uint8_t* allow_out) {
+  auto* fp = static_cast<Fastpath*>(h);
+  auto t = fp->snap();
+  auto it = t->kafka.find(l7_key(ep, port, ingress ? 0u : 1u));
+  if (it == t->kafka.end()) {
+    std::memset(allow_out, 1, size_t(n));
+    return;
+  }
+  const KafkaACLN& acl = it->second;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t tid = acl.intern_of(
+        acl.topics, topics + size_t(i) * t_len, topic_lens[i]);
+    int32_t cid = acl.intern_of(
+        acl.clients, clients + size_t(i) * c_len, client_lens[i]);
+    allow_out[i] = acl.check(api_key[i], api_version[i], tid, cid,
+                             src_identity[i]) ? 1 : 0;
+  }
+}
+
+// ── L3/L4 evaluation ─────────────────────────────────────────────────
 
 // addr: n * stride bytes (stride 4 = v4, 16 = v6), big-endian address
 // bytes (the trie's walk order). sports may be null (disables CT).
+// Thread-safe: any number of concurrent callers (snapshot reads,
+// atomic counters, lock-free CT).
 void nf_eval_batch(void* h, int64_t n, const uint8_t* addr, int stride,
                    const int32_t* ep_idx, const int32_t* dport,
                    const int32_t* proto, const int32_t* sport,
                    uint8_t ingress, int8_t* verdict_out,
                    uint8_t* redirect_out) {
   auto* fp = static_cast<Fastpath*>(h);
+  auto t = fp->snap();
   const bool v6 = stride == 16;
-  const Trie& ip = v6 ? fp->ip6 : fp->ip4;
-  const Trie& deny = v6 ? fp->deny6 : fp->deny4;
-  const bool use_ct = fp->ct_enabled && sport != nullptr;
-  const double now = use_ct ? now_s() : 0.0;
+  const Trie& ip = v6 ? t->ip6 : t->ip4;
+  const Trie& deny = v6 ? t->deny6 : t->deny4;
+  const LBT& lb = v6 ? t->lb6 : t->lb4;
+  const bool use_ct = fp->ct.enabled && sport != nullptr;
+  const uint64_t now = use_ct ? now_ns() : 0;
   const uint32_t dir = ingress ? 0u : 1u;
 
   for (int64_t i = 0; i < n; ++i) {
     const uint8_t* a = addr + size_t(i) * stride;
     int32_t dport_i = dport[i];
 
-    // ── LB stage (egress, IPv4): VIP→backend translate BEFORE CT
-    // and policy, exactly like DatapathPipeline._process. The flow
-    // hash uses the PRE-NAT address + stable endpoint id so the pick
+    // ── LB stage (egress): VIP→backend translate BEFORE CT and
+    // policy, exactly like DatapathPipeline._process. The flow hash
+    // uses the PRE-NAT address + stable endpoint id so the pick
     // matches the device path bit for bit.
-    uint8_t abuf[4];
+    uint8_t abuf[16];
     bool no_service = false;
-    if (!ingress && !v6 && fp->lb.loaded) {
-      uint32_t dst = (uint32_t(a[0]) << 24) | (uint32_t(a[1]) << 16) |
-                     (uint32_t(a[2]) << 8) | a[3];
-      const LBTables& t = fp->lb;
-      for (size_t f = 0; f < t.fe_addr.size(); ++f) {
-        if (t.fe_addr[f] != dst || t.fe_port[f] != dport_i) continue;
-        if (t.fe_proto[f] != 0 && t.fe_proto[f] != proto[i]) continue;
-        if (t.fe_seq_len[f] <= 0) {
+    if (!ingress && lb.loaded) {
+      for (size_t f = 0; f < lb.n_fe; ++f) {
+        if (std::memcmp(lb.fe_addr.data() + f * stride, a, stride) != 0)
+          continue;
+        if (lb.fe_port[f] != dport_i) continue;
+        if (lb.fe_proto[f] != 0 && lb.fe_proto[f] != proto[i]) continue;
+        if (lb.fe_seq_len[f] <= 0) {
           no_service = true;
           break;
         }
         // mirror pipeline.py's np.clip fallback exactly: with a
         // non-empty id table, out-of-range indices CLAMP (not raw)
         uint32_t ep_id;
-        if (fp->ep_ids.empty()) {
+        if (t->ep_ids.empty()) {
           ep_id = uint32_t(ep_idx[i]);
         } else {
           int64_t ci = ep_idx[i];
           if (ci < 0) ci = 0;
-          if (ci >= int64_t(fp->ep_ids.size()))
-            ci = int64_t(fp->ep_ids.size()) - 1;
-          ep_id = fp->ep_ids[ci];
+          if (ci >= int64_t(t->ep_ids.size()))
+            ci = int64_t(t->ep_ids.size()) - 1;
+          ep_id = t->ep_ids[ci];
         }
         int32_t hsh = flow_hash32(
-            a, 4, sport ? sport[i] : 0, dport_i, proto[i], ep_id,
+            a, stride, sport ? sport[i] : 0, dport_i, proto[i], ep_id,
             sport != nullptr);
-        int32_t be = t.fe_seq[f * t.seq_width + (hsh % t.fe_seq_len[f])];
-        uint32_t ba = t.be_addr[be];
-        abuf[0] = (ba >> 24) & 0xFF;
-        abuf[1] = (ba >> 16) & 0xFF;
-        abuf[2] = (ba >> 8) & 0xFF;
-        abuf[3] = ba & 0xFF;
+        int32_t be = lb.fe_seq[f * lb.seq_width + (hsh % lb.fe_seq_len[f])];
+        std::memcpy(abuf, lb.be_addr.data() + size_t(be) * stride, stride);
         a = abuf;
-        dport_i = t.be_port[be];
+        dport_i = lb.be_port[be];
         break;
       }
       if (no_service) {
         verdict_out[i] = DROP_NO_SERVICE;
         redirect_out[i] = 0;
         if (uint32_t(ep_idx[i]) < fp->ep_count)
-          fp->counters[size_t(ep_idx[i]) * 3 + 2]++;  // dropped_other
+          fp->counters[size_t(ep_idx[i]) * 3 + 2].fetch_add(
+              1, std::memory_order_relaxed);
         continue;
       }
     }
@@ -391,7 +742,8 @@ void nf_eval_batch(void* h, int64_t n, const uint8_t* addr, int stride,
         verdict_out[i] = FORWARD;
         redirect_out[i] = 0;
         if (uint32_t(ep_idx[i]) < fp->ep_count)
-          fp->counters[size_t(ep_idx[i]) * 3]++;
+          fp->counters[size_t(ep_idx[i]) * 3].fetch_add(
+              1, std::memory_order_relaxed);
         continue;
       }
     }
@@ -401,17 +753,17 @@ void nf_eval_batch(void* h, int64_t n, const uint8_t* addr, int stride,
       v = DROP_PREFILTER;
     } else {
       int32_t hit = ip.loaded ? ip.lookup(a) : 0;
-      uint64_t ident = hit > 0 ? uint64_t(hit - 1) : fp->world_identity;
+      uint64_t ident = hit > 0 ? uint64_t(hit - 1) : t->world_identity;
       // __policy_can_access probe order (bpf/lib/policy.h:46):
       // exact {id,dport,proto} -> L3-only {id} -> L4-only {dport,proto}
-      int val = fp->policy.find(
+      int val = t->policy.find(
           ident, policy_kb(uint32_t(ep_idx[i]), uint32_t(dport_i),
                            uint32_t(proto[i]), dir));
       if (val < 0)
-        val = fp->policy.find(ident,
-                              policy_kb(uint32_t(ep_idx[i]), 0, 0, dir));
+        val = t->policy.find(ident,
+                             policy_kb(uint32_t(ep_idx[i]), 0, 0, dir));
       if (val < 0)
-        val = fp->policy.find(
+        val = t->policy.find(
             0, policy_kb(uint32_t(ep_idx[i]), uint32_t(dport_i),
                          uint32_t(proto[i]), dir));
       if (val > 0) {
@@ -426,15 +778,17 @@ void nf_eval_batch(void* h, int64_t n, const uint8_t* addr, int stride,
     redirect_out[i] = red;
     if (uint32_t(ep_idx[i]) < fp->ep_count) {
       int cls = v == FORWARD ? 0 : v == DROP_POLICY ? 1 : 2;
-      fp->counters[size_t(ep_idx[i]) * 3 + cls]++;
+      fp->counters[size_t(ep_idx[i]) * 3 + cls].fetch_add(
+          1, std::memory_order_relaxed);
     }
   }
 }
 
 void nf_counters(void* h, int64_t* out) {
   auto* fp = static_cast<Fastpath*>(h);
-  std::memcpy(out, fp->counters.data(),
-              fp->counters.size() * sizeof(int64_t));
+  size_t n = size_t(fp->ep_count ? fp->ep_count : 1) * 3;
+  for (size_t i = 0; i < n; ++i)
+    out[i] = fp->counters[i].load(std::memory_order_relaxed);
 }
 
 }  // extern "C"

@@ -139,44 +139,35 @@ class NativeFastpath:
         )
 
     def load_lb(self, manager) -> None:
-        """Load the IPv4 service tables from a lb.ServiceManager —
-        built through the SAME build_device() used by the device path
-        so frontend order, selection sequences, and backend rows are
-        bit-identical (deterministic hash ⇒ identical picks). Flushes
-        conntrack (translated CT keys change with the tables).
-        IPv6 service tables are NOT supported natively — refusing
-        loudly beats silently diverging from the device path."""
+        """Load BOTH address families' service tables from a
+        lb.ServiceManager — built through the SAME build_device() used
+        by the device path so frontend order, selection sequences, and
+        backend rows are bit-identical (deterministic hash ⇒ identical
+        picks, bpf/lib/lb.h lb4/lb6 dual-stack). Flushes conntrack
+        (translated CT keys change with the tables)."""
         tables = manager.build_device()
-        if tables.get(6) is not None:
-            raise RuntimeError(
-                "native front-end does not support IPv6 service tables"
-            )
-        t = tables.get(4)
+        for family, stride in ((4, 4), (6, 16)):
+            t = tables.get(family)
+            if t is None:
+                self._load_lb_family(stride, None)
+            else:
+                self._load_lb_family(stride, t)
+        self.ct_flush()
+
+    def _load_lb_family(self, stride: int, t) -> None:
         if t is None:
+            z8 = np.zeros(1, np.uint8)
+            z32 = np.zeros(1, np.int32)
             self._lib.nf_load_lb(
-                self._h, 0, 1,
-                _ptr(np.zeros(1, np.uint32), ctypes.c_uint32),
-                _ptr(np.zeros(1, np.int32), ctypes.c_int32),
-                _ptr(np.zeros(1, np.int32), ctypes.c_int32),
-                _ptr(np.zeros(1, np.int32), ctypes.c_int32),
-                _ptr(np.zeros(1, np.int32), ctypes.c_int32),
-                _ptr(np.zeros(1, np.int32), ctypes.c_int32),
-                0,
-                _ptr(np.zeros(1, np.uint32), ctypes.c_uint32),
-                _ptr(np.zeros(1, np.int32), ctypes.c_int32),
+                self._h, stride, 0, 1,
+                _ptr(z8, ctypes.c_uint8), _ptr(z32, ctypes.c_int32),
+                _ptr(z32, ctypes.c_int32), _ptr(z32, ctypes.c_int32),
+                _ptr(z32, ctypes.c_int32), _ptr(z32, ctypes.c_int32),
+                0, _ptr(z8, ctypes.c_uint8), _ptr(z32, ctypes.c_int32),
             )
-            self.ct_flush()
             return
-        fe_bytes = np.asarray(t.fe_bytes, np.uint32)
-        fe_addr = np.ascontiguousarray(
-            (fe_bytes[:, 0] << 24) | (fe_bytes[:, 1] << 16)
-            | (fe_bytes[:, 2] << 8) | fe_bytes[:, 3], np.uint32
-        )
-        be_bytes = np.asarray(t.be_bytes, np.uint32)
-        be_addr = np.ascontiguousarray(
-            (be_bytes[:, 0] << 24) | (be_bytes[:, 1] << 16)
-            | (be_bytes[:, 2] << 8) | be_bytes[:, 3], np.uint32
-        )
+        fe_addr = np.ascontiguousarray(np.asarray(t.fe_bytes), np.uint8)
+        be_addr = np.ascontiguousarray(np.asarray(t.be_bytes), np.uint8)
         fe_port = np.ascontiguousarray(t.fe_port, np.int32)
         fe_proto = np.ascontiguousarray(t.fe_proto, np.int32)
         fe_seq = np.ascontiguousarray(t.fe_seq, np.int32)
@@ -184,15 +175,208 @@ class NativeFastpath:
         fe_revnat = np.ascontiguousarray(t.fe_revnat, np.int32)
         be_port = np.ascontiguousarray(t.be_port, np.int32)
         self._lib.nf_load_lb(
-            self._h, fe_addr.shape[0], fe_seq.shape[1],
-            _ptr(fe_addr, ctypes.c_uint32), _ptr(fe_port, ctypes.c_int32),
+            self._h, stride, fe_addr.shape[0], fe_seq.shape[1],
+            _ptr(fe_addr, ctypes.c_uint8), _ptr(fe_port, ctypes.c_int32),
             _ptr(fe_proto, ctypes.c_int32), _ptr(fe_seq, ctypes.c_int32),
             _ptr(fe_seq_len, ctypes.c_int32),
             _ptr(fe_revnat, ctypes.c_int32),
-            be_addr.shape[0], _ptr(be_addr, ctypes.c_uint32),
+            be_addr.shape[0], _ptr(be_addr, ctypes.c_uint8),
             _ptr(be_port, ctypes.c_int32),
         )
-        self.ct_flush()
+
+    # -- L7 -------------------------------------------------------------
+    def load_l7_http(
+        self, endpoint_id: int, port: int, http_policy, *,
+        ingress: bool = True,
+    ) -> None:
+        """Load one (endpoint, port, direction)'s HTTP policy into the
+        native enforcer (the envoy/cilium_l7policy.cc role): the SAME
+        MultiDFA tables HTTPPolicy compiled, plus per-rule accept-bit
+        indices and identity scopes. Raises when any rule relies on
+        host-only matching (regex demoted from the DFA, or header
+        matchers) — refusing loudly beats silently diverging."""
+        m, p, hst, rules = http_policy.native_tables()
+        n = len(rules)
+        m_bit = np.ascontiguousarray([r[0] for r in rules], np.int32)
+        p_bit = np.ascontiguousarray([r[1] for r in rules], np.int32)
+        h_bit = np.ascontiguousarray([r[2] for r in rules], np.int32)
+        scoped = np.ascontiguousarray(
+            [1 if r[3] is not None else 0 for r in rules], np.uint8
+        )
+        off = [0]
+        idents: list = []
+        for r in rules:
+            if r[3] is not None:
+                idents.extend(sorted(r[3]))
+            off.append(len(idents))
+        ident_off = np.ascontiguousarray(off, np.int64)
+        ident_arr = np.ascontiguousarray(idents or [0], np.uint64)
+
+        def dfa_args(d):
+            if d is None:
+                z = np.zeros(256, np.int32)
+                za = np.zeros(1, np.uint64)
+                return (_ptr(z, ctypes.c_int32), _ptr(za, ctypes.c_uint64),
+                        0, 0, (z, za))
+            trans = np.ascontiguousarray(d.trans, np.int32)
+            accept = np.ascontiguousarray(d.accept, np.uint64)
+            return (_ptr(trans, ctypes.c_int32),
+                    _ptr(accept, ctypes.c_uint64),
+                    trans.shape[0], int(d.start), (trans, accept))
+
+        mt, ma, mq, ms, mk = dfa_args(m)
+        pt, pa, pq, ps, pk = dfa_args(p)
+        ht, ha, hq, hs, hk = dfa_args(hst)
+        self._lib.nf_l7_set_http(
+            self._h, endpoint_id, port, 1 if ingress else 0,
+            mt, ma, mq, ms, pt, pa, pq, ps, ht, ha, hq, hs,
+            n, _ptr(m_bit, ctypes.c_int32), _ptr(p_bit, ctypes.c_int32),
+            _ptr(h_bit, ctypes.c_int32), _ptr(scoped, ctypes.c_uint8),
+            _ptr(ident_off, ctypes.c_int64),
+            _ptr(ident_arr, ctypes.c_uint64),
+        )
+
+    def load_l7_kafka(
+        self, endpoint_id: int, port: int, kafka_acl, *,
+        ingress: bool = True,
+    ) -> None:
+        """Load one (endpoint, port, direction)'s Kafka ACL vectors +
+        interned topic/client tables (pkg/kafka/policy.go MatchesRule,
+        natively)."""
+        n = len(kafka_acl)
+        key_mask = np.ascontiguousarray(kafka_acl.key_mask, np.uint32)
+        key_wild = np.ascontiguousarray(kafka_acl.key_wild, np.uint8)
+        version = np.ascontiguousarray(kafka_acl.version, np.int32)
+        topic_id = np.ascontiguousarray(kafka_acl.topic_id, np.int32)
+        clients = kafka_acl.client_id  # list of strings per rule
+        cli_tbl = sorted({c for c in clients if c})
+        cli_ids = {c: i for i, c in enumerate(cli_tbl)}
+        client_id = np.ascontiguousarray(
+            [cli_ids.get(c, -1) if c else -1 for c in clients], np.int32
+        )
+        scoped = np.ascontiguousarray(
+            [1 if idents is not None else 0 for _r, idents in kafka_acl._rules],
+            np.uint8,
+        )
+        off = [0]
+        idents_flat: list = []
+        for _r, idents in kafka_acl._rules:
+            if idents is not None:
+                idents_flat.extend(sorted(idents))
+            off.append(len(idents_flat))
+        ident_off = np.ascontiguousarray(off, np.int64)
+        ident_arr = np.ascontiguousarray(idents_flat or [0], np.uint64)
+
+        def strtab(strs):
+            offs = [0]
+            blob = b""
+            for s in strs:
+                blob += s.encode()
+                offs.append(len(blob))
+            b = np.frombuffer(blob or b"\0", np.uint8).copy()
+            return b, np.ascontiguousarray(offs, np.int64)
+
+        topics = [t for t, _ in sorted(
+            kafka_acl._topic_ids.items(), key=lambda kv: kv[1]
+        )]
+        t_bytes, t_off = strtab(topics)
+        c_bytes, c_off = strtab(cli_tbl)
+        self._lib.nf_l7_set_kafka(
+            self._h, endpoint_id, port, 1 if ingress else 0,
+            n, _ptr(key_mask, ctypes.c_uint32),
+            _ptr(key_wild, ctypes.c_uint8), _ptr(version, ctypes.c_int32),
+            _ptr(topic_id, ctypes.c_int32), _ptr(client_id, ctypes.c_int32),
+            _ptr(scoped, ctypes.c_uint8), _ptr(ident_off, ctypes.c_int64),
+            _ptr(ident_arr, ctypes.c_uint64),
+            len(topics), _ptr(t_bytes, ctypes.c_uint8),
+            _ptr(t_off, ctypes.c_int64),
+            len(cli_tbl), _ptr(c_bytes, ctypes.c_uint8),
+            _ptr(c_off, ctypes.c_int64),
+        )
+
+    def check_http_batch(
+        self, endpoint_id: int, port: int, requests, *,
+        ingress: bool = True, max_len: int = 256,
+    ) -> np.ndarray:
+        """Native per-request HTTP enforcement → [B] bool allow (the
+        same contract as HTTPPolicy.check_batch). Field widths adapt to
+        the batch's longest value so overlong strings still match —
+        HTTPPolicy deliberately host-walks overlong values rather than
+        failing closed, and the native path must agree. Values past
+        64KiB raise (bounded allocation; route those to the Python
+        path)."""
+        from ..l7.http_policy import NativeL7Unsupported
+        from ..ops.dfa import strings_to_batch
+
+        n = len(requests)
+        enc_m = [r.method.encode() for r in requests]
+        enc_p = [r.path.encode() for r in requests]
+        enc_h = [r.host.encode() for r in requests]
+
+        def width(encs, floor):
+            longest = max(map(len, encs), default=0)
+            if longest > 65536:
+                raise NativeL7Unsupported(
+                    f"request field of {longest} bytes exceeds the "
+                    "native 64KiB cap"
+                )
+            return max(floor, longest)
+
+        m_w = width(enc_m, 16)
+        p_w = width(enc_p, max_len)
+        h_w = width(enc_h, max_len)
+        mb, ml = strings_to_batch(enc_m, m_w)
+        pb, pl = strings_to_batch(enc_p, p_w)
+        hb, hl = strings_to_batch(enc_h, h_w)
+        src = np.ascontiguousarray(
+            [r.src_identity for r in requests], np.uint64
+        )
+        allow = np.empty(n, np.uint8)
+        self._lib.nf_l7_http_batch(
+            self._h, endpoint_id, port, 1 if ingress else 0, n,
+            _ptr(np.ascontiguousarray(mb, np.uint8), ctypes.c_uint8), m_w,
+            _ptr(np.ascontiguousarray(ml, np.int32), ctypes.c_int32),
+            _ptr(np.ascontiguousarray(pb, np.uint8), ctypes.c_uint8),
+            p_w,
+            _ptr(np.ascontiguousarray(pl, np.int32), ctypes.c_int32),
+            _ptr(np.ascontiguousarray(hb, np.uint8), ctypes.c_uint8),
+            h_w,
+            _ptr(np.ascontiguousarray(hl, np.int32), ctypes.c_int32),
+            _ptr(src, ctypes.c_uint64), _ptr(allow, ctypes.c_uint8),
+        )
+        return allow.astype(bool)
+
+    def check_kafka_batch(
+        self, endpoint_id: int, port: int, requests, *,
+        ingress: bool = True,
+    ) -> np.ndarray:
+        """Native Kafka ACL enforcement → [B] bool allow (the same
+        contract as KafkaACL.check_batch)."""
+        from ..ops.dfa import strings_to_batch
+
+        n = len(requests)
+        tb, tl = strings_to_batch([r.topic.encode() for r in requests], 255)
+        cb, cl = strings_to_batch(
+            [r.client_id.encode() for r in requests], 255
+        )
+        api_key = np.ascontiguousarray([r.api_key for r in requests], np.int32)
+        api_ver = np.ascontiguousarray(
+            [r.api_version for r in requests], np.int32
+        )
+        src = np.ascontiguousarray(
+            [r.src_identity for r in requests], np.uint64
+        )
+        allow = np.empty(n, np.uint8)
+        self._lib.nf_l7_kafka_batch(
+            self._h, endpoint_id, port, 1 if ingress else 0, n,
+            _ptr(api_key, ctypes.c_int32), _ptr(api_ver, ctypes.c_int32),
+            _ptr(np.ascontiguousarray(tb, np.uint8), ctypes.c_uint8), 255,
+            _ptr(np.ascontiguousarray(tl, np.int32), ctypes.c_int32),
+            _ptr(np.ascontiguousarray(cb, np.uint8), ctypes.c_uint8), 255,
+            _ptr(np.ascontiguousarray(cl, np.int32), ctypes.c_int32),
+            _ptr(src, ctypes.c_uint64), _ptr(allow, ctypes.c_uint8),
+        )
+        return allow.astype(bool)
 
     # -- evaluation -----------------------------------------------------
     def process(

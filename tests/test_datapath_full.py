@@ -209,6 +209,44 @@ class TestConntrackTable:
         assert ct.gc() == 1
         assert len(ct) == 0
 
+    def test_gc_keeps_probe_chains_walkable(self):
+        """gc() must tombstone (valid=False) without emptying ka: a
+        reclaimed slot in the middle of a probe chain would otherwise
+        make live entries behind it unreachable (the early-terminating
+        _find stops at EMPTY)."""
+        ct = FlowConntrack(capacity_bits=4, probes=8, other_lifetime=0.01,
+                           tcp_lifetime=3600.0)
+        # flow A (UDP, expires fast) and flow B (TCP, long-lived) that
+        # collide: find kb values whose round-0 slots collide
+        base_kb = None
+        for cand in range(1, 4096):
+            ka0, kb0, kc0 = pack_keys(
+                np.zeros(1, np.uint64), np.array([17], np.uint64),
+                np.zeros(1, np.uint64), np.array([1000], np.uint64),
+                np.array([53], np.uint64), np.array([17], np.uint64),
+                np.zeros(1, np.uint64),
+            )
+            ka1, kb1, kc1 = pack_keys(
+                np.zeros(1, np.uint64), np.array([cand], np.uint64),
+                np.zeros(1, np.uint64), np.array([2000], np.uint64),
+                np.array([80], np.uint64), np.array([6], np.uint64),
+                np.zeros(1, np.uint64),
+            )
+            s0 = int(ct._hash(ka0, kb0, kc0)[0] & ct.mask)
+            s1 = int(ct._hash(ka1, kb1, kc1)[0] & ct.mask)
+            if s0 == s1 and cand != 17:
+                base_kb = cand
+                break
+        assert base_kb is not None
+        ct.create_batch(ka0, kb0, kc0)  # takes the shared round-0 slot
+        ct.create_batch(ka1, kb1, kc1)  # probes past it
+        assert ct.lookup_batch(ka1, kb1, kc1)[0][0] == CT_ESTABLISHED
+        time.sleep(0.02)  # A expires; B (TCP) stays live
+        assert ct.gc() >= 1
+        assert ct.lookup_batch(ka1, kb1, kc1)[0][0] == CT_ESTABLISHED, (
+            "gc() broke the probe chain to a live entry"
+        )
+
     def test_batch_insert_dedup_and_collisions(self):
         ct = FlowConntrack(capacity_bits=6, probes=8)
         n = 12
