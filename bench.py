@@ -92,18 +92,52 @@ def _bench_ident_update(engine, reg):
     samples = []
     host = []
     for i in range(8):
-        t0 = time.time()
-        reg.allocate(
-            parse_label_array(
-                [f"k8s:app=a{i % 512}", f"k8s:zone=z{i % 8}", "k8s:env=prod"]
-            )
+        labels = parse_label_array(
+            [f"k8s:app=a{i % 512}", f"k8s:zone=z{i % 8}", "k8s:env=bench"]
         )
+        t0 = time.time()
+        ident = reg.allocate(labels)
         engine.refresh()
         host.append(time.time() - t0)
         jax.block_until_ready(engine.device_policy.sel_match)
         samples.append(time.time() - t0)
+        # restore the world between samples: without this, each
+        # sample's cost depends on how many prior samples accumulated
+        # (a crossed row-capacity bucket would force a full rebuild
+        # mid-series and skew the median)
+        reg.release(ident)
+        engine.refresh()
+        jax.block_until_ready(engine.device_policy.sel_match)
     mid = len(samples) // 2
     return sorted(samples)[mid] * 1000, sorted(host)[mid] * 1000
+
+
+def _bench_ident_burst(engine, reg) -> float:
+    """Amortized per-identity blocking cost when a CHURN BURST lands as
+    one delta batch — the row patches for all k identities ride ONE
+    device dispatch (_set_rows2), so the tunnel round trip is paid
+    once, not k times. Returns ms per identity (median of 4 bursts)."""
+    from cilium_tpu.labels import parse_label_array
+
+    k = 16
+    samples = []
+    for trial in range(4):
+        labels = [
+            parse_label_array(
+                [f"k8s:app=a{(trial * k + j) % 512}", f"k8s:burst=b{j}"]
+            )
+            for j in range(k)
+        ]
+        t0 = time.time()
+        batch = [reg.allocate(l) for l in labels]
+        engine.refresh()
+        jax.block_until_ready(engine.device_policy.sel_match)
+        samples.append((time.time() - t0) / k)
+        for ident in batch:
+            reg.release(ident)
+        engine.refresh()
+        jax.block_until_ready(engine.device_policy.sel_match)
+    return sorted(samples)[len(samples) // 2] * 1000
 
 
 def _bench_rule_update(engine, repo, rng) -> float:
@@ -371,6 +405,104 @@ def _bench_native_l7() -> float:
     return iters * b / (time.time() - t0)
 
 
+def _bench_stretch() -> dict:
+    """The north-star stretch config (BASELINE.json configs[4]):
+    100k identities × 100k rules, 64 endpoints — the reference's full
+    identity envelope (pkg/identity/allocator.go:77-78) merged with
+    local/CIDR identities in the high range, at 10× its per-endpoint
+    rule scale. Reports compile + full-materialize time and sustained
+    verdicts/s on the materialized policymap."""
+    import random as _random
+
+    from cilium_tpu.engine import PolicyEngine as _PE
+    from cilium_tpu.identity import IdentityRegistry as _IR
+    from cilium_tpu.policy.repository import Repository as _Repo
+
+    n_rules = int(os.environ.get("BENCH_STRETCH_RULES", 100_000))
+    n_ids = int(os.environ.get("BENCH_STRETCH_IDS", 100_000))
+    rng = _random.Random(1)
+    repo = _Repo()
+    rules = []
+    n_apps = 2048
+    for _ in range(n_rules):
+        subject = [f"k8s:app=a{rng.randrange(n_apps)}"]
+        peer = EndpointSelector.make([f"k8s:app=a{rng.randrange(n_apps)}"])
+        if rng.random() < 0.3:
+            port = rng.choice([80, 443, 8080, 53, 5432])
+            ing = IngressRule(
+                from_endpoints=(peer,),
+                to_ports=(PortRule(
+                    ports=(PortProtocol(port, "UDP" if port == 53 else "TCP"),)
+                ),),
+            )
+        else:
+            ing = IngressRule(from_endpoints=(peer,))
+        rules.append(rule(subject, ingress=[ing]))
+    repo.add_list(rules)
+
+    reg = _IR()
+    idents = []
+    combos = set()
+    while len(idents) < n_ids:
+        app = rng.randrange(n_apps)
+        zone = rng.randrange(64)
+        env = rng.randrange(3)
+        if (app, zone, env) in combos:
+            continue
+        combos.add((app, zone, env))
+        labels = [f"k8s:app=a{app}", f"k8s:zone=z{zone}"]
+        if env:
+            labels.append(f"k8s:env={'prod' if env == 1 else 'dev'}")
+        # user range first (256..65535), then the local/CIDR high range
+        idents.append(
+            reg.allocate(parse_label_array(labels), local=len(idents) >= 65000)
+        )
+
+    engine = _PE(repo, reg)
+    t0 = time.time()
+    compiled = engine.refresh()
+    jax.block_until_ready(engine.device_policy.sel_match)
+    compile_s = time.time() - t0
+
+    ep_ids = [idents[i].id for i in range(N_ENDPOINTS)]
+    t0 = time.time()
+    tables, _snaps = materialize_endpoints(
+        compiled, engine.device_policy, ep_ids, ingress=True
+    )
+    jax.block_until_ready(tables.id_bits)
+    materialize_s = time.time() - t0
+
+    nrng = np.random.default_rng(7)
+    b = 1 << 22
+    live_rows = np.array([compiled.id_to_row[i.id] for i in idents], np.int32)
+    ep_idx = jnp.asarray(nrng.integers(0, N_ENDPOINTS, b, dtype=np.int32))
+    src = jnp.asarray(nrng.choice(live_rows, b).astype(np.int32))
+    dport = jnp.asarray(
+        nrng.choice(np.array([80, 443, 8080, 53, 22, 0], np.int32), b)
+    )
+    proto = jnp.asarray(np.where(np.asarray(dport) == 53, 17, 6).astype(np.int32))
+    dec, _red = lookup_batch(tables, ep_idx, src, dport, proto)
+    jax.block_until_ready(dec)
+    iters = 10
+    t0 = time.time()
+    for _ in range(iters):
+        dec, _red = lookup_batch(tables, ep_idx, src, dport, proto)
+    jax.block_until_ready(dec)
+    vps = iters * b / (time.time() - t0)
+    return {
+        "identities": len(idents),
+        "local_identities": sum(1 for x in idents if x.is_local),
+        "rules": n_rules,
+        "endpoints": N_ENDPOINTS,
+        "verdicts_per_s": round(vps),
+        "compile_s": round(compile_s, 1),
+        "materialize_s": round(materialize_s, 1),
+        "selectors": compiled.num_selectors,
+        "rows": int(compiled.id_bits.shape[0]),
+        "allow_fraction": round(float((np.asarray(dec) == 1).mean()), 4),
+    }
+
+
 def _bench_dispatch_rtt() -> float:
     """Median blocking round trip for a trivial pre-compiled dispatch —
     the environment's latency floor for ANY blocking device update
@@ -450,6 +582,7 @@ def main() -> None:
     # until the new state is live on device): identity churn and
     # single-rule import (pkg/endpoint/policy.go:506 analog).
     update_ident_ms, update_ident_host_ms = _bench_ident_update(engine, reg)
+    update_ident_burst_ms = _bench_ident_burst(engine, reg)
     update_rule_ms = _bench_rule_update(engine, repo, rng)
     update_rule_delete_ms = _bench_rule_delete(engine, repo, rng)
     dispatch_rtt_ms = _bench_dispatch_rtt()
@@ -474,6 +607,13 @@ def main() -> None:
     jax.block_until_ready(tables2.id_bits)
     rebuild_warm_s = time.time() - t0
 
+    # ── the 100k×100k stretch envelope (BASELINE configs[4])
+    stretch = (
+        _bench_stretch()
+        if os.environ.get("BENCH_STRETCH", "1") != "0" and extra
+        else {}
+    )
+
     allow_frac = float(jnp.mean((dec == 1).astype(jnp.float32)))
     result = {
         "metric": f"policymap verdicts/sec at {N_RULES} rules",
@@ -483,6 +623,7 @@ def main() -> None:
         "p99_us": round(p99_us, 2),
         "update_ident_ms": round(update_ident_ms, 1),
         "update_ident_host_ms": round(update_ident_host_ms, 1),
+        "update_ident_burst_ms": round(update_ident_burst_ms, 1),
         "update_rule_ms": round(update_rule_ms, 1),
         "update_rule_delete_ms": round(update_rule_delete_ms, 1),
         "lpm50k_lps": round(lpm50k),
@@ -492,6 +633,7 @@ def main() -> None:
         "native_vps_mt": {k: round(v) for k, v in native_mt.items()},
         "native_l7_rps": round(native_l7_rps),
         "rebuild_warm_s": round(rebuild_warm_s, 2),
+        "stretch_100k": stretch,
     }
     print(json.dumps(result))
     print(

@@ -99,6 +99,16 @@ def _bucket_slack(n: int, minimum: int = 8) -> int:
     return _bucket(n + max(4, n // 4), minimum)
 
 
+def _iter_group_sigs(raw: _RawDirection):
+    """Yield (signature, local_group_index) per local group of a raw
+    extraction; signature = (no_peers, frozenset((sid, explicit)))."""
+    peers_of: Dict[int, List[Tuple[int, bool]]] = {}
+    for g, sid, expl in raw.gp:
+        peers_of.setdefault(g, []).append((sid, expl))
+    for i, no_peers in enumerate(raw.group_no_peers):
+        yield (no_peers, frozenset(peers_of.get(i, ()))), i
+
+
 def _remove_occurrences(items: list, removed: list) -> list:
     """Remove each element of ``removed`` once from ``items``
     (multiset subtraction, order-preserving)."""
@@ -309,15 +319,29 @@ class DirectionPacker:
             self.combo_id.setdefault((subj, self.port_id[(port, proto)]), len(self.combo_id))
         k1 = _bucket_slack(len(self.combo_id))
 
-        g = _bucket_slack(max(1, self.n_groups))
+        # Pre-check groups are INTERNED by signature (no_peers flag +
+        # peer (sid, explicit) set): two directional rules with the
+        # same peer sets share one group column. At rule counts where
+        # many rules repeat selector shapes this collapses the G axis
+        # by 5-10×, and the [B,S]@[S,G] group matmuls dominate the
+        # materialization sweep's FLOPs. Refcounted for deletion.
+        self.group_sig: Dict[tuple, int] = {}
+        self.group_refs: Dict[int, int] = {}
+        sigs = {s for s, _ in _iter_group_sigs(raw)}
+        g = _bucket_slack(max(1, len(sigs)))
 
-        # K7 combos: (subj_sel, port_id, group) for L7 presence.
+        # K7 combos: (subj_sel, port_id, group) for L7 presence —
+        # sized via the same deterministic intern order _write uses.
+        order: Dict[tuple, int] = {}
+        local_gid: Dict[int, int] = {}
+        for sig, local in _iter_group_sigs(raw):
+            local_gid[local] = order.setdefault(sig, len(order))
+        k7_keys = {
+            (subj, self.port_id[(port, PROTO_TCP_N)], local_gid[grp])
+            for subj, port, grp in raw.l7_ports
+        }
         self.k7_ids: Dict[Tuple[int, int, int], int] = {}
-        for subj, port, group in raw.l7_ports:
-            self.k7_ids.setdefault(
-                (subj, self.port_id[(port, PROTO_TCP_N)], group), len(self.k7_ids)
-            )
-        k7 = _bucket_slack(len(self.k7_ids))
+        k7 = _bucket_slack(len(k7_keys))
 
         self.prog = DirectionProgram(
             s_pad=s_pad,
@@ -363,15 +387,13 @@ class DirectionPacker:
 
     def write_rule(self, rule_key: int, raw: _RawDirection) -> None:
         """Write ONE rule's raw extraction, attributing every cell,
-        group, and entry to ``rule_key`` for later removal. Callers
+        group ref, and entry to ``rule_key`` for later removal. Callers
         must call refresh_entry_views() after a batch."""
         self._attr_key = rule_key
         self.rule_cells.setdefault(rule_key, [])
-        self.rule_groups.setdefault(rule_key, []).extend(
-            range(self.n_groups, self.n_groups + len(raw.group_no_peers))
-        )
+        self.rule_groups.setdefault(rule_key, [])
         n_ent, n_l7 = len(self.entries), len(self.l7_list)
-        self._write(raw, group_offset=self.n_groups)
+        self._write(raw)
         self.rule_entries.setdefault(rule_key, []).extend(self.entries[n_ent:])
         self.rule_l7.setdefault(rule_key, []).extend(self.l7_list[n_l7:])
         self._attr_key = None
@@ -393,11 +415,17 @@ class DirectionPacker:
                 self._mat_by_name(name)[i, j] = 0
                 self.writes.append((name, i, j, 0))
         for g in self.rule_groups.pop(rule_key, []):
-            # groups are per-rule unique: disable outright (with its
-            # gpn/gpe/g7 cells cleared above the group can never pass)
-            if self.prog.group_no_peers[g]:
-                self.prog.group_no_peers[g] = False
-                self.writes.append(("group_no_peers", g, 0, 0))
+            # interned groups are shared: only the LAST contributor's
+            # removal deactivates the column (its gpn/gpe/g7 cells die
+            # via cell_refs; the id stays interned for reuse)
+            n = self.group_refs.get(g, 0) - 1
+            if n > 0:
+                self.group_refs[g] = n
+            else:
+                self.group_refs.pop(g, None)
+                if self.prog.group_no_peers[g]:
+                    self.prog.group_no_peers[g] = False
+                    self.writes.append(("group_no_peers", g, 0, 0))
         self.entries = _remove_occurrences(
             self.entries, self.rule_entries.pop(rule_key, [])
         )
@@ -441,14 +469,29 @@ class DirectionPacker:
         }
         if len(self.combo_id) + len(new_combos) > p.s1_mat.shape[1]:
             return False
-        if self.n_groups + len(raw.group_no_peers) > p.gpn_mat.shape[1]:
+        # probe group interning the same way _write will (existing
+        # signatures reuse their column; only genuinely new sigs grow)
+        local_gid: Dict[int, int] = {}
+        next_gid = len(self.group_sig)
+        probe_new: Dict[tuple, int] = {}
+        for sig, local in _iter_group_sigs(raw):
+            gid = self.group_sig.get(sig)
+            if gid is None:
+                gid = probe_new.get(sig)
+                if gid is None:
+                    gid = next_gid
+                    probe_new[sig] = gid
+                    next_gid += 1
+            local_gid[local] = gid
+        if next_gid > p.gpn_mat.shape[1]:
             return False
-        off = self.n_groups
         new_k7 = {
-            (l[0], pid_probe[(l[1], PROTO_TCP_N)], l[2] + off)
+            key
             for l in raw.l7_ports
+            if (key := (l[0], pid_probe[(l[1], PROTO_TCP_N)], local_gid[l[2]]))
+            not in self.k7_ids
         }
-        if len(self.k7_ids) + len(new_k7 - set(self.k7_ids)) > p.s7_mat.shape[1]:
+        if len(self.k7_ids) + len(new_k7) > p.s7_mat.shape[1]:
             return False
         max_sel = -1
         for s1, s2 in raw.deny + raw.allow:
@@ -481,12 +524,29 @@ class DirectionPacker:
             mat[i, j] = 1
             self.writes.append((name, i, j, 1))
 
-    def _write(self, raw: _RawDirection, group_offset: int) -> None:
+    def _write(self, raw: _RawDirection) -> None:
         p = self.prog
         for s1, s2 in raw.deny:
             self._set("deny", p.deny_mat, s1, s2)
         for s1, s2 in raw.allow:
             self._set("allow", p.allow_mat, s1, s2)
+
+        # intern this raw's local groups by signature → global ids
+        gmap: Dict[int, int] = {}
+        for sig, local in _iter_group_sigs(raw):
+            gid = self.group_sig.get(sig)
+            if gid is None:
+                gid = len(self.group_sig)
+                self.group_sig[sig] = gid
+            gmap[local] = gid
+            self.group_refs[gid] = self.group_refs.get(gid, 0) + 1
+            if self._attr_key is not None:
+                self.rule_groups[self._attr_key].append(gid)
+            no_peers = raw.group_no_peers[local]
+            if no_peers and not p.group_no_peers[gid]:
+                p.group_no_peers[gid] = True
+                self.writes.append(("group_no_peers", gid, 0, 1))
+        self.n_groups = len(self.group_sig)
 
         for subj, sid, port, proto, expl, group in raw.entries:
             pid = self._port(port, proto)
@@ -498,24 +558,20 @@ class DirectionPacker:
                 self._set("ee", p.ee_mat, k, sid)
             else:
                 self._set("en", p.en_mat, k, sid)
-            self.entries.append((subj, sid, port, proto, expl, group + group_offset))
+            self.entries.append((subj, sid, port, proto, expl, gmap[group]))
 
-        for i, no_peers in enumerate(raw.group_no_peers):
-            p.group_no_peers[group_offset + i] = no_peers
-            if no_peers:
-                self.writes.append(("group_no_peers", group_offset + i, 0, 1))
         for group, sid, expl in raw.gp:
             name, mat = ("gpe", p.gpe_mat) if expl else ("gpn", p.gpn_mat)
-            self._set(name, mat, sid, group + group_offset)
-        self.n_groups += len(raw.group_no_peers)
+            self._set(name, mat, sid, gmap[group])
 
         for subj, port, group in raw.l7_ports:
             pid = self._port(port, PROTO_TCP_N)
-            k = self.k7_ids.setdefault((subj, pid, group + group_offset), len(self.k7_ids))
+            gid = gmap[group]
+            k = self.k7_ids.setdefault((subj, pid, gid), len(self.k7_ids))
             self._set("s7", p.s7_mat, subj, k)
             self._set("p7", p.p7_mat, pid, k)
-            self._set("g7", p.g7_mat, group + group_offset, k)
-            self.l7_list.append((subj, port, group + group_offset))
+            self._set("g7", p.g7_mat, gid, k)
+            self.l7_list.append((subj, port, gid))
 
 
 def _merge_raws(raws: Sequence[_RawDirection]) -> _RawDirection:

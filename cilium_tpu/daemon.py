@@ -229,10 +229,13 @@ class Daemon:
         }
 
     def policy_delete(self, labels: Sequence[str]) -> Dict:
-        """DELETE /policy (daemon/policy.go PolicyDelete:253)."""
+        """DELETE /policy (daemon/policy.go PolicyDelete:253). A no-op
+        delete (nothing matched) skips regeneration and the state
+        save — upsert-style callers probe-delete before every add."""
         rev, deleted = self.repo.take_by_labels(parse_label_array(labels))
-        self._regenerate("policy delete")
-        self.save_state()
+        if deleted:
+            self._regenerate("policy delete")
+            self.save_state()
         return {"revision": rev, "deleted": len(deleted)}
 
     def policy_translate(self, translator) -> Dict:

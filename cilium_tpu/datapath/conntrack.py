@@ -310,7 +310,52 @@ class FlowConntrack:
             if n:
                 self.valid[stale] = False
                 self.version += 1
+            # Tombstones accumulate forever (ka stays) and each one
+            # keeps probe chains alive past it — sustained churn would
+            # erode the early-termination win back to full-width
+            # probing. Past 25% occupancy by tombstones, rehash the
+            # live entries into fresh arrays.
+            tombstones = int(((self.ka != _EMPTY) & ~self.valid).sum())
+            if tombstones > self.capacity // 4:
+                self._compact(now)
             return n
+
+    def _compact(self, now: float) -> None:
+        """Rebuild the table from its live entries (caller holds the
+        lock): tombstoned slots return to EMPTY, restoring ~1-probe
+        chains."""
+        live = np.nonzero(self.valid & (self.expires > now))[0]
+        ka, kb, kc = self.ka[live], self.kb[live], self.kc[live]
+        expires = self.expires[live]
+        packets = self.packets[live]
+        revnat = self.revnat[live]
+        self.ka[:] = _EMPTY
+        self.valid[:] = False
+        # re-place with the same probe discipline as create_batch
+        slots = self._probe_slots(ka, kb, kc)
+        placed = np.zeros(len(ka), bool)
+        for p in range(self.probes):
+            cand = slots[:, p]
+            want = (~placed) & ~self.valid[cand]
+            if not want.any():
+                continue
+            idx = np.nonzero(want)[0]
+            _, first = np.unique(cand[idx], return_index=True)
+            win = idx[first]
+            s = cand[win]
+            free = ~self.valid[s]
+            win, s = win[free], s[free]
+            self.ka[s] = ka[win]
+            self.kb[s] = kb[win]
+            self.kc[s] = kc[win]
+            self.valid[s] = True
+            self.expires[s] = expires[win]
+            self.packets[s] = packets[win]
+            self.revnat[s] = revnat[win]
+            placed[win] = True
+            if placed.all():
+                break
+        self.version += 1
 
     def flush(self) -> int:
         with self._lock:

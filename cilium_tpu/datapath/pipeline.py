@@ -132,6 +132,7 @@ def process_flows(
     block: int = 16384,  # measured-fastest lookup block (ops/lookup.py)
     levels: int = 4,
     prefilter: bool = True,
+    row_override: Optional[jnp.ndarray] = None,  # [B] int32, -1 = LPM
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """→ (verdict[B] int8, redirect[B] bool, counters [EP, 3] int32).
 
@@ -140,6 +141,11 @@ def process_flows(
     DESTINATION for egress (bpf_lxc.c:497 resolves dst identity).
     ``prefilter`` guards the XDP deny-trie stage — the reference runs
     it only on traffic entering the node (bpf_xdp.c), not on egress.
+    ``row_override`` carries the overlay path's identity-from-tunnel-
+    key (bpf_overlay.c: decap reads the security identity from the
+    encap key and trusts it over an ipcache walk): flows with a
+    non-negative row skip BOTH the identity LPM and the prefilter (the
+    XDP prefilter inspects outer headers, which decap already shed).
 
     counters[e] = (forwarded, dropped_policy, dropped_prefilter) — the
     metricsmap accumulation, computed with a one-hot matmul so the
@@ -151,6 +157,10 @@ def process_flows(
         denied_pf = jnp.zeros(peer_bytes.shape[0], jnp.bool_)
     hit = lpm_lookup(t.ip_child, t.ip_info, peer_bytes, levels=levels)
     peer_row = jnp.where(hit > 0, hit - 1, t.world_row)
+    if row_override is not None:
+        trusted = row_override >= 0
+        peer_row = jnp.where(trusted, row_override, peer_row)
+        denied_pf = denied_pf & ~trusted
     return _verdict_tail(
         t.policymap, denied_pf, peer_row, ep_idx, dport, proto, ep_count, block
     )
@@ -172,9 +182,10 @@ def process_flows_wide(
     ep_count: int = 1,
     block: int = 16384,
     prefilter: bool = True,
+    row_override: Optional[jnp.ndarray] = None,  # [B] int32, -1 = LPM
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """IPv4 fast path over the wide tries — semantics identical to
-    process_flows(levels=4)."""
+    process_flows(levels=4), including the overlay row_override."""
     if prefilter:
         denied_pf = lpm_lookup_wide(
             t.pf_root_info, t.pf_root_child, t.pf_sub_child, t.pf_sub_info,
@@ -187,6 +198,10 @@ def process_flows_wide(
         peer_u32,
     )
     peer_row = jnp.where(hit > 0, hit - 1, t.world_row)
+    if row_override is not None:
+        trusted = row_override >= 0
+        peer_row = jnp.where(trusted, row_override, peer_row)
+        denied_pf = denied_pf & ~trusted
     return _verdict_tail(
         t.policymap, denied_pf, peer_row, ep_idx, dport, proto, ep_count, block
     )
@@ -565,6 +580,7 @@ class DatapathPipeline:
         ingress: bool,
         family: int,
         pad_to: Optional[int] = None,
+        row_override: Optional[np.ndarray] = None,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
         t = self._tables[(direction, family)]
@@ -575,6 +591,11 @@ class DatapathPipeline:
             ep_idx = np.pad(ep_idx, (0, pad))
             dports = np.pad(dports, (0, pad))
             protos = np.pad(protos, (0, pad))
+            if row_override is not None:
+                row_override = np.pad(
+                    row_override, (0, pad), constant_values=-1
+                )
+        ro = None if row_override is None else jnp.asarray(row_override)
         if family == 4:
             b64 = peer_bytes.astype(np.uint32)
             peer_u32 = (
@@ -590,6 +611,7 @@ class DatapathPipeline:
                 ep_count=max(1, len(self._endpoints)),
                 # XDP prefilter guards traffic entering the node only
                 prefilter=ingress,
+                row_override=ro,
             )
         else:
             v, red, counters = process_flows(
@@ -601,6 +623,7 @@ class DatapathPipeline:
                 ep_count=max(1, len(self._endpoints)),
                 levels=16,
                 prefilter=ingress,
+                row_override=ro,
             )
         return (
             np.asarray(v)[:b],
@@ -620,12 +643,23 @@ class DatapathPipeline:
         family: int,
         peer_words: Optional[Tuple[np.ndarray, np.ndarray]] = None,
         want_rev_nat: bool = False,
+        tunnel_identities: Optional[np.ndarray] = None,
     ):
         self.rebuild()
         ep_idx = np.asarray(ep_idx, np.int32)
         dports = np.asarray(dports, np.int32)
         protos = np.asarray(protos, np.int32)
         b = peer_bytes.shape[0]
+
+        # Overlay path (bpf_overlay.c): decapped flows carry the peer's
+        # security identity in the tunnel key — trust it over the
+        # ipcache LPM when it resolves to a known device row; unknown
+        # or zero identities fall back to the LPM walk.
+        row_override: Optional[np.ndarray] = None
+        if tunnel_identities is not None:
+            row_override = self.engine.rows_or_negative(
+                np.asarray(tunnel_identities, np.int64)
+            )
 
         # --- LB stage (egress only): VIP→backend translate -------------
         # bpf_lxc.c:444-455 — the service lookup precedes conntrack and
@@ -665,7 +699,8 @@ class DatapathPipeline:
         if ct is None or sports is None:
             # No CT: full batch takes the device path (counters on MXU).
             v, red, counters = self._dispatch(
-                peer_bytes, ep_idx, dports, protos, ingress=ingress, family=family
+                peer_bytes, ep_idx, dports, protos, ingress=ingress,
+                family=family, row_override=row_override,
             )
             if svc_drop is not None and svc_drop.any():
                 v = v.copy()
@@ -730,6 +765,9 @@ class DatapathPipeline:
                 ingress=ingress,
                 family=family,
                 pad_to=_bucket(len(midx)),
+                row_override=(
+                    None if row_override is None else row_override[midx]
+                ),
             )
             if svc_drop is not None:
                 sd = svc_drop[midx]
@@ -800,6 +838,7 @@ class DatapathPipeline:
         ingress: bool = True,
         sports: Optional[np.ndarray] = None,
         return_rev_nat: bool = False,
+        tunnel_identities: Optional[np.ndarray] = None,
     ):
         """IPv4 batch → (verdicts [B] int8, redirect [B] bool);
         accumulates the per-endpoint counters. ``src_ips`` is the peer
@@ -808,7 +847,10 @@ class DatapathPipeline:
         pre-pass (established/reply bypass + creation on allow).
         ``return_rev_nat`` appends a [B] uint16 array of revNAT ids for
         reply-direction CT hits (0 otherwise) — resolve with
-        rev_nat_frontend() to restore the VIP on reply sources."""
+        rev_nat_frontend() to restore the VIP on reply sources.
+        ``tunnel_identities`` ([B] int, 0 = none) marks overlay-decapped
+        flows whose encap key carried the peer identity — trusted over
+        the ipcache LPM when known (bpf_overlay.c)."""
         src = np.asarray(src_ips)
         peer_bytes = ipv4_to_bytes(src)
         return self._process(
@@ -819,6 +861,7 @@ class DatapathPipeline:
                 src.astype(np.uint64),
             ),
             want_rev_nat=return_rev_nat,
+            tunnel_identities=tunnel_identities,
         )
 
     def process_v6(
@@ -831,11 +874,13 @@ class DatapathPipeline:
         ingress: bool = True,
         sports: Optional[np.ndarray] = None,
         return_rev_nat: bool = False,
+        tunnel_identities: Optional[np.ndarray] = None,
     ):
         """IPv6 batch (16-level LPM walk, bpf_lxc.c:848 tail_ipv6_*)."""
         return self._process(
             np.asarray(peer_bytes, np.int32), ep_idx, dports, protos, sports,
             ingress=ingress, family=6, want_rev_nat=return_rev_nat,
+            tunnel_identities=tunnel_identities,
         )
 
     def rev_nat_frontend(self, revnat_id: int):

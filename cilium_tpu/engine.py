@@ -459,6 +459,32 @@ class PolicyEngine:
         assert self._low_rows is not None
         return self._rows_snapshot(self._low_rows, self._high_rows, identity_ids)
 
+    def rows_or_negative(self, identity_ids: np.ndarray) -> np.ndarray:
+        """[B] device rows with -1 for unknown/invalid identities — the
+        tolerant variant for datapath inputs that CARRY an identity
+        (overlay tunnel keys) where an unknown value must fall back,
+        not raise."""
+        self.refresh()
+        with self._lock:
+            low = self._low_rows
+            high = dict(self._high_rows)
+        assert low is not None
+        ids = np.asarray(identity_ids, np.int64)
+        rows = np.full(ids.shape, -1, np.int32)
+        ok = (ids > 0) & (ids < low.size)
+        rows[ok] = low[ids[ok]]
+        hi = ids >= low.size
+        if hi.any():
+            # per-UNIQUE-id dict lookups, vectorized scatter: overlay
+            # tunnel keys commonly carry high-range (local/CIDR)
+            # identities and batches run to millions of flows
+            uniq, inv = np.unique(ids[hi], return_inverse=True)
+            vals = np.fromiter(
+                (high.get(int(u), -1) for u in uniq), np.int32, len(uniq)
+            )
+            rows[hi] = vals[inv]
+        return rows
+
     # ------------------------------------------------------------------
     def verdicts(
         self,

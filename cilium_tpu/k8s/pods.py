@@ -11,7 +11,7 @@ Daemon.endpoint_add — which here replaces the agent's REST PUT
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .constants import (
     POD_NAMESPACE_LABEL,
@@ -88,6 +88,13 @@ class PodOrchestrator:
         )
         self._pod_to_ep[key] = ep_id
         return ep_id
+
+    def known_pods(self) -> List[Tuple[str, str]]:
+        """(namespace, name) of every pod with a live endpoint — the
+        resync reconciliation input."""
+        return sorted(
+            tuple(key.split("/", 1)) for key in self._pod_to_ep
+        )
 
     def delete_pod(self, pod: dict) -> bool:
         ep_id = self._pod_to_ep.pop(self.pod_key(pod), None)

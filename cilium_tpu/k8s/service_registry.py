@@ -162,6 +162,28 @@ class ServiceRegistry:
     def observe(self, fn) -> None:
         self._observers.append(fn)
 
+    def service_ids(self) -> List[ServiceID]:
+        """All currently-known service ids (the resync reconciliation
+        input: ids absent from a re-list snapshot are stale)."""
+        with self._lock:
+            return sorted(
+                set(self.services) | set(self.endpoints),
+                key=lambda s: (s.namespace, s.name),
+            )
+
+    def known_service_ids(self) -> List[ServiceID]:
+        """Ids with a Service object (resync compares these against
+        the snapshot's Service kinds)."""
+        with self._lock:
+            return sorted(self.services, key=lambda s: (s.namespace, s.name))
+
+    def known_endpoints_ids(self) -> List[ServiceID]:
+        """Ids with an Endpoints object (resync compares these against
+        the snapshot's Endpoints kinds — Service and Endpoints are
+        separate k8s objects deleted independently)."""
+        with self._lock:
+            return sorted(self.endpoints, key=lambda s: (s.namespace, s.name))
+
     def _notify(self, event: str, sid: ServiceID) -> None:
         for fn in list(self._observers):
             fn(event, sid)

@@ -87,6 +87,13 @@ class K8sWatcher:
 
     # -- policy --------------------------------------------------------
     def add_policy_object(self, obj: Dict[str, Any]) -> int:
+        """Upsert semantics (k8s_watcher.go updates re-import under the
+        same provenance labels): a MODIFIED event or a re-list after
+        reconnect must replace the object's previous rules, never
+        accumulate duplicates."""
+        meta = obj.get("metadata") or {}
+        lbls = policy_labels(extract_namespace(meta), meta.get("name", ""))
+        self.daemon.policy_delete(lbls)
         rules = objects_to_rules([obj])
         rules = preprocess_rules(rules, self.services)
         return self.daemon.policy_add(rules_to_json(rules))["revision"]
@@ -118,6 +125,80 @@ class K8sWatcher:
             self._namespace_labels[meta.get("name", "")] = dict(meta.get("labels") or {})
         else:
             raise ValueError(f"unsupported object kind {kind!r}")
+
+    def resync(self, objects: Iterable[Dict[str, Any]]) -> None:
+        """Full-state reconciliation after a watch reconnect: the
+        informer re-lists and hands the COMPLETE current object set;
+        everything present is (re-)applied (upserts are idempotent)
+        and previously-known objects absent from the snapshot are
+        deleted — healing adds AND deletes missed while disconnected
+        (the cache-resync contract daemon/k8s_watcher.go relies on
+        client-go for)."""
+        objects = list(objects)
+
+        def key(o: Dict[str, Any]):
+            meta = o.get("metadata") or {}
+            return (
+                o.get("kind", ""),
+                meta.get("namespace") or "default",
+                meta.get("name", ""),
+            )
+
+        seen = {key(o) for o in objects}
+        # collect currently-known objects per kind
+        stale: List[Dict[str, Any]] = []
+        for r_labels in self._known_policy_labels():
+            if (
+                (KIND_CNP, r_labels[1], r_labels[0]) not in seen
+                and (KIND_NETWORK_POLICY, r_labels[1], r_labels[0]) not in seen
+            ):
+                stale.append({
+                    "kind": KIND_CNP,
+                    "metadata": {"name": r_labels[0], "namespace": r_labels[1]},
+                })
+        for sid in self.services.known_service_ids():
+            if (KIND_SERVICE, sid.namespace, sid.name) not in seen:
+                stale.append({
+                    "kind": KIND_SERVICE,
+                    "metadata": {"name": sid.name, "namespace": sid.namespace},
+                })
+        # Endpoints are deleted independently of their Service: a
+        # snapshot holding the Service but not its Endpoints means the
+        # backend set was removed while disconnected
+        for sid in self.services.known_endpoints_ids():
+            if (KIND_ENDPOINTS, sid.namespace, sid.name) not in seen:
+                stale.append({
+                    "kind": KIND_ENDPOINTS,
+                    "metadata": {"name": sid.name, "namespace": sid.namespace},
+                })
+        for pod in list(self.pods.known_pods()):
+            if (KIND_POD, pod[0], pod[1]) not in seen:
+                stale.append({
+                    "kind": KIND_POD,
+                    "metadata": {"name": pod[1], "namespace": pod[0]},
+                })
+        for obj in stale:
+            self.delete(obj)
+        for obj in objects:
+            self.apply(obj)
+
+    def _known_policy_labels(self) -> List[tuple]:
+        """(name, namespace) pairs of k8s-sourced rules currently in
+        the repository (by provenance labels)."""
+        from .constants import POLICY_LABEL_NAME, POLICY_LABEL_NAMESPACE, SOURCE_K8S
+
+        out = set()
+        with self.daemon.repo._lock:
+            for r in self.daemon.repo.rules:
+                name = ns = None
+                for l in r.labels.to_strings():
+                    if l.startswith(f"{SOURCE_K8S}:{POLICY_LABEL_NAME}="):
+                        name = l.split("=", 1)[1]
+                    elif l.startswith(f"{SOURCE_K8S}:{POLICY_LABEL_NAMESPACE}="):
+                        ns = l.split("=", 1)[1]
+                if name is not None and ns is not None:
+                    out.add((name, ns))
+        return sorted(out)
 
     def delete(self, obj: Dict[str, Any]) -> None:
         kind = obj.get("kind", "")

@@ -127,6 +127,10 @@ struct Conntrack {
   std::unique_ptr<std::atomic<uint64_t>[]> ka;
   std::vector<uint64_t> kb, kc;
   std::unique_ptr<std::atomic<uint64_t>[]> expires;  // monotonic ns
+  // bumped at the START of every flush: an insert that claimed its
+  // slot before the flush must not survive it (the entry's verdict
+  // basis predates the reload that triggered the flush)
+  std::atomic<uint64_t> flush_epoch{0};
   uint64_t mask = 0;
   uint64_t tcp_life_ns = 21600ull * 1000000000ull;
   uint64_t other_life_ns = 60ull * 1000000000ull;
@@ -188,6 +192,7 @@ struct Conntrack {
   }
 
   inline void insert(uint64_t a, uint64_t b, uint64_t c, uint64_t now) {
+    uint64_t epoch0 = flush_epoch.load(std::memory_order_acquire);
     uint64_t h = hash(a, b, c);
     for (int p = 0; p < kProbes; ++p) {
       uint64_t s = (h + p) & mask;
@@ -203,7 +208,20 @@ struct Conntrack {
       kb[s] = b;
       kc[s] = c;
       expires[s].store(now + life_ns(c), std::memory_order_relaxed);
-      ka[s].store(a, std::memory_order_release);
+      // publish via CAS: a concurrent flush stores kEmpty over our
+      // kBusy claim — failing here means "flushed, drop the entry"
+      uint64_t busy = kBusy;
+      if (!ka[s].compare_exchange_strong(busy, a,
+                                         std::memory_order_acq_rel))
+        return;
+      // the flush may also have swept this slot BEFORE we claimed it:
+      // an entry whose verdict basis predates the flush must not
+      // survive, so self-retract on an epoch move
+      if (flush_epoch.load(std::memory_order_acquire) != epoch0) {
+        uint64_t expect = a;
+        ka[s].compare_exchange_strong(expect, kEmpty,
+                                      std::memory_order_acq_rel);
+      }
       return;
     }
     // full neighborhood: drop (flow re-verdicts next packet)
@@ -211,6 +229,7 @@ struct Conntrack {
 
   void flush() {
     if (!enabled) return;
+    flush_epoch.fetch_add(1, std::memory_order_acq_rel);
     for (size_t i = 0; i <= mask; ++i)
       ka[i].store(kEmpty, std::memory_order_release);
   }

@@ -17,6 +17,7 @@ matmuls).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, List, Sequence, Tuple
 
 import jax
@@ -106,6 +107,61 @@ def materialize_endpoints(
     return st.tables, st.snapshots
 
 
+def _seg_bucket(n_seg: int) -> int:
+    b = 8
+    while b < n_seg:
+        b <<= 1
+    return b
+
+
+@functools.partial(jax.jit, static_argnames=("n", "ingress", "block"))
+def _sweep_device(
+    policy: DevicePolicy,
+    seg_row: jnp.ndarray,  # [n_seg] int32
+    seg_port: jnp.ndarray,
+    seg_proto: jnp.ndarray,
+    seg_l4: jnp.ndarray,  # [n_seg] bool
+    n: int,
+    ingress: bool,
+    block: int,
+):
+    """The endpoints × identities × slots sweep with the flattened
+    index arrays generated ON DEVICE and results bit-packed before
+    leaving it — the host⇄device traffic is [n_seg] in and
+    3 × [n_seg, n/32] out instead of 5 × [n_seg·n] in and
+    3 × [n_seg·n] out (the host-built repeat/tile arrays made the
+    sweep upload-bound: ~600MB at the 100k-identity stretch scale)."""
+    n_seg = seg_row.shape[0]
+    subj = jnp.repeat(seg_row, n)
+    peer = jnp.tile(jnp.arange(n, dtype=jnp.int32), n_seg)
+    v = verdict_batch(
+        policy,
+        subj,
+        peer,
+        jnp.repeat(seg_port, n),
+        jnp.repeat(seg_proto, n),
+        jnp.repeat(seg_l4, n),
+        ingress=ingress,
+        block=block,
+    )
+    allow = pack_bool_bits((v.decision == ALLOW).reshape(n_seg, n))
+    l3a = pack_bool_bits((v.l3 == 1).reshape(n_seg, n))
+    red = pack_bool_bits(v.l7_redirect.reshape(n_seg, n))
+    return allow, l3a, red
+
+
+def _unpack_rows(words: np.ndarray, n: int) -> np.ndarray:
+    """[n_seg, ceil(n/32)] uint32 → [n_seg, n] bool (pack_bool_bits
+    inverse, host-side)."""
+    words = np.ascontiguousarray(words)
+    bits = np.unpackbits(
+        words.view(np.uint8).reshape(words.shape[0], -1),
+        axis=1,
+        bitorder="little",
+    )
+    return bits[:, :n].astype(bool)
+
+
 def materialize_endpoints_state(
     compiled: CompiledPolicy,
     device: DevicePolicy,
@@ -140,20 +196,43 @@ def materialize_endpoints_state(
             seg_l4.append(True)
 
     n_seg = len(seg_row)
-    all_rows = np.arange(n, dtype=np.int32)
-    v = verdict_batch(
-        device,
-        jnp.asarray(np.repeat(np.asarray(seg_row, np.int32), n)),
-        jnp.asarray(np.tile(all_rows, n_seg)),
-        jnp.asarray(np.repeat(np.asarray(seg_port, np.int32), n)),
-        jnp.asarray(np.repeat(np.asarray(seg_proto, np.int32), n)),
-        jnp.asarray(np.repeat(np.asarray(seg_l4, bool), n)),
-        ingress=ingress,
-        block=block,
-    )
-    dec = np.asarray(v.decision).reshape(n_seg, n)
-    l3d = np.asarray(v.l3).reshape(n_seg, n)
-    red = np.asarray(v.l7_redirect).reshape(n_seg, n)
+    # Chunk the segment axis so one dispatch's flattened row count
+    # stays bounded (~big-batch sized) regardless of endpoint count ×
+    # identity capacity, then pad each chunk to a bucket (dummy L3
+    # segs against row 0) so repeated materializations reuse the
+    # compiled sweep.
+    budget = max(8, (1 << 23) // max(1, n))
+    seg_chunk = 1 << (budget.bit_length() - 1)  # power of two ≤ budget
+    seg_chunk = min(seg_chunk, _seg_bucket(n_seg))
+    aw_parts: List[np.ndarray] = []
+    l3_parts: List[np.ndarray] = []
+    rw_parts: List[np.ndarray] = []
+    sr = np.asarray(seg_row, np.int32)
+    sp = np.asarray(seg_port, np.int32)
+    spr = np.asarray(seg_proto, np.int32)
+    sl = np.asarray(seg_l4, bool)
+    for lo in range(0, n_seg, seg_chunk):
+        hi = min(lo + seg_chunk, n_seg)
+        pad = min(_seg_bucket(hi - lo), seg_chunk) - (hi - lo)
+        aw, l3w, rw = _sweep_device(
+            device,
+            jnp.asarray(np.pad(sr[lo:hi], (0, pad))),
+            jnp.asarray(np.pad(sp[lo:hi], (0, pad))),
+            jnp.asarray(np.pad(spr[lo:hi], (0, pad))),
+            jnp.asarray(np.pad(sl[lo:hi], (0, pad))),
+            n,
+            ingress,
+            block,
+        )
+        aw_parts.append(np.asarray(aw)[: hi - lo])
+        l3_parts.append(np.asarray(l3w)[: hi - lo])
+        rw_parts.append(np.asarray(rw)[: hi - lo])
+    if aw_parts:
+        allow_sn = _unpack_rows(np.concatenate(aw_parts), n)
+        l3_sn = _unpack_rows(np.concatenate(l3_parts), n)
+        red_sn = _unpack_rows(np.concatenate(rw_parts), n)
+    else:  # zero endpoints: nothing to sweep
+        allow_sn = l3_sn = red_sn = np.zeros((0, n), bool)
 
     # Column layout: one column per (endpoint, L3) + (endpoint, slot).
     col_ep: List[int] = []
@@ -166,7 +245,7 @@ def materialize_endpoints_state(
 
     seg = 0
     for e, row in enumerate(ep_rows):
-        l3_allow = (l3d[seg] == 1) & live
+        l3_allow = l3_sn[seg] & live
         seg += 1
         col_ep.append(e)
         col_port.append(0)
@@ -178,8 +257,8 @@ def materialize_endpoints_state(
         for r_idx in np.nonzero(l3_allow)[0]:
             entries[PolicyKey(int(compiled.row_ids[r_idx]), 0, 0, direction)] = 0
         for port, proto_n in ep_slots[e]:
-            allow = (dec[seg] == ALLOW) & live
-            redirect = red[seg] & live
+            allow = allow_sn[seg] & live
+            redirect = red_sn[seg] & live
             seg += 1
             col_ep.append(e)
             col_port.append(port)

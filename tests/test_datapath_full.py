@@ -475,3 +475,146 @@ class TestConntrackInvalidation:
         # no control-plane movement: entry survives across batches
         pipe.process(*args, ingress=True, sports=sp)
         assert len(pipe.conntrack) == 1
+
+
+class TestOverlayIdentity:
+    """Identity-from-tunnel-key (bpf_overlay.c): decapped flows trust
+    the encap key's identity over the ipcache LPM."""
+
+    def _world(self):
+        from cilium_tpu.engine import PolicyEngine
+        from cilium_tpu.identity import IdentityRegistry
+        from cilium_tpu.ipcache.ipcache import IPCache
+        from cilium_tpu.ipcache.prefilter import PreFilter
+        from cilium_tpu.labels import parse_label_array
+        from cilium_tpu.ops.lpm import ip_strings_to_u32
+        from cilium_tpu.policy.api import EndpointSelector, IngressRule, rule
+        from cilium_tpu.policy.repository import Repository
+
+        repo = Repository()
+        repo.add_list([rule(
+            ["k8s:app=web"],
+            ingress=[IngressRule(
+                from_endpoints=(EndpointSelector.make(["k8s:app=lb"]),),
+            )],
+            labels=["k8s:policy=o1"],
+        )])
+        reg = IdentityRegistry()
+        web = reg.allocate(parse_label_array(["k8s:app=web"]))
+        lb = reg.allocate(parse_label_array(["k8s:app=lb"]))
+        cache = IPCache()  # deliberately NO entry for the remote pod IP
+        pf = PreFilter()
+        pf.insert(pf.revision, ["203.0.113.0/24"])
+        pipe = DatapathPipeline(PolicyEngine(repo, reg), cache, pf)
+        pipe.set_endpoints([web.id])
+        return pipe, web, lb, ip_strings_to_u32
+
+    def test_tunnel_identity_trusted_over_lpm(self):
+        pipe, web, lb, to_u32 = self._world()
+        # remote pod 10.244.1.5 is unknown to the local ipcache → LPM
+        # says world → DROP; the tunnel key says lb → FORWARD
+        ips = to_u32(["10.244.1.5"])
+        eps = np.zeros(1, np.int32)
+        dports = np.zeros(1, np.int32)
+        protos = np.full(1, 6, np.int32)
+        v, _ = pipe.process(ips, eps, dports, protos, ingress=True)
+        assert v.tolist() == [DROP_POLICY]
+        v, _ = pipe.process(
+            ips, eps, dports, protos, ingress=True,
+            tunnel_identities=np.array([lb.id], np.int64),
+        )
+        assert v.tolist() == [FORWARD], "tunnel-key identity not trusted"
+
+    def test_unknown_tunnel_identity_falls_back_to_lpm(self):
+        pipe, web, lb, to_u32 = self._world()
+        pipe.ipcache.upsert("10.244.1.6/32", lb.id, source="kvstore")
+        ips = to_u32(["10.244.1.6"])
+        args = (ips, np.zeros(1, np.int32), np.zeros(1, np.int32),
+                np.full(1, 6, np.int32))
+        # identity 999999 was never allocated → fall back to the LPM,
+        # which resolves lb → FORWARD (never fail to world on a bad key)
+        v, _ = pipe.process(
+            *args, ingress=True,
+            tunnel_identities=np.array([999999], np.int64),
+        )
+        assert v.tolist() == [FORWARD]
+        # zero means "not an overlay flow" → plain LPM path
+        v, _ = pipe.process(
+            *args, ingress=True,
+            tunnel_identities=np.array([0], np.int64),
+        )
+        assert v.tolist() == [FORWARD]
+
+    def test_prefilter_skipped_for_decapped_flows(self):
+        """The XDP prefilter matches OUTER headers; a decapped inner
+        source landing in a deny CIDR must not be prefiltered when the
+        tunnel key vouches for it."""
+        pipe, web, lb, to_u32 = self._world()
+        ips = to_u32(["203.0.113.9"])  # inside the deny CIDR
+        args = (ips, np.zeros(1, np.int32), np.zeros(1, np.int32),
+                np.full(1, 6, np.int32))
+        v, _ = pipe.process(*args, ingress=True)
+        assert v.tolist() == [DROP_PREFILTER]
+        v, _ = pipe.process(
+            *args, ingress=True,
+            tunnel_identities=np.array([lb.id], np.int64),
+        )
+        assert v.tolist() == [FORWARD]
+
+    def test_tunnel_identity_with_conntrack_tail(self):
+        """Overlay identities must survive the CT-miss tail subsetting
+        (mixed batch: some established, some new overlay flows)."""
+        from cilium_tpu.datapath.conntrack import FlowConntrack
+
+        pipe, web, lb, to_u32 = self._world()
+        pipe.conntrack = FlowConntrack(capacity_bits=10)
+        ips = to_u32(["10.244.1.5", "10.244.1.7"])
+        eps = np.zeros(2, np.int32)
+        dports = np.zeros(2, np.int32)
+        protos = np.full(2, 6, np.int32)
+        sports = np.array([1111, 2222])
+        tids = np.array([lb.id, 0], np.int64)
+        v, _ = pipe.process(
+            ips, eps, dports, protos, ingress=True, sports=sports,
+            tunnel_identities=tids,
+        )
+        assert v.tolist() == [FORWARD, DROP_POLICY]
+        # flow 0 is now established; rerun keeps both verdicts stable
+        v, _ = pipe.process(
+            ips, eps, dports, protos, ingress=True, sports=sports,
+            tunnel_identities=tids,
+        )
+        assert v.tolist() == [FORWARD, DROP_POLICY]
+
+
+class TestConntrackCompaction:
+    def test_gc_compacts_tombstones(self):
+        """Sustained churn must not erode probing: past 25% tombstone
+        occupancy, gc() rehashes live entries and empties the rest."""
+        ct = FlowConntrack(capacity_bits=6, other_lifetime=0.005,
+                           tcp_lifetime=3600.0)
+        # one long-lived TCP flow that must survive compaction
+        ka_l, kb_l, kc_l = pack_keys(
+            np.zeros(1, np.uint64), np.array([42], np.uint64),
+            np.zeros(1, np.uint64), np.array([999], np.uint64),
+            np.array([80], np.uint64), np.array([6], np.uint64),
+            np.zeros(1, np.uint64),
+        )
+        ct.create_batch(ka_l, kb_l, kc_l)
+        # churn: waves of short-lived UDP flows → tombstones after gc
+        for wave in range(4):
+            n = 8
+            kb = np.arange(wave * n, wave * n + n, dtype=np.uint64) + 1000
+            ka, kbw, kc = pack_keys(
+                np.zeros(n, np.uint64), kb, np.zeros(n, np.uint64),
+                np.full(n, 2000, np.uint64), np.full(n, 53, np.uint64),
+                np.full(n, 17, np.uint64), np.ones(n, np.uint64),
+            )
+            ct.create_batch(ka, kbw, kc)
+            time.sleep(0.01)
+            ct.gc()
+        tombstones = int(((ct.ka != np.uint64(0xFFFFFFFFFFFFFFFF))
+                          & ~ct.valid).sum())
+        assert tombstones <= ct.capacity // 4, "compaction never ran"
+        # the live flow survived the rehash
+        assert ct.lookup_batch(ka_l, kb_l, kc_l)[0][0] == CT_ESTABLISHED

@@ -503,3 +503,142 @@ def test_pod_labels_include_namespace_meta():
     assert f"k8s:io.kubernetes.pod.namespace=ns1" in lbls
     assert "k8s:io.cilium.k8s.namespace.labels.team=alpha" in lbls
     assert "k8s:io.cilium.k8s.policy.serviceaccount=robot" in lbls
+
+
+# -------------------------------------------- watcher adapter boundary
+
+
+def _pod(name, ip, app, ns="shop"):
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": {"app": app}},
+        "status": {"podIP": ip},
+    }
+
+
+def _cnp(name, app_subject, app_peer, ns="shop"):
+    return {
+        "kind": "CiliumNetworkPolicy",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "endpointSelector": {"matchLabels": {"app": app_subject}},
+            "ingress": [{"fromEndpoints": [{"matchLabels": {"app": app_peer}}]}],
+        },
+    }
+
+
+def _svc(name, ip="10.96.0.50", ns="shop"):
+    return {
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"type": "ClusterIP", "clusterIP": ip,
+                 "ports": [{"port": 80, "protocol": "TCP"}]},
+    }
+
+
+def test_watcher_modified_event_replaces_rules(tmp_path):
+    """A MODIFIED event (or a replayed ADDED after reconnect) must
+    UPSERT under the object's provenance labels — duplicate imports of
+    the same CNP must not accumulate rules (k8s_watcher.go re-imports
+    under the same labels)."""
+    d = Daemon(state_dir=str(tmp_path / "state"))
+    w = K8sWatcher(d)
+    w.apply(_cnp("guard", "db", "web"))
+    n1 = len(d.repo)
+    w.apply(_cnp("guard", "db", "web"))  # watch replay: same object
+    assert len(d.repo) == n1, "replayed ADDED duplicated rules"
+    # MODIFIED: the peer changes; the OLD rule must be gone
+    w.apply(_cnp("guard", "db", "admin"))
+    assert len(d.repo) == n1
+    res = d.policy_resolve(
+        ["k8s:app=web", f"{NS}=shop"], ["k8s:app=db", f"{NS}=shop"]
+    )
+    assert res["verdict"] == "denied", "stale pre-update rule survived"
+    res = d.policy_resolve(
+        ["k8s:app=admin", f"{NS}=shop"], ["k8s:app=db", f"{NS}=shop"]
+    )
+    assert res["verdict"] == "allowed"
+
+
+def test_watcher_out_of_order_delete_then_add(tmp_path):
+    """Deletes arriving for never-seen (or already-deleted) objects
+    must be no-ops, and a late ADDED after a DELETED re-creates cleanly
+    — the at-least-once delivery contract of a watch stream."""
+    d = Daemon(state_dir=str(tmp_path / "state"))
+    w = K8sWatcher(d)
+    # delete before any add: no-op, no raise
+    w.delete(_cnp("guard", "db", "web"))
+    w.delete(_pod("web-1", "10.1.0.10", "web"))
+    assert len(d.repo) == 0 and len(d.endpoint_manager) == 0
+    w.apply(_cnp("guard", "db", "web"))
+    w.apply(_pod("web-1", "10.1.0.10", "web"))
+    w.delete(_cnp("guard", "db", "web"))
+    w.delete(_cnp("guard", "db", "web"))  # duplicate DELETED replay
+    assert len(d.repo) == 0
+    assert len(d.endpoint_manager) == 1
+
+
+def test_watcher_resync_heals_missed_events(tmp_path):
+    """Reconnect semantics: events missed while disconnected (both
+    adds and deletes) are healed by a full re-list resync — the
+    client-go cache.Resync contract the reference's watcher assumes."""
+    d = Daemon(state_dir=str(tmp_path / "state"))
+    w = K8sWatcher(d)
+    w.apply(_pod("web-1", "10.1.0.10", "web"))
+    w.apply(_pod("db-1", "10.1.0.20", "db"))
+    w.apply(_cnp("guard", "db", "web"))
+    w.apply(_cnp("doomed", "db", "other"))
+    w.apply(_svc("kafka"))
+    assert len(d.endpoint_manager) == 2
+
+    # -- disconnect: meanwhile the cluster deleted pod db-1, CNP
+    # "doomed", service kafka, and added pod api-1 + CNP "extra".
+    # The watcher saw NONE of those events; it reconnects and re-lists:
+    snapshot = [
+        _pod("web-1", "10.1.0.10", "web"),
+        _pod("api-1", "10.1.0.30", "api"),
+        _cnp("guard", "db", "web"),
+        _cnp("extra", "api", "web"),
+    ]
+    w.resync(snapshot)
+
+    # adds healed
+    assert len(d.endpoint_manager) == 2  # web-1 + api-1 (db-1 gone)
+    assert ("shop", "api-1") in w.pods.known_pods()
+    assert ("shop", "db-1") not in w.pods.known_pods()
+    # policy deletes healed: "doomed" gone, "guard"+"extra" present
+    known = {name for name, _ns in w._known_policy_labels()}
+    assert known == {"guard", "extra"}
+    # service delete healed
+    assert all(s.name != "kafka" for s in w.services.service_ids())
+    # idempotence: resyncing the same snapshot changes nothing
+    rules_before = len(d.repo)
+    w.resync(snapshot)
+    assert len(d.repo) == rules_before
+    assert len(d.endpoint_manager) == 2
+
+
+def test_watcher_resync_heals_stale_endpoints(tmp_path):
+    """Endpoints objects are deleted independently of their Service:
+    a snapshot keeping the Service but missing its Endpoints must
+    clear the stale backend set (k8s_watcher.go treats them as
+    separate informers)."""
+    d = Daemon(state_dir=str(tmp_path / "state"))
+    w = K8sWatcher(d)
+    w.apply(_svc("kafka"))
+    w.apply({
+        "kind": "Endpoints",
+        "metadata": {"name": "kafka", "namespace": "shop"},
+        "subsets": [{
+            "addresses": [{"ip": "10.1.0.40"}],
+            "ports": [{"port": 9092, "protocol": "TCP"}],
+        }],
+    })
+    sid = ServiceID("shop", "kafka")
+    assert w.services.get(sid)[1] is not None
+    # disconnect: the Endpoints object is deleted; re-list returns
+    # only the Service
+    w.resync([_svc("kafka")])
+    info, eps = w.services.get(sid)
+    assert info is not None, "service wrongly deleted"
+    assert eps is None, "stale Endpoints survived resync"

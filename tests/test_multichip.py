@@ -136,3 +136,62 @@ class TestShardingParity:
 
 def _world(seed: int):
     return _build_world(n_rules=48, n_idents=24, seed=seed, n_apps=12, n_zones=3)
+
+
+class TestDatapathSharding:
+    def test_lpm_policymap_chain_flow_sharded(self, mesh):
+        """The full datapath stage chain (prefilter LPM + identity LPM
+        + policymap lookup + counter matmul) over sharded flow batches
+        must match the replicated run bit-for-bit — certifying the
+        column-bitmap gather and both trie walks under GSPMD."""
+        from __graft_entry__ import (
+            _build_datapath_world,
+            _make_ip_flows,
+            make_sharded_datapath_step,
+        )
+
+        pipe, _engine, idents = _build_datapath_world(seed=3)
+        b = 128 * N_DEVICES
+        dp = make_sharded_datapath_step(pipe, b)
+        peer_u32, ep_idx, dport, proto = _make_ip_flows(idents, b, seed=4)
+        base = dp(
+            jnp.asarray(peer_u32), jnp.asarray(ep_idx),
+            jnp.asarray(dport), jnp.asarray(proto),
+        )
+        flow_sh = NamedSharding(mesh, P(("flows", "ident")))
+        sh = dp(*[jax.device_put(x, flow_sh)
+                  for x in (peer_u32, ep_idx, dport, proto)])
+        for a, s in zip(base, sh):
+            np.testing.assert_array_equal(np.asarray(s), np.asarray(a))
+        # forwarded, policy-dropped, and prefilter-dropped all present
+        assert len(set(np.asarray(base[0]).tolist())) >= 3
+
+    def test_materialize_sweep_ident_sharded(self, mesh):
+        """The endpoints × identities × slots materialization sweep
+        with sel_match sharded over identity rows."""
+        from cilium_tpu.ops.materialize import _sweep_device
+
+        engine, _ = _world(7)
+        compiled = engine._compiled
+        policy = engine.device_policy
+        n = int(compiled.id_bits.shape[0])
+        seg_row = np.asarray([0, 1, 2, 3, 0, 1, 2, 3], np.int32)
+        seg_port = np.asarray([0, 0, 0, 0, 80, 80, 443, 443], np.int32)
+        seg_proto = np.asarray([0, 0, 0, 0, 6, 6, 6, 6], np.int32)
+        seg_l4 = np.asarray([False] * 4 + [True] * 4)
+        base = _sweep_device(
+            policy, jnp.asarray(seg_row), jnp.asarray(seg_port),
+            jnp.asarray(seg_proto), jnp.asarray(seg_l4), n, True, 1024,
+        )
+        policy_sh = policy.replace(
+            sel_match=jax.device_put(
+                np.asarray(policy.sel_match),
+                NamedSharding(mesh, P("ident", None)),
+            )
+        )
+        sh = _sweep_device(
+            policy_sh, jnp.asarray(seg_row), jnp.asarray(seg_port),
+            jnp.asarray(seg_proto), jnp.asarray(seg_l4), n, True, 1024,
+        )
+        for a, s in zip(base, sh):
+            np.testing.assert_array_equal(np.asarray(s), np.asarray(a))
