@@ -345,6 +345,60 @@ def _bench_native(snaps, idents, nrng: np.random.Generator):
     return single, mt
 
 
+def _bench_native_e2e(snaps, idents, nrng: np.random.Generator):
+    """The native front-end's FULL per-node pipeline (conntrack probe →
+    identity LPM → policymap, bpf_lxc.c end to end) — (mixed_vps,
+    established_vps). 'Established' replays only allowed flows, the
+    kernel's CT-bypass steady state; this is the e2e number to hold
+    against the pure policymap-lookup rate (the reference amortizes the
+    LPM exactly this way via conntrack, bpf/lib/conntrack.h)."""
+    from cilium_tpu.identity.model import ID_WORLD
+    from cilium_tpu.ipcache.ipcache import IPCache
+    from cilium_tpu.native import NativeFastpath, native_available
+
+    if not native_available():
+        return 0.0, 0.0
+    cache = IPCache()
+    for i, ident in enumerate(idents):
+        cache.upsert(f"10.{(i >> 8) & 255}.{i & 255}.1/32", ident.id, source="k8s")
+    nf = NativeFastpath(ep_count=N_ENDPOINTS, ct_bits=22)
+    nf.set_world_identity(ID_WORLD)
+    nf.load_policy_snapshots(snaps)
+    nf.load_ipcache(cache)
+    b = 1 << 20
+    i_sel = nrng.integers(0, len(idents), b)
+    ips = (
+        np.uint32(10) << 24
+        | ((i_sel >> 8) & 255).astype(np.uint32) << 16
+        | (i_sel & 255).astype(np.uint32) << 8
+        | 1
+    ).astype(np.uint32)
+    eps = nrng.integers(0, N_ENDPOINTS, b).astype(np.int32)
+    dports = nrng.choice(np.array([80, 443, 8080, 53, 22], np.int32), b)
+    protos = np.where(dports == 53, 17, 6).astype(np.int32)
+    sports = nrng.integers(1024, 60000, b).astype(np.int32)
+    v, _ = nf.process(ips, eps, dports, protos, sports=sports)
+    iters = 5
+    t0 = time.time()
+    for _ in range(iters):
+        v, _ = nf.process(ips, eps, dports, protos, sports=sports)
+    mixed = iters * b / (time.time() - t0)
+    allow = v == 1
+    al = max(1, int(allow.sum()))
+    reps = b // al + 1
+    ips2 = np.tile(ips[allow], reps)[:b]
+    eps2 = np.tile(eps[allow], reps)[:b]
+    dp2 = np.tile(dports[allow], reps)[:b]
+    pr2 = np.tile(protos[allow], reps)[:b]
+    sp2 = np.tile(sports[allow], reps)[:b]
+    nf.process(ips2, eps2, dp2, pr2, sports=sp2)
+    t0 = time.time()
+    for _ in range(iters):
+        nf.process(ips2, eps2, dp2, pr2, sports=sp2)
+    est = iters * b / (time.time() - t0)
+    return mixed, est
+
+
 def _bench_native_l7() -> float:
     """Native L7 HTTP enforcement rate (DFA walk + rule chain in C++,
     the envoy/cilium_l7policy.cc role; SURVEY native census item 3)."""
@@ -600,6 +654,10 @@ def main() -> None:
         if extra else (0.0, {})
     )
     native_l7_rps = _bench_native_l7() if extra else 0.0
+    native_e2e_vps, native_e2e_est_vps = (
+        _bench_native_e2e(_snaps, idents, np.random.default_rng(9))
+        if extra else (0.0, 0.0)
+    )
     t0 = time.time()
     tables2, _ = materialize_endpoints(
         compiled, engine.device_policy, ep_ids, ingress=True
@@ -632,6 +690,8 @@ def main() -> None:
         "native_vps": round(native_vps),
         "native_vps_mt": {k: round(v) for k, v in native_mt.items()},
         "native_l7_rps": round(native_l7_rps),
+        "native_e2e_vps": round(native_e2e_vps),
+        "native_e2e_est_vps": round(native_e2e_est_vps),
         "rebuild_warm_s": round(rebuild_warm_s, 2),
         "stretch_100k": stretch,
     }

@@ -228,6 +228,19 @@ class Daemon:
             "rules": [rule_to_dict(r) for r in rules],
         }
 
+    def policy_replace(self, labels: Sequence[str], rules_json: str) -> Dict:
+        """Atomic upsert: swap the rules carrying ``labels`` for the
+        given rule set under one repository lock, then regenerate ONCE
+        — the MODIFIED-event path (no enforcement gap, no doubled
+        regeneration)."""
+        rules = rules_from_json(rules_json)
+        rev, n_deleted = self.repo.replace_by_labels(
+            parse_label_array(labels), rules
+        )
+        self._regenerate("policy replace")
+        self.save_state()
+        return {"revision": rev, "count": len(rules), "deleted": n_deleted}
+
     def policy_delete(self, labels: Sequence[str]) -> Dict:
         """DELETE /policy (daemon/policy.go PolicyDelete:253). A no-op
         delete (nothing matched) skips regeneration and the state

@@ -207,6 +207,112 @@ def process_flows_wide(
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("ep_count", "block", "prefilter", "levels", "family"),
+    donate_argnums=(1,),
+)
+def process_flows_ct(
+    t,  # WideDatapathTables (family 4) | DatapathTables (family 6)
+    ct,  # DeviceCTState — DONATED: updated in place on device
+    peer: jnp.ndarray,  # family 4: [B] uint32; family 6: [B, 16] int32
+    ep_idx: jnp.ndarray,
+    dport: jnp.ndarray,
+    proto: jnp.ndarray,
+    sport: jnp.ndarray,
+    direction: jnp.ndarray,  # [] int32 0 ingress / 1 egress
+    now: jnp.ndarray,  # [] int32 seconds (monotonic)
+    valid: jnp.ndarray,  # [B] bool — False for shape-bucket padding
+    ep_count: int = 1,
+    block: int = 16384,
+    prefilter: bool = True,
+    levels: int = 4,
+    family: int = 4,
+):
+    """The FUSED datapath step with device-resident conntrack: CT
+    probe (fwd + reply) → deny LPM → identity LPM → policymap lookup →
+    CT insert, ONE device program per batch (datapath/device_ct.py).
+    Established flows take FORWARD regardless of the policy stages —
+    the bpf/lib/conntrack.h bypass, computed branch-free (the verdict
+    stages run for every lane anyway; SIMD lanes are not saved by
+    host-side subsetting).
+
+    → (verdict [B] int8, redirect [B] bool, counters [EP, 3] int32,
+    new_ct_state)."""
+    from .device_ct import _ct_step_impl, pack_kc_words
+
+    if family == 4:
+        denied_pf = (
+            lpm_lookup_wide(
+                t.pf_root_info, t.pf_root_child, t.pf_sub_child,
+                t.pf_sub_info, peer,
+            ) > 0
+            if prefilter
+            else jnp.zeros(peer.shape[0], jnp.bool_)
+        )
+        hit = lpm_lookup_wide(
+            t.ip_root_info, t.ip_root_child, t.ip_sub_child, t.ip_sub_info,
+            peer,
+        )
+        z = jnp.zeros_like(peer)
+        ka_w, kb_w = (z, z), (z, peer)
+    else:
+        denied_pf = (
+            lpm_lookup(t.pf_child, t.pf_info, peer, levels=levels) > 0
+            if prefilter
+            else jnp.zeros(peer.shape[0], jnp.bool_)
+        )
+        hit = lpm_lookup(t.ip_child, t.ip_info, peer, levels=levels)
+        b32 = peer.astype(jnp.uint32)
+
+        def word(i):
+            return (
+                (b32[:, i] << 24) | (b32[:, i + 1] << 16)
+                | (b32[:, i + 2] << 8) | b32[:, i + 3]
+            )
+
+        ka_w, kb_w = (word(0), word(4)), (word(8), word(12))
+    peer_row = jnp.where(hit > 0, hit - 1, t.world_row)
+    dec, red = lookup_batch(
+        t.policymap, ep_idx, peer_row, dport, proto, block=block
+    )
+    policy_fwd = dec == jnp.int8(FORWARD)
+    # padded lanes must never create CT state (their zero-keys would
+    # otherwise become real, long-lived entries)
+    allow_new = policy_fwd & ~denied_pf & ~red & valid
+
+    kc_w = pack_kc_words(
+        ep_idx, sport, dport, proto, jnp.broadcast_to(direction, ep_idx.shape)
+    )
+    new_ct, established = _ct_step_impl(
+        ct, ka_w, kb_w, kc_w, proto, now, allow_new
+    )
+
+    verdict = jnp.where(
+        established,
+        jnp.int8(FORWARD),
+        jnp.where(denied_pf, jnp.int8(DROP_PREFILTER), dec),
+    )
+    redirect = red & ~denied_pf & ~established
+
+    ep_oh = (ep_idx[:, None] == jnp.arange(ep_count)[None, :]).astype(jnp.int8)
+    cls = (
+        jnp.stack(
+            [
+                verdict == FORWARD,
+                verdict == DROP_POLICY,
+                verdict == DROP_PREFILTER,
+            ],
+            axis=1,
+        )
+        & valid[:, None]
+    ).astype(jnp.int8)
+    counters = jax.lax.dot_general(
+        ep_oh, cls, (((0,), (0,)), ((), ())), preferred_element_type=jnp.int32
+    )
+    return verdict, redirect, counters, new_ct
+
+
 def _bucket(n: int, floor: int = 1024) -> int:
     """Next power-of-two ≥ n (min ``floor``) — shape buckets so the
     CT-miss tail reuses compiled XLA programs."""
@@ -214,6 +320,24 @@ def _bucket(n: int, floor: int = 1024) -> int:
     while b < n:
         b <<= 1
     return b
+
+
+def _pack_v4_u32(peer_bytes: np.ndarray) -> np.ndarray:
+    """[B, 4] address bytes → [B] uint32 host-order (the wide-trie
+    query word). One definition for every dispatch path."""
+    b = peer_bytes.astype(np.uint32)
+    return (b[:, 0] << 24) | (b[:, 1] << 16) | (b[:, 2] << 8) | b[:, 3]
+
+
+def _pad_flows(pad: int, peer_bytes, *arrays, row_override=None):
+    """Zero-pad a flow batch's arrays to a shape bucket (row_override
+    pads with -1: padded lanes must derive-by-LPM, never trust)."""
+    if pad:
+        peer_bytes = np.pad(peer_bytes, ((0, pad), (0, 0)))
+        arrays = tuple(np.pad(a, (0, pad)) for a in arrays)
+        if row_override is not None:
+            row_override = np.pad(row_override, (0, pad), constant_values=-1)
+    return (peer_bytes, *arrays, row_override)
 
 
 class DatapathPipeline:
@@ -230,11 +354,24 @@ class DatapathPipeline:
         conntrack: Optional[FlowConntrack] = None,
         lb=None,  # Optional[lb.service.ServiceManager]
         monitor=None,  # Optional[monitor.hub.MonitorHub]
+        device_ct_bits: Optional[int] = None,
     ) -> None:
         self.engine = engine
         self.ipcache = ipcache
         self.prefilter = prefilter or PreFilter()
         self.conntrack = conntrack
+        # Device-resident conntrack (datapath/device_ct.py): the CT
+        # table lives in HBM and the whole batch runs as ONE fused
+        # device program. Takes precedence over the host CT for flows
+        # it can serve; falls back to the host path when an LB table
+        # is active for the batch's family+direction (VIP translation
+        # precedes CT and is host-fused today).
+        self._device_ct_bits = device_ct_bits
+        self._device_ct = None  # lazily-created DeviceCTState
+        if device_ct_bits is not None and self.conntrack is None and lb is not None:
+            # LB batches fall back to the host CT domain; without one
+            # they would silently lose conntrack entirely
+            self.conntrack = FlowConntrack(capacity_bits=max(10, device_ct_bits))
         self.lb = lb
         self.monitor = monitor
         # called for every redirect verdict with a known 5-tuple:
@@ -284,6 +421,7 @@ class DatapathPipeline:
             # endpoint's established-flow bypass entries.
             if self.conntrack is not None:
                 self.conntrack.flush()
+            self._device_ct = None
 
     def endpoint_index(self, endpoint_id: int) -> Optional[int]:
         try:
@@ -396,10 +534,10 @@ class DatapathPipeline:
             # flow is a single batched dispatch). Uses the versions
             # captured BEFORE the reads so a mutation landing mid-build
             # flushes again on the next rebuild rather than slipping by.
-            if self.conntrack is not None and (
-                mat_fresh or saw_row_event or basis_moved
-            ):
-                self.conntrack.flush()
+            if mat_fresh or saw_row_event or basis_moved:
+                if self.conntrack is not None:
+                    self.conntrack.flush()
+                self._device_ct = None  # zeroed on next use
 
             # LB tables: deterministic per-flow backend selection means
             # backend churn changes the translated CT key (natural
@@ -412,6 +550,7 @@ class DatapathPipeline:
                 self._lb_version = lb_ver
                 if self.conntrack is not None:
                     self.conntrack.flush()
+                self._device_ct = None
 
             assert self._tries is not None and self._mat
             v4, v6, world = self._tries
@@ -586,22 +725,13 @@ class DatapathPipeline:
         t = self._tables[(direction, family)]
         b = peer_bytes.shape[0]
         if pad_to is not None and pad_to > b:
-            pad = pad_to - b
-            peer_bytes = np.pad(peer_bytes, ((0, pad), (0, 0)))
-            ep_idx = np.pad(ep_idx, (0, pad))
-            dports = np.pad(dports, (0, pad))
-            protos = np.pad(protos, (0, pad))
-            if row_override is not None:
-                row_override = np.pad(
-                    row_override, (0, pad), constant_values=-1
-                )
+            peer_bytes, ep_idx, dports, protos, row_override = _pad_flows(
+                pad_to - b, peer_bytes, ep_idx, dports, protos,
+                row_override=row_override,
+            )
         ro = None if row_override is None else jnp.asarray(row_override)
         if family == 4:
-            b64 = peer_bytes.astype(np.uint32)
-            peer_u32 = (
-                (b64[:, 0] << 24) | (b64[:, 1] << 16)
-                | (b64[:, 2] << 8) | b64[:, 3]
-            )
+            peer_u32 = _pack_v4_u32(peer_bytes)
             v, red, counters = process_flows_wide(
                 t,
                 jnp.asarray(peer_u32),
@@ -694,6 +824,25 @@ class DatapathPipeline:
                     revnat_vals = np.asarray(rv).astype(np.uint16)
                     svc_drop = nobk
                     peer_words = None  # address changed — repack for CT
+
+        # ── device-resident conntrack: ONE fused program per batch ──
+        # Host fallbacks: any family with an active LB table (BOTH
+        # directions — the CT is one bidirectional structure; an
+        # egress VIP flow's entry must be visible to its ingress
+        # reply, so the two directions must share a CT domain) and
+        # overlay tunnel identities.
+        if (
+            self._device_ct_bits is not None
+            and sports is not None
+            and svc_drop is None
+            and row_override is None
+            and (self.lb is None or self._lb_tables.get(family) is None)
+        ):
+            return self._process_device_ct(
+                peer_bytes, ep_idx, dports, protos,
+                np.asarray(sports, np.int32),
+                ingress=ingress, family=family, want_rev_nat=want_rev_nat,
+            )
 
         ct = self.conntrack
         if ct is None or sports is None:
@@ -825,6 +974,77 @@ class DatapathPipeline:
             rev = ct.revnat_of(slot)
             rev[state != CT_REPLY] = 0
             return verdict, redirect, rev
+        return verdict, redirect
+
+    def _process_device_ct(
+        self,
+        peer_bytes: np.ndarray,
+        ep_idx: np.ndarray,
+        dports: np.ndarray,
+        protos: np.ndarray,
+        sports: np.ndarray,
+        *,
+        ingress: bool,
+        family: int,
+        want_rev_nat: bool,
+    ):
+        """Dispatch through the fused device-CT program and thread the
+        donated CT state forward."""
+        import time as _time
+
+        from .device_ct import make_state
+
+        direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
+        t = self._tables[(direction, family)]
+        b = peer_bytes.shape[0]
+        pad = _bucket(b) - b
+        valid = np.zeros(b + pad, bool)
+        valid[:b] = True
+        peer_bytes, ep_idx, dports, protos, sports, _ = _pad_flows(
+            pad, peer_bytes, ep_idx, dports, protos, sports
+        )
+        peer = _pack_v4_u32(peer_bytes) if family == 4 else peer_bytes
+        now = jnp.asarray(np.int32(_time.monotonic()))
+        with self._lock:
+            if self._device_ct is None:
+                self._device_ct = make_state(self._device_ct_bits)
+            state = self._device_ct
+            v, red, counters, new_state = process_flows_ct(
+                t,
+                state,
+                jnp.asarray(peer),
+                jnp.asarray(ep_idx),
+                jnp.asarray(dports),
+                jnp.asarray(protos),
+                jnp.asarray(sports),
+                jnp.asarray(np.int32(0 if ingress else 1)),
+                now,
+                jnp.asarray(valid),
+                ep_count=max(1, len(self._endpoints)),
+                prefilter=ingress,
+                levels=16,
+                family=family,
+            )
+            self._device_ct = new_state
+            counters = np.asarray(counters)
+            if self.counters.shape == counters.shape:
+                self.counters += counters
+        verdict = np.asarray(v)[:b]
+        redirect = np.asarray(red)[:b]
+        if self.on_redirect is not None and redirect.any():
+            for i in np.nonzero(redirect)[0]:
+                self.on_redirect(
+                    bytes(int(x) & 0xFF for x in peer_bytes[i]),
+                    int(ep_idx[i]), int(sports[i]), int(dports[i]),
+                    int(protos[i]), ingress, family,
+                )
+        self._emit_flow_events(
+            peer_bytes[:b], ep_idx[:b], dports[:b], protos[:b], verdict,
+            ingress=ingress, family=family, redirect=redirect,
+        )
+        if want_rev_nat:
+            # no LB table was active on this path (fallback condition)
+            return verdict, redirect, np.zeros(b, np.uint16)
         return verdict, redirect
 
     # ------------------------------------------------------------------

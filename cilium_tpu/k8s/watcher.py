@@ -90,13 +90,14 @@ class K8sWatcher:
         """Upsert semantics (k8s_watcher.go updates re-import under the
         same provenance labels): a MODIFIED event or a re-list after
         reconnect must replace the object's previous rules, never
-        accumulate duplicates."""
+        accumulate duplicates. The replace is atomic (one repository
+        lock hold, one regeneration) — no window with the object's
+        rules absent."""
         meta = obj.get("metadata") or {}
         lbls = policy_labels(extract_namespace(meta), meta.get("name", ""))
-        self.daemon.policy_delete(lbls)
         rules = objects_to_rules([obj])
         rules = preprocess_rules(rules, self.services)
-        return self.daemon.policy_add(rules_to_json(rules))["revision"]
+        return self.daemon.policy_replace(lbls, rules_to_json(rules))["revision"]
 
     def delete_policy_object(self, obj: Dict[str, Any]) -> int:
         meta = obj.get("metadata") or {}

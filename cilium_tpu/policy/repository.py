@@ -168,6 +168,36 @@ class Repository:
                 self._log_op("delete", (labels, tuple(deleted)))
             return self._revision, deleted
 
+    def replace_by_labels(
+        self, labels: LabelArray, rules: Sequence[Rule]
+    ) -> Tuple[int, int]:
+        """Atomically swap every rule carrying ``labels`` for
+        ``rules`` under ONE lock hold — no window where the object has
+        no rules (the upsert the k8s watcher needs for MODIFIED
+        events; reference: repository replace-by-labels on re-import).
+        Returns (revision, n_deleted). Logged as a delete op + an add
+        op at consecutive revisions so incremental compilers retract
+        then append without a full rebuild."""
+        for r in rules:
+            r.sanitize()
+        with self._lock:
+            kept: List[Rule] = []
+            deleted: List[Rule] = []
+            for r in self.rules:
+                if len(labels) and all(r.labels.has(l) for l in labels):
+                    deleted.append(r)
+                else:
+                    kept.append(r)
+            self.rules = kept
+            if deleted:
+                self._bump()
+                self._log_op("delete", (labels, tuple(deleted)))
+            self.rules = self.rules + list(rules)
+            if rules:
+                self._bump()
+                self._log_op("add", tuple(rules))
+            return self._revision, len(deleted)
+
     def translate_rules(self, translator) -> Tuple[int, int]:
         """Run a rule translator (e.g. k8s ToServices→ToCIDR,
         pkg/policy.Translator / repository.go TranslateRules) over every

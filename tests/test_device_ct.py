@@ -1,0 +1,296 @@
+"""Device-resident conntrack: the fused CT+policy dispatch must match
+the host-CT pipeline flow-for-flow (established bypass, reply-tuple
+recognition, deny-never-cached, flush-on-basis-move).
+
+Reference analog: bpf/lib/conntrack.h probed in the same program as
+the policy lookup — here the same fusion on the device (ONE program:
+CT probe → LPM → policymap → CT insert; datapath/device_ct.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cilium_tpu.datapath.conntrack import FlowConntrack
+from cilium_tpu.datapath.pipeline import (
+    DROP_POLICY,
+    DROP_PREFILTER,
+    FORWARD,
+    DatapathPipeline,
+)
+from cilium_tpu.engine import PolicyEngine
+from cilium_tpu.identity import IdentityRegistry
+from cilium_tpu.ipcache.ipcache import IPCache
+from cilium_tpu.ipcache.prefilter import PreFilter
+from cilium_tpu.labels import parse_label_array
+from cilium_tpu.ops.lpm import ip_strings_to_u32, ipv6_to_bytes
+from cilium_tpu.policy.api import (
+    EgressRule,
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    rule,
+)
+from cilium_tpu.policy.repository import Repository
+
+
+def _worlds():
+    """Two pipelines over the SAME world: host CT and device CT."""
+    def build(device: bool):
+        repo = Repository()
+        repo.add_list([
+            rule(
+                ["k8s:app=web"],
+                ingress=[IngressRule(
+                    from_endpoints=(EndpointSelector.make(["k8s:app=lb"]),),
+                    to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),)),),
+                )],
+                egress=[EgressRule(
+                    to_endpoints=(EndpointSelector.make(["k8s:app=db"]),),
+                    to_ports=(PortRule(ports=(PortProtocol(5432, "TCP"),)),),
+                )],
+                labels=["k8s:policy=d0"],
+            ),
+        ])
+        reg = IdentityRegistry()
+        web = reg.allocate(parse_label_array(["k8s:app=web"]))
+        lb = reg.allocate(parse_label_array(["k8s:app=lb"]))
+        db = reg.allocate(parse_label_array(["k8s:app=db"]))
+        cache = IPCache()
+        cache.upsert("10.0.0.2/32", lb.id, source="k8s")
+        cache.upsert("10.0.0.3/32", db.id, source="k8s")
+        cache.upsert("fd00::2/128", lb.id, source="k8s")
+        pf = PreFilter()
+        pf.insert(pf.revision, ["192.0.2.0/24"])
+        pipe = DatapathPipeline(
+            PolicyEngine(repo, reg), cache, pf,
+            conntrack=None if device else FlowConntrack(capacity_bits=12),
+            device_ct_bits=10 if device else None,
+        )
+        pipe.set_endpoints([web.id])
+        return pipe, repo, dict(web=web, lb=lb, db=db)
+
+    return build(False), build(True)
+
+
+def _flows(n, seed=0):
+    rng = np.random.default_rng(seed)
+    pool = ip_strings_to_u32(["10.0.0.2", "10.0.0.3", "192.0.2.7", "8.8.8.8"])
+    ips = pool[rng.integers(0, len(pool), n)].astype(np.uint32)
+    eps = np.zeros(n, np.int32)
+    dports = rng.choice(np.array([80, 443, 5432], np.int32), n)
+    protos = np.full(n, 6, np.int32)
+    sports = rng.integers(1024, 60000, n).astype(np.int32)
+    return ips, eps, dports, protos, sports
+
+
+class TestParityWithHostCT:
+    def test_random_batches_match_host_ct(self):
+        (hp, _, _), (dp, _, _) = _worlds()
+        for seed in range(3):
+            ips, eps, dports, protos, sports = _flows(256, seed)
+            hv, hr = hp.process(ips, eps, dports, protos,
+                                ingress=True, sports=sports)
+            dv, dr = dp.process(ips, eps, dports, protos,
+                                ingress=True, sports=sports)
+            np.testing.assert_array_equal(hv, dv)
+            np.testing.assert_array_equal(hr, dr)
+        assert {FORWARD, DROP_POLICY, DROP_PREFILTER} <= set(hv.tolist())
+
+    def test_established_bypass_survives_batches(self):
+        _, (dp, _, ids) = _worlds()
+        ips = ip_strings_to_u32(["10.0.0.2"])
+        args = (ips, np.zeros(1, np.int32), np.array([80], np.int32),
+                np.full(1, 6, np.int32))
+        sp = np.array([7777], np.int32)
+        v1, _ = dp.process(*args, ingress=True, sports=sp)
+        v2, _ = dp.process(*args, ingress=True, sports=sp)
+        assert v1.tolist() == [FORWARD] and v2.tolist() == [FORWARD]
+        assert dp.counters[0, 0] == 2
+
+    def test_reply_direction_forwards(self):
+        _, (dp, _, ids) = _worlds()
+        db_ip = ip_strings_to_u32(["10.0.0.3"])
+        # egress web → db:5432 (allowed, creates device CT state)
+        v, _ = dp.process(
+            db_ip, np.zeros(1, np.int32), np.array([5432], np.int32),
+            np.full(1, 6, np.int32), ingress=False,
+            sports=np.array([40000], np.int32),
+        )
+        assert v.tolist() == [FORWARD]
+        # ingress reply from db with swapped ports: policy would DROP
+        # (web ingress only allows lb:80); the reply tuple forwards
+        v, _ = dp.process(
+            db_ip, np.zeros(1, np.int32), np.array([40000], np.int32),
+            np.full(1, 6, np.int32), ingress=True,
+            sports=np.array([5432], np.int32),
+        )
+        assert v.tolist() == [FORWARD], "device CT missed the reply tuple"
+
+    def test_denied_flow_never_cached(self):
+        _, (dp, _, _) = _worlds()
+        ips = ip_strings_to_u32(["8.8.8.8"])
+        args = (ips, np.zeros(1, np.int32), np.array([80], np.int32),
+                np.full(1, 6, np.int32))
+        for i in range(3):
+            v, _ = dp.process(*args, ingress=True,
+                              sports=np.array([6000 + i], np.int32))
+            assert v.tolist() == [DROP_POLICY]
+
+    def test_redirect_flows_not_cached(self):
+        """L7-redirect verdicts must never enter CT (a bypass would
+        route later packets around the proxy)."""
+        from cilium_tpu.policy.api import HTTPRule, L7Rules
+
+        repo = Repository()
+        repo.add_list([rule(
+            ["k8s:app=web"],
+            ingress=[IngressRule(
+                from_endpoints=(EndpointSelector.make(["k8s:app=lb"]),),
+                to_ports=(PortRule(
+                    ports=(PortProtocol(80, "TCP"),),
+                    rules=L7Rules(http=(HTTPRule(path="/x"),)),
+                ),),
+            )],
+        )])
+        reg = IdentityRegistry()
+        web = reg.allocate(parse_label_array(["k8s:app=web"]))
+        lb = reg.allocate(parse_label_array(["k8s:app=lb"]))
+        cache = IPCache()
+        cache.upsert("10.0.0.2/32", lb.id, source="k8s")
+        dp = DatapathPipeline(
+            PolicyEngine(repo, reg), cache, PreFilter(), device_ct_bits=10
+        )
+        dp.set_endpoints([web.id])
+        ips = ip_strings_to_u32(["10.0.0.2"])
+        args = (ips, np.zeros(1, np.int32), np.array([80], np.int32),
+                np.full(1, 6, np.int32))
+        for i in range(3):
+            v, red = dp.process(*args, ingress=True,
+                                sports=np.array([9999], np.int32))
+            assert v.tolist() == [FORWARD] and red.tolist() == [True], (
+                f"packet {i}: redirect flow took a CT bypass"
+            )
+
+    def test_rule_change_flushes_device_ct(self):
+        (_, _, _), (dp, repo, ids) = _worlds()
+        ips = ip_strings_to_u32(["10.0.0.2"])
+        args = (ips, np.zeros(1, np.int32), np.array([80], np.int32),
+                np.full(1, 6, np.int32))
+        sp = np.array([4242], np.int32)
+        v, _ = dp.process(*args, ingress=True, sports=sp)
+        assert v.tolist() == [FORWARD]
+        repo.delete_by_labels(parse_label_array(["k8s:policy=d0"]))
+        v, _ = dp.process(*args, ingress=True, sports=sp)
+        assert v.tolist() == [DROP_POLICY], (
+            "established bypass survived a rule delete"
+        )
+
+    def test_v6_device_ct(self):
+        _, (dp, _, _) = _worlds()
+        peers = ipv6_to_bytes(["fd00::2"]).astype(np.int32)
+        args = (peers, np.zeros(1, np.int32), np.array([80], np.int32),
+                np.full(1, 6, np.int32))
+        sp = np.array([5151], np.int32)
+        v1, _ = dp.process_v6(*args, ingress=True, sports=sp)
+        v2, _ = dp.process_v6(*args, ingress=True, sports=sp)
+        assert v1.tolist() == [FORWARD] and v2.tolist() == [FORWARD]
+        # reply direction over v6
+        v, _ = dp.process_v6(
+            peers, np.zeros(1, np.int32),
+            np.array([5151], np.int32), np.full(1, 6, np.int32),
+            ingress=False, sports=np.array([80], np.int32),
+        )
+        assert v.tolist() == [FORWARD]
+
+
+class TestKcPacking:
+    def test_pack_flip_roundtrip_matches_host(self):
+        """The 32-bit-halved kc packing and reply flip must agree with
+        the host pack_keys/flip_kc bit layout."""
+        import jax.numpy as jnp
+
+        from cilium_tpu.datapath.conntrack import flip_kc, pack_keys
+        from cilium_tpu.datapath.device_ct import (
+            _flip_kc_words,
+            pack_kc_words,
+        )
+
+        rng = np.random.default_rng(0)
+        n = 512
+        ep = rng.integers(0, 64, n)
+        sp = rng.integers(0, 65536, n)
+        dp_ = rng.integers(0, 65536, n)
+        pr = rng.choice([6, 17], n)
+        dr = rng.integers(0, 2, n)
+        _, _, kc = pack_keys(
+            np.zeros(n, np.uint64), np.zeros(n, np.uint64),
+            ep.astype(np.uint64), sp.astype(np.uint64),
+            dp_.astype(np.uint64), pr.astype(np.uint64),
+            dr.astype(np.uint64),
+        )
+        hi, lo = pack_kc_words(
+            jnp.asarray(ep, jnp.int32), jnp.asarray(sp, jnp.int32),
+            jnp.asarray(dp_, jnp.int32), jnp.asarray(pr, jnp.int32),
+            jnp.asarray(dr, jnp.int32),
+        )
+        joined = (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | (
+            np.asarray(lo).astype(np.uint64)
+        )
+        np.testing.assert_array_equal(joined, kc)
+        fhi, flo = _flip_kc_words(hi, lo)
+        fjoined = (np.asarray(fhi).astype(np.uint64) << np.uint64(32)) | (
+            np.asarray(flo).astype(np.uint64)
+        )
+        np.testing.assert_array_equal(fjoined, flip_kc(kc))
+
+
+class TestLBFallback:
+    def test_lb_family_uses_one_host_ct_domain_both_directions(self):
+        """With an active LB table, BOTH directions must share the
+        host CT domain: an egress VIP flow's entry has to be visible
+        to its ingress reply (revNAT + reply bypass)."""
+        from cilium_tpu.lb import Backend, L3n4Addr, ServiceManager
+
+        repo = Repository()
+        repo.add_list([rule(
+            ["k8s:app=web"],
+            egress=[EgressRule(
+                to_endpoints=(EndpointSelector.make(["k8s:app=db"]),),
+                to_ports=(PortRule(ports=(PortProtocol(8080, "TCP"),)),),
+            )],
+        )])
+        reg = IdentityRegistry()
+        web = reg.allocate(parse_label_array(["k8s:app=web"]))
+        db = reg.allocate(parse_label_array(["k8s:app=db"]))
+        cache = IPCache()
+        cache.upsert("10.0.0.3/32", db.id, source="k8s")
+        lbm = ServiceManager()
+        lbm.upsert(L3n4Addr("10.96.0.10", 80, "TCP"),
+                   [Backend("10.0.0.3", 8080)])
+        dp = DatapathPipeline(
+            PolicyEngine(repo, reg), cache, PreFilter(),
+            lb=lbm, device_ct_bits=10,
+        )
+        dp.set_endpoints([web.id])
+        assert dp.conntrack is not None, "no host CT fallback for LB flows"
+        vip = ip_strings_to_u32(["10.96.0.10"])
+        v, _, rev = dp.process(
+            vip, np.zeros(1, np.int32), np.array([80], np.int32),
+            np.full(1, 6, np.int32), ingress=False,
+            sports=np.array([4000], np.int32), return_rev_nat=True,
+        )
+        assert v.tolist() == [FORWARD]
+        # reply: backend → client, ingress, swapped ports — must hit
+        # the SAME CT domain and carry the revNAT id back
+        be = ip_strings_to_u32(["10.0.0.3"])
+        v, _, rev = dp.process(
+            be, np.zeros(1, np.int32), np.array([4000], np.int32),
+            np.full(1, 6, np.int32), ingress=True,
+            sports=np.array([8080], np.int32), return_rev_nat=True,
+        )
+        assert v.tolist() == [FORWARD], "reply lost across CT domains"
+        assert int(rev[0]) > 0, "revNAT id lost across CT domains"
+        assert dp.rev_nat_frontend(int(rev[0])).ip == "10.96.0.10"
