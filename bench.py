@@ -384,7 +384,11 @@ def _bench_native_e2e(snaps, idents, nrng: np.random.Generator):
         v, _ = nf.process(ips, eps, dports, protos, sports=sports)
     mixed = iters * b / (time.time() - t0)
     allow = v == 1
-    al = max(1, int(allow.sum()))
+    al = int(allow.sum())
+    if al == 0:
+        # nothing allowed → no established set to replay; reporting a
+        # rate from zero-length batches would be nonsense
+        return mixed, 0.0
     reps = b // al + 1
     ips2 = np.tile(ips[allow], reps)[:b]
     eps2 = np.tile(eps[allow], reps)[:b]

@@ -101,6 +101,11 @@ class Daemon:
         )
         self.endpoint_manager = EndpointManager()
         self.proxy = Proxy()
+        # boot-time capability probes on a daemon thread (the
+        # run_probes.sh-at-boot analog; status() peeks, never blocks)
+        from . import probes as _probes
+
+        _probes.probe_in_background()
         # datapath state maps (pkg/maps/{lxcmap,tunnel,proxymap})
         self.ipam = IPAM(pod_cidr)
         self.lxcmap = LXCMap()
@@ -768,7 +773,30 @@ class Daemon:
             "ipam_allocated": len(self.ipam),
             "lxcmap_entries": len(self.lxcmap),
             "tunnel_entries": len(self.tunnel),
+            # node capability probe summary (run_probes.sh role):
+            # subsystems running degraded are named, not crashed-on.
+            # Non-blocking: the probe set runs on a boot thread (the
+            # first native probe can pay a g++ compile), so status
+            # answers "still probing" instead of stalling the RPC.
+            "features_degraded": (
+                peeked.get("degraded", [])
+                if (peeked := self._peek_features()) is not None
+                else ["probing"]
+            ),
         }
+
+    def _peek_features(self):
+        from . import probes
+
+        return probes.peek_features()
+
+    def features(self) -> Dict:
+        """Node capability probes (probes.py; bpf/run_probes.sh role).
+        Blocks until the probe set completes (explicit callers want
+        the answer; status() uses the non-blocking peek)."""
+        from . import probes
+
+        return probes.probe_features()
 
     def metrics_text(self) -> str:
         return metrics.registry.expose()

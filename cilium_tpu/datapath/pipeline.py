@@ -368,9 +368,10 @@ class DatapathPipeline:
         # precedes CT and is host-fused today).
         self._device_ct_bits = device_ct_bits
         self._device_ct = None  # lazily-created DeviceCTState
-        if device_ct_bits is not None and self.conntrack is None and lb is not None:
-            # LB batches fall back to the host CT domain; without one
-            # they would silently lose conntrack entirely
+        if device_ct_bits is not None and self.conntrack is None:
+            # Batches the device CT cannot serve (active LB tables,
+            # overlay tunnel identities) fall back to the host CT
+            # domain; without one they would silently lose conntrack
             self.conntrack = FlowConntrack(capacity_bits=max(10, device_ct_bits))
         self.lb = lb
         self.monitor = monitor

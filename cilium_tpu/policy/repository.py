@@ -146,26 +146,31 @@ class Repository:
         rev, deleted = self.take_by_labels(labels)
         return rev, len(deleted)
 
+    def _take_locked(self, labels: LabelArray) -> List[Rule]:
+        """Remove + return every rule carrying all ``labels`` (caller
+        holds the lock). Logs the delete op with the removed Rule
+        objects themselves: incremental compilers retract exactly
+        these (their cell attribution is keyed by object identity)."""
+        kept: List[Rule] = []
+        deleted: List[Rule] = []
+        for r in self.rules:
+            if len(labels) and all(r.labels.has(l) for l in labels):
+                deleted.append(r)
+            else:
+                kept.append(r)
+        self.rules = kept
+        if deleted:
+            self._bump()
+            self._log_op("delete", (labels, tuple(deleted)))
+        return deleted
+
     def take_by_labels(self, labels: LabelArray) -> Tuple[int, List[Rule]]:
         """delete_by_labels returning the removed rules themselves —
         callers tracking derived state (prefix-length counter) need
         the exact rule set removed under THIS lock hold, not a
         separately computed snapshot that can race a concurrent add."""
         with self._lock:
-            kept: List[Rule] = []
-            deleted: List[Rule] = []
-            for r in self.rules:
-                if len(labels) and all(r.labels.has(l) for l in labels):
-                    deleted.append(r)
-                else:
-                    kept.append(r)
-            self.rules = kept
-            if deleted:
-                self._bump()
-                # payload carries the removed Rule objects themselves:
-                # incremental compilers retract exactly these (their
-                # cell attribution is keyed by object identity)
-                self._log_op("delete", (labels, tuple(deleted)))
+            deleted = self._take_locked(labels)
             return self._revision, deleted
 
     def replace_by_labels(
@@ -181,17 +186,7 @@ class Repository:
         for r in rules:
             r.sanitize()
         with self._lock:
-            kept: List[Rule] = []
-            deleted: List[Rule] = []
-            for r in self.rules:
-                if len(labels) and all(r.labels.has(l) for l in labels):
-                    deleted.append(r)
-                else:
-                    kept.append(r)
-            self.rules = kept
-            if deleted:
-                self._bump()
-                self._log_op("delete", (labels, tuple(deleted)))
+            deleted = self._take_locked(labels)
             self.rules = self.rules + list(rules)
             if rules:
                 self._bump()

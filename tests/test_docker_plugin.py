@@ -1,0 +1,115 @@
+"""Docker libnetwork plugin: the full ADD lifecycle over the real
+plugin socket (Activate → RequestPool → RequestAddress →
+CreateEndpoint → Join → Leave → DeleteEndpoint → ReleaseAddress).
+
+Reference: /root/reference/plugins/cilium-docker/driver/ — remote
+NetworkDriver + IpamDriver over /run/docker/plugins JSON POSTs.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+
+import pytest
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.plugins.docker import DockerPlugin
+
+
+class _UnixHTTP(http.client.HTTPConnection):
+    def __init__(self, path: str):
+        super().__init__("localhost")
+        self._path = path
+
+    def connect(self):
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.connect(self._path)
+
+
+def _call(sock_path: str, method: str, body=None):
+    c = _UnixHTTP(sock_path)
+    payload = json.dumps(body or {})
+    c.request("POST", f"/{method}", body=payload,
+              headers={"Content-Type": "application/json"})
+    resp = c.getresponse()
+    out = json.loads(resp.read().decode())
+    c.close()
+    return out
+
+
+@pytest.fixture
+def plugin(tmp_path):
+    d = Daemon(state_dir=str(tmp_path / "state"))
+    sock = str(tmp_path / "cilium-docker.sock")
+    p = DockerPlugin(d, sock).start()
+    yield d, sock
+    p.stop()
+
+
+def test_activate_and_capabilities(plugin):
+    _d, sock = plugin
+    assert _call(sock, "Plugin.Activate") == {
+        "Implements": ["NetworkDriver", "IpamDriver"]
+    }
+    assert _call(sock, "NetworkDriver.GetCapabilities") == {"Scope": "local"}
+    spaces = _call(sock, "IpamDriver.GetDefaultAddressSpaces")
+    assert spaces["LocalDefaultAddressSpace"] == "CiliumLocal"
+
+
+def test_full_container_lifecycle(plugin):
+    d, sock = plugin
+    pool = _call(sock, "IpamDriver.RequestPool", {"AddressSpace": "CiliumLocal"})
+    assert pool["PoolID"] == "CiliumPoolv4"
+    assert pool["Pool"] == str(d.ipam.net)
+
+    addr = _call(sock, "IpamDriver.RequestAddress", {"PoolID": pool["PoolID"]})
+    ip = addr["Address"].split("/")[0]
+    assert d.ipam.owner_of(ip) == "docker"
+
+    eid = "deadbeef" * 8
+    _call(sock, "NetworkDriver.CreateNetwork", {"NetworkID": "net1"})
+    r = _call(sock, "NetworkDriver.CreateEndpoint", {
+        "NetworkID": "net1", "EndpointID": eid,
+        "Interface": {"Address": addr["Address"]},
+    })
+    assert "Err" not in r
+
+    join = _call(sock, "NetworkDriver.Join", {
+        "NetworkID": "net1", "EndpointID": eid, "SandboxKey": "/proc/1/ns/net",
+    })
+    assert join["InterfaceName"]["DstPrefix"] == "eth"
+    # the daemon registered a real endpoint with the allocated address
+    eps = d.endpoint_list()
+    assert any(e["ipv4"] == ip for e in eps), eps
+
+    _call(sock, "NetworkDriver.Leave", {"NetworkID": "net1", "EndpointID": eid})
+    assert not any(e["ipv4"] == ip for e in d.endpoint_list())
+
+    _call(sock, "NetworkDriver.DeleteEndpoint", {"EndpointID": eid})
+    _call(sock, "IpamDriver.ReleaseAddress",
+          {"PoolID": pool["PoolID"], "Address": addr["Address"]})
+    assert d.ipam.owner_of(ip) is None
+
+
+def test_errors_ride_the_err_field(plugin):
+    _d, sock = plugin
+    r = _call(sock, "NetworkDriver.Join", {"EndpointID": "unknown"})
+    assert "Err" in r and "unknown endpoint" in r["Err"]
+    r = _call(sock, "NoSuch.Method")
+    assert "Err" in r
+    r = _call(sock, "IpamDriver.RequestPool", {"V6": True})
+    assert "Err" in r and "IPv6" in r["Err"]
+
+
+def test_specific_address_request(plugin):
+    d, sock = plugin
+    base = d.ipam.net.network_address + 100
+    r = _call(sock, "IpamDriver.RequestAddress",
+              {"PoolID": "CiliumPoolv4", "Address": str(base)})
+    assert r["Address"].split("/")[0] == str(base)
+    # double-allocation reports through Err
+    r = _call(sock, "IpamDriver.RequestAddress",
+              {"PoolID": "CiliumPoolv4", "Address": str(base)})
+    assert "Err" in r

@@ -308,3 +308,60 @@ def test_service_ids_global_via_kvstore():
     assert s1.id == s2.id  # same frontend ⇒ same cluster-global id
     s3 = m2.upsert(L3n4Addr("10.96.0.11", 80, "TCP"), [])
     assert s3.id != s1.id  # distinct frontends never collide
+
+
+class TestLBOnlyMode:
+    """Standalone LB datapath (bpf_lb.c role): translate + forward,
+    no policy engine in the loop."""
+
+    def _world(self):
+        from cilium_tpu.datapath.conntrack import FlowConntrack
+        from cilium_tpu.datapath.lb_only import (
+            DROP_NO_SERVICE,
+            FORWARD,
+            LBOnlyDatapath,
+        )
+        from cilium_tpu.lb import Backend, L3n4Addr, ServiceManager
+
+        lbm = ServiceManager()
+        lbm.upsert(L3n4Addr("10.96.0.10", 80, "TCP"),
+                   [Backend("10.0.0.3", 8080, weight=1),
+                    Backend("10.0.0.4", 8080, weight=1)])
+        lbm.upsert(L3n4Addr("10.96.0.99", 53, "UDP"), [])
+        dp = LBOnlyDatapath(lbm, FlowConntrack(capacity_bits=10))
+        return dp, lbm, FORWARD, DROP_NO_SERVICE
+
+    def test_translate_passthrough_and_drop(self):
+        import numpy as np
+
+        from cilium_tpu.ops.lpm import ip_strings_to_u32
+
+        dp, lbm, FORWARD, DROP_NO_SERVICE = self._world()
+        ips = ip_strings_to_u32(["10.96.0.10", "10.96.0.99", "8.8.8.8"])
+        dports = np.array([80, 53, 443], np.int32)
+        protos = np.array([6, 17, 6], np.int32)
+        sports = np.array([1000, 1001, 1002], np.int32)
+        nd, npo, v, rev = dp.process(ips, dports, protos, sports)
+        assert v.tolist() == [FORWARD, DROP_NO_SERVICE, FORWARD]
+        be = ip_strings_to_u32(["10.0.0.3", "10.0.0.4"])
+        assert int(nd[0]) in be.tolist() and int(npo[0]) == 8080
+        assert int(nd[2]) == int(ips[2]) and int(npo[2]) == 443  # untouched
+        assert int(rev[0]) > 0 and int(rev[2]) == 0
+
+    def test_affinity_and_reply_revnat(self):
+        import numpy as np
+
+        from cilium_tpu.ops.lpm import ip_strings_to_u32
+
+        dp, lbm, FORWARD, _ = self._world()
+        vip = ip_strings_to_u32(["10.96.0.10"])
+        args = (vip, np.array([80], np.int32), np.array([6], np.int32),
+                np.array([4242], np.int32))
+        nd1, np1, _, rev1 = dp.process(*args)
+        nd2, np2, _, _ = dp.process(*args)
+        assert int(nd1[0]) == int(nd2[0]), "flow affinity broken"
+        # reply from the backend: restore the VIP on the source
+        ns, nsp = dp.rev_nat(
+            nd1, np1, np.array([4242], np.int64), np.array([6], np.int64)
+        )
+        assert int(ns[0]) == int(vip[0]) and int(nsp[0]) == 80

@@ -162,3 +162,27 @@ class TestDaemonWiring:
         assert out == [{"prefix": "10.2.0.0/24", "nexthop": "192.168.0.2",
                         "device": "cilium_vxlan", "mtu": 1450}]
         d.shutdown()
+
+
+class TestProbes:
+    """Node capability probes (probes.py = bpf/run_probes.sh role)."""
+
+    def test_probe_features_shape_and_cache(self):
+        from cilium_tpu import probes
+
+        probes.reset_cache()
+        f1 = probes.probe_features()
+        assert f1["device"]["ok"] and f1["device"]["device_count"] >= 1
+        assert f1["kvstore_sqlite"] is True
+        assert f1["l7_dfa"] is True
+        assert isinstance(f1["degraded"], list)
+        assert f1 is probes.probe_features()  # cached
+
+    def test_daemon_status_surfaces_degradation(self, tmp_path):
+        from cilium_tpu.daemon import Daemon
+
+        d = Daemon(state_dir=str(tmp_path / "s"))
+        st = d.status()
+        assert "features_degraded" in st
+        feats = d.features()
+        assert "native_fastpath" in feats and "device" in feats
