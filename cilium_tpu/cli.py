@@ -200,6 +200,13 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--launch-proxy", action="store_true",
                    help="spawn + supervise the external L7 proxy "
                         "process (python -m cilium_tpu.proxy)")
+    d.add_argument("--k8s-api", default=None, metavar="URL",
+                   help="apiserver base URL: LIST + WATCH NetworkPolicy/"
+                        "CNP/Service/Endpoints/Pod/Namespace and apply "
+                        "them (pkg/k8s client + informer loop)")
+    d.add_argument("--k8s-token-file", default=None,
+                   help="bearer-token file for --k8s-api (the in-cluster "
+                        "ServiceAccount pattern)")
 
     # status / metrics
     sub.add_parser("status", help="agent status")
@@ -376,6 +383,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             proxy_launcher = ProxyLauncher(
                 args.socket + ".xds", args.socket + ".accesslog"
             ).start()
+        informer = None
+        if args.k8s_api:
+            from .k8s import K8sWatcher
+            from .k8s.client import APIServerClient, Informer
+
+            token = None
+            if args.k8s_token_file:
+                with open(args.k8s_token_file) as f:
+                    token = f.read().strip()
+            informer = Informer(
+                APIServerClient(args.k8s_api, token=token),
+                K8sWatcher(daemon),
+            ).start()
+            # the reference blocks on cache sync before serving
+            # (daemon/main.go:843-856); an unsynced start is loudly
+            # flagged rather than silently serving empty k8s state
+            if not informer.wait_synced(timeout=30.0):
+                print("WARNING: k8s cache not synced after 30s — "
+                      "serving with partial state; the informer keeps "
+                      "retrying in the background")
         daemon.fqdn_start()  # ToFQDNs DNS poll loop (daemon/main.go:808)
         if daemon.health.nodes is not None:
             # node prober (daemon/main.go:927-945) — only meaningful
@@ -389,6 +416,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         try:
             server.serve_forever()
         except KeyboardInterrupt:
+            if informer is not None:
+                informer.stop()
             if proxy_launcher is not None:
                 proxy_launcher.stop()
             if accesslog_rx is not None:

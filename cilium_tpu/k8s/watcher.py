@@ -14,16 +14,20 @@ from __future__ import annotations
 
 import json
 import pathlib
+import threading
 from typing import Any, Dict, Iterable, List
 
 from ..labels import parse_label_array
 from ..policy.api.serialization import rule_from_dict, rules_to_json
+from ..utils.logging import get_logger
 from .cnp import parse_cnp
 from .constants import extract_namespace, policy_labels
 from .network_policy import parse_network_policy
 from .pods import PodOrchestrator
 from .rule_translate import preprocess_rules
 from .service_registry import ServiceRegistry
+
+log = get_logger("k8s-watcher")
 
 KIND_NETWORK_POLICY = "NetworkPolicy"
 KIND_CNP = "CiliumNetworkPolicy"
@@ -80,6 +84,11 @@ class K8sWatcher:
         self.pods = PodOrchestrator(daemon)
         self._namespace_labels: Dict[str, Dict[str, str]] = {}
         self.pods.namespace_labels = self._namespace_labels
+        # One lock serializes apply/delete/resync: the informer runs a
+        # watch thread per kind, and a resync's stale scan must not
+        # interleave with another kind's live applies (an object added
+        # between the scan's snapshot and its deletes would be reaped)
+        self._apply_lock = threading.RLock()
         # Service churn retriggers ToServices translation of rules that
         # are already imported (k8s_watcher.go serviceModFn →
         # RuleTranslator over the repository).
@@ -112,6 +121,10 @@ class K8sWatcher:
 
     # -- dispatch ------------------------------------------------------
     def apply(self, obj: Dict[str, Any]) -> None:
+        with self._apply_lock:
+            self._apply_locked(obj)
+
+    def _apply_locked(self, obj: Dict[str, Any]) -> None:
         kind = obj.get("kind", "")
         if kind in (KIND_NETWORK_POLICY, KIND_CNP):
             self.add_policy_object(obj)
@@ -134,8 +147,14 @@ class K8sWatcher:
         and previously-known objects absent from the snapshot are
         deleted — healing adds AND deletes missed while disconnected
         (the cache-resync contract daemon/k8s_watcher.go relies on
-        client-go for)."""
-        objects = list(objects)
+        client-go for). Serialized against live applies; one malformed
+        object is logged and skipped, never allowed to abort the whole
+        reconciliation (client-go isolates handler errors the same
+        way)."""
+        with self._apply_lock:
+            self._resync_locked(list(objects))
+
+    def _resync_locked(self, objects: List[Dict[str, Any]]) -> None:
 
         def key(o: Dict[str, Any]):
             meta = o.get("metadata") or {}
@@ -178,10 +197,41 @@ class K8sWatcher:
                     "kind": KIND_POD,
                     "metadata": {"name": pod[1], "namespace": pod[0]},
                 })
+        # namespaces: reaped only when the snapshot covers the kind at
+        # all (a snapshot from an informer not watching Namespace must
+        # not wipe the label cache)
+        if any(o.get("kind") == KIND_NAMESPACE for o in objects):
+            for ns_name in list(self._namespace_labels):
+                if (KIND_NAMESPACE, "default", ns_name) not in seen and (
+                    KIND_NAMESPACE, ns_name, ns_name
+                ) not in seen:
+                    stale.append({
+                        "kind": KIND_NAMESPACE,
+                        "metadata": {"name": ns_name},
+                    })
         for obj in stale:
-            self.delete(obj)
+            try:
+                self._delete_locked(obj)
+            except Exception:
+                log.warning("resync delete failed", fields={
+                    "kind": obj.get("kind"),
+                    "name": (obj.get("metadata") or {}).get("name"),
+                })
         for obj in objects:
-            self.apply(obj)
+            # placeholders assert presence only — applying one would
+            # wipe the real spec
+            if obj.get("__placeholder__"):
+                continue
+            try:
+                self._apply_locked(obj)
+            except Exception as e:
+                # one poisoned object must not block ingestion of the
+                # rest (or the initial sync would never complete)
+                log.warning("resync apply failed", fields={
+                    "kind": obj.get("kind"),
+                    "name": (obj.get("metadata") or {}).get("name"),
+                    "err": f"{type(e).__name__}: {e}",
+                })
 
     def _known_policy_labels(self) -> List[tuple]:
         """(name, namespace) pairs of k8s-sourced rules currently in
@@ -202,6 +252,10 @@ class K8sWatcher:
         return sorted(out)
 
     def delete(self, obj: Dict[str, Any]) -> None:
+        with self._apply_lock:
+            self._delete_locked(obj)
+
+    def _delete_locked(self, obj: Dict[str, Any]) -> None:
         kind = obj.get("kind", "")
         if kind in (KIND_NETWORK_POLICY, KIND_CNP):
             self.delete_policy_object(obj)
@@ -221,5 +275,8 @@ class K8sWatcher:
             )
         elif kind == KIND_POD:
             self.pods.delete_pod(obj)
+        elif kind == KIND_NAMESPACE:
+            meta = obj.get("metadata") or {}
+            self._namespace_labels.pop(meta.get("name", ""), None)
         else:
             raise ValueError(f"unsupported object kind {kind!r}")
