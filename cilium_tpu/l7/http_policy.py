@@ -27,6 +27,11 @@ from ..policy.api import HTTPRule
 from .regex_compile import MultiDFA, RegexError, compile_patterns
 
 
+# below this many strings the device DFA dispatch costs more than a
+# host table walk (and may trigger a first-use jit compile mid-request)
+_DEVICE_BATCH_MIN = 32
+
+
 class NativeL7Unsupported(ValueError):
     """This policy needs host-side evaluation (demoted regex / header
     matchers) and must not be offloaded to the native enforcer."""
@@ -129,10 +134,18 @@ class _PatternSet:
         out = np.zeros(n, np.uint64)
         if self.dfa is not None:
             encs = [v.encode() for v in values]
-            raw = match_patterns(self.dfa, encs, max_len)
-            for i, enc in enumerate(encs):
-                if len(enc) > max_len:
-                    raw[i] = np.uint64(self.dfa.match_str(enc))
+            if n < _DEVICE_BATCH_MIN:
+                # per-request proxy checks are latency-bound: a device
+                # dispatch (worst case: first-use jit compile) for a
+                # handful of strings loses to a linear host table walk
+                raw = np.fromiter(
+                    (self.dfa.match_str(e) for e in encs), np.uint64, n
+                )
+            else:
+                raw = match_patterns(self.dfa, encs, max_len)
+                for i, enc in enumerate(encs):
+                    if len(enc) > max_len:
+                        raw[i] = np.uint64(self.dfa.match_str(enc))
             if len(self.dfa_pids) == len(self.patterns):
                 out = raw  # identity mapping (no demotions)
             else:
