@@ -30,6 +30,7 @@ import urllib.parse
 import urllib.request
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from ..utils.backoff import Backoff
 from ..utils.logging import get_logger
 
 log = get_logger("k8s-client")
@@ -53,10 +54,17 @@ class APIServerClient:
         base_url: str,
         token: Optional[str] = None,
         timeout: float = 10.0,
+        watch_read_timeout: float = 60.0,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
+        # a watch stream with no traffic for this long is treated as a
+        # dead connection (half-open TCP after a partition would
+        # otherwise block the watch thread forever); real apiservers
+        # are additionally asked to end the watch server-side first
+        # via timeoutSeconds, so a healthy-but-idle watch ends cleanly
+        self.watch_read_timeout = watch_read_timeout
 
     def _open(self, path: str, query: Dict[str, str], stream: bool = False):
         url = f"{self.base_url}/{path}"
@@ -65,8 +73,13 @@ class APIServerClient:
         req = urllib.request.Request(url)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
+        # stream sockets get slack past timeoutSeconds so a healthy
+        # server ends the watch before the client's deadline fires
         return urllib.request.urlopen(
-            req, timeout=None if stream else self.timeout
+            req,
+            timeout=self.watch_read_timeout * 1.5 + 1.0
+            if stream
+            else self.timeout,
         )
 
     def list(self, kind: str) -> Tuple[List[Dict], str]:
@@ -89,7 +102,14 @@ class APIServerClient:
         try:
             resp = self._open(
                 prefix,
-                {"watch": "1", "resourceVersion": resource_version},
+                {
+                    "watch": "1",
+                    "resourceVersion": resource_version,
+                    # ask the server to end the watch before our socket
+                    # deadline so an idle-but-healthy stream terminates
+                    # cleanly rather than tripping the read timeout
+                    "timeoutSeconds": str(int(self.watch_read_timeout)),
+                },
                 stream=True,
             )
         except urllib.error.HTTPError as e:
@@ -99,7 +119,13 @@ class APIServerClient:
         with resp:
             buf = b""
             while not stop.is_set():
-                chunk = resp.read1(65536)
+                try:
+                    chunk = resp.read1(65536)
+                except TimeoutError:
+                    # no bytes within the deadline: connection presumed
+                    # half-open — end the stream; the caller reconnects
+                    # from the tracked rv (no re-list needed)
+                    return
                 if not chunk:
                     return
                 buf += chunk
@@ -144,7 +170,12 @@ class Informer:
         self._threads: List[threading.Thread] = []
         self._synced = threading.Event()
         self._relist_mu = threading.Lock()
+        self._relist_gen = 0  # bumps on every completed re-list
+        self._last_versions: Dict[str, str] = {}
         self.relists = 0  # observability: how many re-list cycles ran
+
+    def _backoff(self) -> Backoff:
+        return Backoff(min_s=self.relist_backoff_s, max_s=self.max_backoff_s)
 
     # -- one full LIST across kinds → one resync --------------------------
     def _list_all(self) -> Dict[str, str]:
@@ -160,7 +191,7 @@ class Informer:
         return versions
 
     def _watch_kind(self, kind: str, rv: str) -> None:
-        backoff = self.relist_backoff_s
+        backoff = self._backoff()
         while not self._stop.is_set():
             clean_end = False
             try:
@@ -192,20 +223,31 @@ class Informer:
             if clean_end:
                 # apiservers time watches out by design: reconnect
                 # from the tracked rv, no O(cluster) re-list needed
-                backoff = self.relist_backoff_s
+                backoff.reset()
                 continue
-            if self._stop.wait(backoff):
-                return
-            backoff = min(backoff * 2, self.max_backoff_s)
             # failure path: ONE full re-list across all kinds (a
             # single combined resync needs no placeholder snapshots
-            # and can't race partial views of other kinds; the
-            # _relist_mu collapses concurrent failures into turns)
+            # and can't race partial views of other kinds). A re-list
+            # that completed after THIS failure was observed — during
+            # the backoff sleep or while queued on the mutex — already
+            # reconciled every kind, so piggyback on its versions
+            # instead of hammering the apiserver with N redundant full
+            # re-lists when all watches drop at once.
+            gen = self._relist_gen
+            if backoff.wait(self._stop):
+                return
             with self._relist_mu:
+                if self._relist_gen != gen:
+                    rv = self._last_versions.get(kind, rv)
+                    backoff.reset()
+                    continue
                 try:
                     versions = self._list_all()
+                    self._last_versions = versions
+                    self._relist_gen += 1
                     rv = versions.get(kind, rv)
                     self.relists += 1
+                    backoff.reset()
                 except Exception as e:
                     log.warning(
                         "re-list failed",
@@ -214,7 +256,7 @@ class Informer:
 
     def start(self) -> "Informer":
         def boot():
-            backoff = self.relist_backoff_s
+            backoff = self._backoff()
             while not self._stop.is_set():
                 try:
                     versions = self._list_all()
@@ -224,9 +266,8 @@ class Informer:
                         "initial list failed; retrying",
                         fields={"err": f"{type(e).__name__}: {e}"},
                     )
-                    if self._stop.wait(backoff):
+                    if backoff.wait(self._stop):
                         return
-                    backoff = min(backoff * 2, self.max_backoff_s)
             else:
                 return
             self._synced.set()

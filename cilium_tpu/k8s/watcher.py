@@ -158,11 +158,13 @@ class K8sWatcher:
 
         def key(o: Dict[str, Any]):
             meta = o.get("metadata") or {}
-            return (
-                o.get("kind", ""),
-                meta.get("namespace") or "default",
-                meta.get("name", ""),
+            kind = o.get("kind", "")
+            # cluster-scoped kinds carry no namespace: pin the key's
+            # namespace slot so lookups need exactly one form
+            ns = "" if kind == KIND_NAMESPACE else (
+                meta.get("namespace") or "default"
             )
+            return (kind, ns, meta.get("name", ""))
 
         seen = {key(o) for o in objects}
         # collect currently-known objects per kind
@@ -202,9 +204,7 @@ class K8sWatcher:
         # not wipe the label cache)
         if any(o.get("kind") == KIND_NAMESPACE for o in objects):
             for ns_name in list(self._namespace_labels):
-                if (KIND_NAMESPACE, "default", ns_name) not in seen and (
-                    KIND_NAMESPACE, ns_name, ns_name
-                ) not in seen:
+                if (KIND_NAMESPACE, "", ns_name) not in seen:
                     stale.append({
                         "kind": KIND_NAMESPACE,
                         "metadata": {"name": ns_name},

@@ -37,6 +37,9 @@ class FakeAPIServer:
         self.events = {k: [] for k in RESOURCES}
         self.expire_watches = False  # force 410 on next watch
         self.drop_watches = threading.Event()  # close streams now
+        self.stall_next_watch = False  # hold ONE stream open, silent
+        self.abort_next: set = set()  # kinds whose NEXT watch dies mid-frame
+        self.list_count = 0  # how many LIST requests ever served
 
         outer = self
 
@@ -75,6 +78,7 @@ class FakeAPIServer:
     # -- protocol -------------------------------------------------------
     def _serve_list(self, h, kind):
         with self.lock:
+            self.list_count += 1
             items = [dict(o) for o in self.store[kind].values()]
             body = json.dumps({
                 "kind": f"{kind}List",
@@ -96,6 +100,23 @@ class FakeAPIServer:
         h.send_header("Content-Type", "application/json")
         h.send_header("Transfer-Encoding", "chunked")
         h.end_headers()
+        with self.lock:
+            stall = self.stall_next_watch
+            self.stall_next_watch = False
+        if stall:
+            # half-open simulation: THIS connection stays up forever
+            # with zero bytes flowing — only the client's read
+            # deadline can recover the watch (a clean close would not
+            # prove the deadline works)
+            time.sleep(30)
+            return
+        with self.lock:
+            abort = kind in self.abort_next
+            self.abort_next.discard(kind)
+        if abort:
+            # mid-stream failure: no terminating 0-chunk → the client
+            # sees a protocol error, not a clean end
+            return
 
         def send(obj):
             data = json.dumps(obj).encode() + b"\n"
@@ -175,14 +196,13 @@ def world(tmp_path):
     api = FakeAPIServer()
     d = Daemon(state_dir=str(tmp_path / "state"))
     w = K8sWatcher(d)
-    inf = None
-    yield api, d, w, lambda i: i
+    yield api, d, w
     api.drop_watches.set()
     api.stop()
 
 
 def test_initial_list_populates_daemon(world, tmp_path):
-    api, d, w, _ = world
+    api, d, w = world
     api.put("CiliumNetworkPolicy", _cnp("guard", "db", "web"))
     api.put("Pod", _pod("web-1", "10.1.0.10", "web"))
     inf = Informer(APIServerClient(api.url), w).start()
@@ -198,7 +218,7 @@ def test_initial_list_populates_daemon(world, tmp_path):
 
 
 def test_watch_events_apply_live(world):
-    api, d, w, _ = world
+    api, d, w = world
     inf = Informer(APIServerClient(api.url), w, relist_backoff_s=0.1).start()
     try:
         assert inf.wait_synced()
@@ -222,7 +242,7 @@ def test_watch_events_apply_live(world):
 
 
 def test_stream_drop_relists_and_heals_missed_delete(world):
-    api, d, w, _ = world
+    api, d, w = world
     api.put("Pod", _pod("web-1", "10.1.0.10", "web"))
     api.put("Pod", _pod("db-1", "10.1.0.20", "db"))
     inf = Informer(
@@ -252,7 +272,7 @@ def test_stream_drop_relists_and_heals_missed_delete(world):
 
 
 def test_410_gone_triggers_relist(world):
-    api, d, w, _ = world
+    api, d, w = world
     api.put("Pod", _pod("web-1", "10.1.0.10", "web"))
     inf = Informer(
         APIServerClient(api.url), w,
@@ -265,5 +285,64 @@ def test_410_gone_triggers_relist(world):
         time.sleep(0.3)
         api.expire_watches = False
         assert _wait(lambda: len(d.endpoint_manager) == 2, timeout=10)
+    finally:
+        inf.stop()
+
+
+def test_half_open_watch_recovers_via_read_deadline(world):
+    """A watch connection that goes silent WITHOUT closing (network
+    partition / half-open TCP) must not pin the watch thread: the
+    client's read deadline abandons it and the reconnect resumes from
+    the tracked rv."""
+    api, d, w = world
+    api.put("Pod", _pod("web-1", "10.1.0.10", "web"))
+    api.stall_next_watch = True  # first watch connection: 30s of silence
+    inf = Informer(
+        APIServerClient(api.url, watch_read_timeout=0.5), w,
+        kinds=["Pod"], relist_backoff_s=0.1,
+    ).start()
+    try:
+        assert inf.wait_synced()
+        # queued while the stream is dark; only a reconnect (after the
+        # ~1.75s read deadline, far before the 30s stall ends) sees it
+        api.put("Pod", _pod("db-1", "10.1.0.20", "db"))
+        assert _wait(lambda: len(d.endpoint_manager) == 2, timeout=10)
+    finally:
+        inf.stop()
+
+
+def test_simultaneous_watch_failures_collapse_to_one_relist(world):
+    """All kind watches dropping at once (apiserver restart) must not
+    fan out into one full re-list per kind: the first thread through
+    re-lists every kind in one pass and the rest piggyback on its
+    result."""
+    api, d, w = world
+    kinds = ["Pod", "Service", "Endpoints", "Namespace"]
+    api.put("Pod", _pod("web-1", "10.1.0.10", "web"))
+    inf = Informer(
+        APIServerClient(api.url), w, kinds=kinds, relist_backoff_s=0.3,
+    ).start()
+    try:
+        assert inf.wait_synced()
+        with api.lock:
+            lists_after_sync = api.list_count
+        # end every live stream now; each kind's reconnect dies
+        # mid-frame ONCE (no terminating chunk → protocol error, the
+        # failure path — a clean end would skip the re-list), so all
+        # four watch threads hit the failure path in one wave
+        with api.lock:
+            api.abort_next = set(kinds)
+        api.drop_watches.set()
+        time.sleep(0.2)
+        api.drop_watches.clear()
+        time.sleep(1.0)
+        api.put("Pod", _pod("db-1", "10.1.0.20", "db"))
+        assert _wait(lambda: len(d.endpoint_manager) == 2, timeout=10)
+        # one re-list cycle LISTs every kind once; N cycles would be
+        # N×len(kinds).  Allow 2 cycles of slack for arrival skew.
+        with api.lock:
+            extra_lists = api.list_count - lists_after_sync
+        assert inf.relists <= 2, inf.relists
+        assert extra_lists <= 2 * len(kinds), extra_lists
     finally:
         inf.stop()

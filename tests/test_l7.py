@@ -211,6 +211,41 @@ class TestHTTPPolicy:
         with pytest.raises(ValueError, match="64"):
             HTTPPolicy(rules)
 
+    def test_device_batch_branch_parity(self):
+        """Batches at/above the device-dispatch threshold must agree
+        with the host DFA walk, including a demoted (host-``re``)
+        pattern and a string past max_len (the per-element correction
+        loop) — the small-batch host path must not become the only
+        branch the suite ever runs."""
+        from cilium_tpu.l7.http_policy import _DEVICE_BATCH_MIN
+
+        pathological = "/bad/.*x.{14}y"  # demoted to host `re`
+        pol = HTTPPolicy(
+            [(HTTPRule(path="/svc/.*"), None),
+             (HTTPRule(path="/api/v[0-9]+/.*"), None),
+             (HTTPRule(path=pathological), None)],
+            max_len=64,
+        )
+        assert pol._paths.host_pids  # the demotion actually happened
+        n = _DEVICE_BATCH_MIN + 8
+        paths = []
+        for i in range(n):
+            paths.append([
+                f"/svc/item{i}",
+                f"/api/v{i}/x",
+                "/bad/zx" + "m" * 14 + "y",
+                "/svc/" + "x" * 200,  # > max_len: correction loop
+                f"/nope/{i}",
+            ][i % 5])
+        reqs = [HTTPRequest(method="GET", path=p) for p in paths]
+        got = pol.check_batch(reqs)
+        expect = [p.startswith(("/svc/", "/api/"))
+                  or re.fullmatch(pathological, p) is not None
+                  for p in paths]
+        assert got.tolist() == expect
+        # and single-request (host-walk branch) parity per element
+        assert [pol.check(r) for r in reqs] == expect
+
     def test_overlong_path_takes_host_fallback(self):
         # Long request paths must still match allow rules (advisor
         # finding: fail-closed divergence at common path lengths).
