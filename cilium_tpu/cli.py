@@ -20,6 +20,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 from typing import List, Optional
 
 DEFAULT_SOCK = os.environ.get("CILIUM_TPU_SOCK", "/tmp/cilium_tpu.sock")
@@ -186,9 +187,11 @@ def build_parser() -> argparse.ArgumentParser:
     # daemon
     d = sub.add_parser("daemon", help="run the agent + API server")
     d.add_argument("--no-conntrack", action="store_true")
-    d.add_argument("--join", default=None, metavar="KVSTORE_DB",
-                   help="join a cluster via a shared kvstore file "
-                        "(SQLite path; all agents pass the same file)")
+    d.add_argument("--join", default=None, metavar="KVSTORE",
+                   help="join a cluster via a shared kvstore: a SQLite "
+                        "path (all agents on one host pass the same "
+                        "file) or tcp://host:port of a `kvstore serve` "
+                        "server for multi-host clusters")
     d.add_argument("--node-name", default=None,
                    help="cluster node name (default: hostname)")
     d.add_argument("--node-ip", default=None,
@@ -311,6 +314,32 @@ def build_parser() -> argparse.ArgumentParser:
     pfu = pf.add_parser("update", help="insert deny CIDRs")
     pfu.add_argument("cidrs", nargs="+")
 
+    # kvstore: serve the cluster fabric / direct key access
+    # (cilium kvstore get|set|delete, cilium/cmd/kvstore*.go)
+    kv = sub.add_parser("kvstore", help="cluster kvstore").add_subparsers(
+        dest="sub", required=True
+    )
+    kvs = kv.add_parser(
+        "serve",
+        help="run the TCP kvstore server agents --join (etcd role)",
+    )
+    kvs.add_argument("--listen", default="127.0.0.1:4240",
+                     metavar="HOST:PORT")
+    kvs.add_argument("--lease-ttl", type=float, default=15.0)
+    for opname, ophelp in (
+        ("get", "read keys under a prefix"),
+        ("set", "write one key"),
+        ("delete", "delete a key (or prefix with trailing /)"),
+        ("status", "kvstore connectivity status"),
+    ):
+        op = kv.add_parser(opname, help=ophelp)
+        op.add_argument("--kvstore", required=True, metavar="TARGET",
+                        help="tcp://host:port or SQLite path")
+        if opname in ("get", "set", "delete"):
+            op.add_argument("key")
+        if opname == "set":
+            op.add_argument("value")
+
     return p
 
 
@@ -341,14 +370,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             import socket as _socket
 
             from .cluster import ClusterNode
-            from .kvstore.filestore import FileBackend
+            from .kvstore.netstore import backend_from_target
             from .nodes.registry import Node as _Node
             from .utils.controller import Controller
 
             name = args.node_name or _socket.gethostname()
             cluster_node = ClusterNode(
                 daemon,
-                FileBackend(args.join, name),
+                backend_from_target(args.join, name),
                 _Node(name=name, ipv4=args.node_ip,
                       ipv4_alloc_cidr=args.pod_cidr),
                 cluster=args.cluster,
@@ -454,6 +483,61 @@ def main(argv: Optional[List[str]] = None) -> int:
                     print(ev.summary())
         except KeyboardInterrupt:
             pass
+        return 0
+
+    if args.cmd == "kvstore":
+        from .kvstore.netstore import KVStoreServer, backend_from_target
+
+        if args.sub == "serve":
+            host, _, port = args.listen.rpartition(":")
+            if not port.isdigit():
+                print(f"--listen {args.listen!r} must be HOST:PORT",
+                      file=sys.stderr)
+                return 2
+            server = KVStoreServer(
+                host or "127.0.0.1", int(port), lease_ttl=args.lease_ttl
+            ).start()
+            print(f"kvstore serving on {server.url}", flush=True)
+            try:
+                threading.Event().wait()
+            except KeyboardInterrupt:
+                pass
+            server.stop()
+            return 0
+        # `kvstore status` exists precisely to probe a possibly-down
+        # server — a traceback here would be a bug report, not an
+        # answer (same for a dying server mid-op, or an unwritable
+        # SQLite path)
+        import sqlite3
+
+        _kv_errors = (
+            OSError, TimeoutError, RuntimeError, ValueError, sqlite3.Error,
+        )
+        try:
+            be = backend_from_target(args.kvstore, "cli")
+        except _kv_errors as e:
+            print(f"kvstore {args.kvstore}: unreachable ({e})",
+                  file=sys.stderr)
+            return 1
+        try:
+            if args.sub == "get":
+                for k, v in sorted(be.list_prefix(args.key).items()):
+                    print(f"{k} => {v.decode(errors='replace')}")
+            elif args.sub == "set":
+                be.set(args.key, args.value.encode())
+            elif args.sub == "delete":
+                if args.key.endswith("/"):
+                    be.delete_prefix(args.key)
+                else:
+                    be.delete(args.key)
+            elif args.sub == "status":
+                print(be.status())
+        except _kv_errors as e:
+            print(f"kvstore {args.kvstore}: {args.sub} failed ({e})",
+                  file=sys.stderr)
+            return 1
+        finally:
+            be.close()
         return 0
 
     s = _Surface(args.socket, args.state)

@@ -18,6 +18,7 @@ from .backend import (
 from .allocator import Allocator, AllocatorError
 from .clustermesh import ClusterMesh, RemoteCluster
 from .filestore import FileBackend, FlakyBackend
+from .netstore import KVStoreServer, NetBackend, backend_from_target
 from .store import SharedStore
 
 __all__ = [
@@ -35,7 +36,10 @@ __all__ = [
     "InMemoryStore",
     "KVEvent",
     "KVLock",
+    "KVStoreServer",
     "LockTimeout",
+    "NetBackend",
+    "backend_from_target",
     "RemoteCluster",
     "SharedStore",
     "Watcher",

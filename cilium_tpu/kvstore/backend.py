@@ -96,11 +96,28 @@ class Watcher:
 class BackendOperations:
     """Abstract kvstore client surface (backend.go:92-164)."""
 
+    #: retry sleep for the CAS-spin lock; network backends override
+    #: (each attempt is a round trip there)
+    _lock_retry_s = 0.002
+    name = "client"
+
     def status(self) -> str:
         raise NotImplementedError
 
     def lock_path(self, path: str, timeout: float = 10.0) -> "KVLock":
-        raise NotImplementedError
+        """Distributed lock by CAS-creating a lease-bound lock key,
+        retried until acquired (etcd-style, pkg/kvstore/lock.go). The
+        lease binding means a dead holder's lock auto-releases when
+        its session dies. Always makes at least one attempt, even at
+        timeout=0."""
+        lock_key = path + "/.lock"
+        deadline = time.monotonic() + timeout
+        while True:
+            if self.create_only(lock_key, self.name.encode(), lease=True):
+                return KVLock(self, lock_key)
+            if time.monotonic() >= deadline:
+                raise LockTimeout(f"lock {path} not acquired within {timeout}s")
+            time.sleep(self._lock_retry_s)
 
     def get(self, key: str) -> Optional[bytes]:
         raise NotImplementedError
@@ -224,6 +241,12 @@ class InMemoryStore:
     def _put_locked(
         self, key: str, value: bytes, lease_id: Optional[int]
     ) -> None:
+        # a write racing its own lease's revocation must fail, not
+        # resurrect the popped lease entry: nothing would ever revoke
+        # that id again, so the key (e.g. a '/.lock') would be orphaned
+        # forever (etcd likewise rejects puts on a revoked lease)
+        if lease_id is not None and lease_id not in self._leases:
+            raise RuntimeError(f"lease {lease_id} revoked")
         self._rev += 1
         old = self._data.get(key)
         if old is not None and old.lease_id is not None and old.lease_id != lease_id:
@@ -303,6 +326,25 @@ class InMemoryStore:
         with self._lock:
             self._watchers.append((prefix, watcher))
 
+    def snapshot_and_attach(self, prefix: str, watcher: Watcher) -> None:
+        """List-then-watch without a gap OR a reorder: snapshot, attach,
+        AND emit under one hold of the store lock. Mutations take the
+        same lock, so no event can land between the listing and the
+        live stream — and none can be queued ahead of the snapshot
+        (a delete racing the attach must arrive after the stale create
+        it supersedes, or the consumer resurrects the key). Emitting
+        under the lock is safe: Watcher queues are unbounded, _emit
+        never blocks."""
+        with self._lock:
+            snapshot = sorted(
+                (k, e.value) for k, e in self._data.items()
+                if k.startswith(prefix)
+            )
+            for k, v in snapshot:
+                watcher._emit(KVEvent(EventTypeCreate, k, v))
+            watcher._emit(KVEvent(EventTypeListDone, "", None))
+            self._watchers.append((prefix, watcher))
+
     def detach_watcher(self, watcher: Watcher) -> None:
         with self._lock:
             self._watchers = [(p, w) for p, w in self._watchers if w is not watcher]
@@ -358,34 +400,11 @@ class InMemoryBackend(BackendOperations):
     def list_prefix(self, prefix: str) -> Dict[str, bytes]:
         return self.store.list_prefix(prefix)
 
-    def lock_path(self, path: str, timeout: float = 10.0) -> KVLock:
-        """Acquire a distributed lock by CAS-creating a lease-bound lock
-        key (etcd-style). Spin with a short sleep until acquired."""
-        lock_key = path + "/.lock"
-        deadline = time.monotonic() + timeout
-        while True:
-            if self.store.create_only(lock_key, self.name.encode(), self._lease(True)):
-                return KVLock(self, lock_key)
-            if time.monotonic() >= deadline:
-                raise LockTimeout(f"lock {path} not acquired within {timeout}s")
-            time.sleep(0.002)
-
     def list_and_watch(self, name: str, prefix: str, chan_size: int = 1024) -> Watcher:
         """List current keys (as create events), mark list-done, then
         stream live events (backend.go ListAndWatch)."""
         w = Watcher(name, prefix, chan_size)
-        # Attach under the store lock BEFORE listing so no event between
-        # list and attach is lost; duplicates are impossible because
-        # mutations hold the same lock.
-        with self.store._lock:
-            snapshot = sorted(
-                (k, e.value) for k, e in self.store._data.items()
-                if k.startswith(prefix)
-            )
-            self.store.attach_watcher(prefix, w)
-        for k, v in snapshot:
-            w._emit(KVEvent(EventTypeCreate, k, v))
-        w._emit(KVEvent(EventTypeListDone, "", None))
+        self.store.snapshot_and_attach(prefix, w)
         self._watchers.append(w)
         return w
 

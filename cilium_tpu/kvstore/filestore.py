@@ -28,8 +28,6 @@ from .backend import (
     EventTypeListDone,
     EventTypeModify,
     KVEvent,
-    KVLock,
-    LockTimeout,
     Watcher,
 )
 
@@ -280,16 +278,9 @@ class FileBackend(BackendOperations):
                 )
             }
 
-    def lock_path(self, path: str, timeout: float = 10.0) -> KVLock:
-        """Distributed lock: lease-bound create_only spin (lock.go) —
-        a dead owner's lock vanishes with its lease."""
-        lock_key = f"{path}/.lock"
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self.create_only(lock_key, self.name.encode(), lease=True):
-                return KVLock(self, lock_key)
-            time.sleep(0.02)
-        raise LockTimeout(f"lock {path} not acquired within {timeout}s")
+    # lock_path: inherited CAS-spin (backend.py); SQLite round trips
+    # make tight spinning counterproductive
+    _lock_retry_s = 0.02
 
     # -- watch ----------------------------------------------------------
     def list_and_watch(

@@ -1,0 +1,239 @@
+"""Networked kvstore fabric: TCP server + NetBackend clients.
+
+The multi-HOST story the SQLite file backend can't tell: clients reach
+the store over a socket, leases die with the connection (or its
+keepalive), watches stream across the network, and the whole
+distributed stack — CAS allocator, shared store, clustered daemons —
+runs unchanged over it. Reference analog: pkg/kvstore/etcd.go client
+sessions against a real etcd endpoint.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cilium_tpu.kvstore import (
+    Allocator,
+    EventTypeCreate,
+    EventTypeDelete,
+    EventTypeListDone,
+    KVStoreServer,
+    LockTimeout,
+    NetBackend,
+)
+
+
+@pytest.fixture()
+def server():
+    srv = KVStoreServer(lease_ttl=1.0).start()
+    yield srv
+    srv.stop()
+
+
+def _drain_until(w, typ, key=None, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    got = []
+    while time.monotonic() < deadline:
+        ev = w.next(timeout=0.2)
+        if ev is None:
+            continue
+        got.append(ev)
+        if ev.typ == typ and (key is None or ev.key == key):
+            return got
+    raise AssertionError(f"no {typ} event for {key!r}; saw {got}")
+
+
+class TestNetBackend:
+    def test_crud_and_cas_across_clients(self, server):
+        a = NetBackend(server.url, "node-a")
+        b = NetBackend(server.url, "node-b")
+        try:
+            a.set("cilium/state/k1", b"v1")
+            assert b.get("cilium/state/k1") == b"v1"
+            # CAS: only one creator wins
+            assert a.create_only("cilium/ids/5", b"labels-a") is True
+            assert b.create_only("cilium/ids/5", b"labels-b") is False
+            assert b.get("cilium/ids/5") == b"labels-a"
+            assert b.create_if_exists(
+                "cilium/ids/5", "cilium/ids/5/slave", b"x"
+            ) is True
+            assert a.create_if_exists(
+                "cilium/ids/404", "cilium/ids/404/slave", b"x"
+            ) is False
+            assert sorted(a.list_prefix("cilium/ids/")) == [
+                "cilium/ids/5", "cilium/ids/5/slave",
+            ]
+            assert a.get_prefix("cilium/state/") == ("cilium/state/k1", b"v1")
+            b.delete_prefix("cilium/ids/")
+            assert a.list_prefix("cilium/ids/") == {}
+        finally:
+            a.close()
+            b.close()
+
+    def test_watch_streams_across_clients(self, server):
+        a = NetBackend(server.url, "node-a")
+        b = NetBackend(server.url, "node-b")
+        try:
+            a.set("cilium/nodes/pre", b"existing")
+            w = b.list_and_watch("nodes", "cilium/nodes/")
+            evs = _drain_until(w, EventTypeListDone)
+            assert [(e.typ, e.key) for e in evs] == [
+                (EventTypeCreate, "cilium/nodes/pre"),
+                (EventTypeListDone, ""),
+            ]
+            a.update("cilium/nodes/n1", b"hello", lease=True)
+            _drain_until(w, EventTypeCreate, "cilium/nodes/n1")
+            a.delete("cilium/nodes/n1")
+            _drain_until(w, EventTypeDelete, "cilium/nodes/n1")
+            b.stop_watcher(w)
+        finally:
+            a.close()
+            b.close()
+
+    def test_close_revokes_lease_keys(self, server):
+        a = NetBackend(server.url, "node-a")
+        b = NetBackend(server.url, "node-b")
+        try:
+            w = b.list_and_watch("nodes", "cilium/nodes/")
+            _drain_until(w, EventTypeListDone)
+            a.update("cilium/nodes/a", b"announce", lease=True)
+            a.set("cilium/persist/a", b"durable")
+            _drain_until(w, EventTypeCreate, "cilium/nodes/a")
+            a.close()  # connection death == lease revocation
+            _drain_until(w, EventTypeDelete, "cilium/nodes/a")
+            assert b.get("cilium/persist/a") == b"durable"  # no lease: stays
+        finally:
+            b.close()
+
+    def test_keepalive_timeout_expires_lease(self, server):
+        """A client whose keepalive goes silent (hung process, dropped
+        network) loses its lease at TTL even while TCP lingers."""
+        a = NetBackend(server.url, "node-a")
+        b = NetBackend(server.url, "node-b")
+        try:
+            a.update("cilium/nodes/a", b"announce", lease=True)
+            a._closed.set()  # kill keepalive loop only; socket stays up
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if b.get("cilium/nodes/a") is None:
+                    break
+                time.sleep(0.1)
+            assert b.get("cilium/nodes/a") is None
+        finally:
+            a.close()
+            b.close()
+
+    def test_locks_mutually_exclude(self, server):
+        a = NetBackend(server.url, "node-a")
+        b = NetBackend(server.url, "node-b")
+        try:
+            l1 = a.lock_path("cilium/locks/x", timeout=2.0)
+            with pytest.raises(LockTimeout):
+                b.lock_path("cilium/locks/x", timeout=0.3)
+            l1.unlock()
+            b.lock_path("cilium/locks/x", timeout=2.0).unlock()
+        finally:
+            a.close()
+            b.close()
+
+    def test_ops_fail_fast_after_server_stop(self, server):
+        a = NetBackend(server.url, "node-a")
+        server.stop()
+        with pytest.raises((ConnectionError, TimeoutError)):
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                a.set("k", b"v")
+                time.sleep(0.05)
+        assert "unreachable" in a.status() or "net:" in a.status()
+        a.close()
+
+
+class TestDistributedOverNet:
+    def test_allocator_cas_agreement(self, server):
+        """Two agents on different 'hosts' allocate the same labels →
+        one identity (the etcd CAS master-key contract)."""
+        a = Allocator(NetBackend(server.url, "node-a"),
+                      "cilium/state/identities", suffix="node-a")
+        b = Allocator(NetBackend(server.url, "node-b"),
+                      "cilium/state/identities", suffix="node-b")
+        try:
+            id_a, new_a = a.allocate("k8s:app=web;k8s:env=prod")
+            id_b, new_b = b.allocate("k8s:app=web;k8s:env=prod")
+            assert id_a == id_b
+            assert new_a and not new_b
+            id_c, _ = b.allocate("k8s:app=db")
+            assert id_c != id_a
+        finally:
+            a.close()
+            b.close()
+
+    def test_two_daemons_cluster_over_tcp(self, server):
+        """The capstone over the network: two full Daemons joined via
+        NetBackend converge identities and cross-node ipcache."""
+        from cilium_tpu.cluster import ClusterNode
+        from cilium_tpu.daemon import Daemon
+        from cilium_tpu.nodes.registry import Node
+
+        made = []
+
+        def make(name, ip, pod_cidr):
+            d = Daemon(pod_cidr=pod_cidr, health_probe=lambda a, p: 0.001)
+            cn = ClusterNode(
+                d, NetBackend(server.url, name),
+                Node(name=name, ipv4=ip, ipv4_alloc_cidr=pod_cidr),
+                probe_interval=3600,
+            )
+            made.append((d, cn))
+            return d, cn
+
+        da, ca = make("node-a", "192.168.0.1", "10.1.0.0/16")
+        db_, cb = make("node-b", "192.168.0.2", "10.2.0.0/16")
+        try:
+            da.endpoint_add(1, ["k8s:app=client"], ipv4="10.1.0.7")
+            ident = da.endpoint_manager.lookup(1).identity.id
+            for _ in range(6):
+                ca.pump()
+                cb.pump()
+            # node B sees node A's tunnel + A's endpoint identity
+            assert "node-a" in {n.name for n in cb.nodes.remote_nodes()}
+            info = db_.ipcache.lookup_by_ip("10.1.0.7")
+            assert info is not None and info.source == "kvstore"
+            assert info.identity == ident
+            assert info.host_ip == "192.168.0.1"
+        finally:
+            for d, cn in made:
+                cn.close()
+                d.shutdown()
+
+
+class TestCrossProcess:
+    def test_real_server_process(self, tmp_path):
+        """`cilium kvstore serve` in a REAL second process; a client in
+        this one does CRUD + lease-bound write, then the CLI reads it
+        back over TCP."""
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cilium_tpu.cli", "kvstore", "serve",
+             "--listen", "127.0.0.1:0"],
+            stdout=subprocess.PIPE, text=True,
+        )
+        try:
+            line = proc.stdout.readline().strip()
+            assert line.startswith("kvstore serving on tcp://")
+            url = line.split()[-1]
+            c = NetBackend(url, "test")
+            c.set("cilium/x", b"across-processes")
+            assert c.get("cilium/x") == b"across-processes"
+            out = subprocess.run(
+                [sys.executable, "-m", "cilium_tpu.cli", "kvstore", "get",
+                 "--kvstore", url, "cilium/"],
+                capture_output=True, text=True, timeout=30,
+            )
+            assert "cilium/x => across-processes" in out.stdout
+            c.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
