@@ -692,7 +692,12 @@ def main() -> None:
         "l7_dfa_rps": round(l7_dfa),
         "kafka_acl_rps": round(kafka_acl),
         "native_vps": round(native_vps),
-        "native_vps_mt": {k: round(v) for k, v in native_mt.items()},
+        "native_vps_mt": (
+            {k: round(v) for k, v in native_mt.items()}
+            if native_mt
+            # an empty sweep is a skip, not a failure — say why
+            else {"skipped": f"{os.cpu_count()} host cpu(s)"}
+        ),
         "native_l7_rps": round(native_l7_rps),
         "native_e2e_vps": round(native_e2e_vps),
         "native_e2e_est_vps": round(native_e2e_est_vps),

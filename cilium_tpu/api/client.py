@@ -142,3 +142,30 @@ class APIClient:
         if revision is not None:
             body["revision"] = revision
         return self._request("PATCH", "/prefilter", body)
+
+    def prefilter_delete(self, cidrs, revision=None):
+        body = {"cidrs": list(cidrs)}
+        if revision is not None:
+            body["revision"] = revision
+        return self._request("DELETE", "/prefilter", body)
+
+    def endpoint_get(self, ep_id: int):
+        return self._request("GET", f"/endpoint/{ep_id}")
+
+    def endpoint_regenerate(self, ep_id: Optional[int] = None):
+        path = (f"/endpoint/{ep_id}/regenerate" if ep_id is not None
+                else "/endpoint/regenerate")
+        return self._request("POST", path)
+
+    def endpoint_labels(self, ep_id: int, add=(), delete=()):
+        return self._request("PATCH", f"/endpoint/{ep_id}/labels",
+                             {"add": list(add), "delete": list(delete)})
+
+    def map_list(self):
+        return self._request("GET", "/map")
+
+    def ct_flush(self):
+        return self._request("POST", "/map/ct/flush")
+
+    def node_list(self):
+        return self._request("GET", "/node")

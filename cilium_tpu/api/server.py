@@ -122,11 +122,27 @@ class _Handler(BaseHTTPRequestHandler):
                     ipv4=body.get("ipv4"), ipv6=body.get("ipv6"),
                     pod_name=body.get("pod_name", ""),
                 ))
+            elif method == "GET":
+                model = d.endpoint_get(ep_id)
+                if model is None:
+                    self._json(404, {"error": f"endpoint {ep_id} not found"})
+                else:
+                    self._json(200, model)
             elif method == "DELETE":
                 ok = d.endpoint_delete(ep_id)
                 self._json(200 if ok else 404, {"deleted": ok})
             else:
                 return False
+        elif (m := re.fullmatch(r"/endpoint/(\d+)/regenerate", path)) and method == "POST":
+            self._json(200, d.endpoint_regenerate(int(m.group(1))))
+        elif path == "/endpoint/regenerate" and method == "POST":
+            self._json(200, d.endpoint_regenerate())
+        elif (m := re.fullmatch(r"/endpoint/(\d+)/labels", path)) and method == "PATCH":
+            body = self._body()
+            self._json(200, d.endpoint_labels(
+                int(m.group(1)),
+                add=body.get("add", []), delete=body.get("delete", []),
+            ))
         elif (m := re.fullmatch(r"/endpoint/(\d+)/policymap", path)) and method == "GET":
             ingress = q.get("direction", ["ingress"])[0] != "egress"
             self._json(200, d.policymap_dump(int(m.group(1)), ingress=ingress))
@@ -152,6 +168,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, d.endpoint_config(
                     ep_id, body.get("options", {})
                 ))
+        elif path == "/map" and method == "GET":
+            self._json(200, d.map_list())
+        elif path == "/map/ct/flush" and method == "POST":
+            self._json(200, d.ct_flush())
+        elif path == "/node" and method == "GET":
+            self._json(200, d.node_list())
         elif (m := re.fullmatch(r"/map/(\w+)", path)) and method == "GET":
             self._json(200, d.map_dump(m.group(1)))
         elif path == "/ipam" and method == "POST":
@@ -186,6 +208,13 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/prefilter" and method == "PATCH":
             body = self._body()
             rev = d.prefilter.insert(
+                body.get("revision", d.prefilter.revision),
+                body.get("cidrs", []),
+            )
+            self._json(200, {"revision": rev})
+        elif path == "/prefilter" and method == "DELETE":
+            body = self._body()
+            rev = d.prefilter.delete(
                 body.get("revision", d.prefilter.revision),
                 body.get("cidrs", []),
             )

@@ -134,6 +134,35 @@ class _Surface:
         )
         return {"revision": rev}
 
+    def _d_prefilter_delete(self, cidrs, revision=None):
+        rev = self._daemon.prefilter.delete(
+            revision if revision is not None
+            else self._daemon.prefilter.revision,
+            cidrs,
+        )
+        return {"revision": rev}
+
+    def _d_endpoint_get(self, ep_id):
+        out = self._daemon.endpoint_get(ep_id)
+        if out is None:
+            raise SystemExit(f"endpoint {ep_id} not found")
+        return out
+
+    def _d_endpoint_regenerate(self, ep_id=None):
+        return self._daemon.endpoint_regenerate(ep_id)
+
+    def _d_endpoint_labels(self, ep_id, add=(), delete=()):
+        return self._daemon.endpoint_labels(ep_id, add=add, delete=delete)
+
+    def _d_map_list(self):
+        return self._daemon.map_list()
+
+    def _d_ct_flush(self):
+        return self._daemon.ct_flush()
+
+    def _d_node_list(self):
+        return self._daemon.node_list()
+
 
 def _parse_frontend(text: str) -> dict:
     """'10.96.0.10:80/TCP' → frontend dict (cilium service update
@@ -261,6 +290,14 @@ def build_parser() -> argparse.ArgumentParser:
     epc.add_argument("options", nargs="+", help="Option=true|false pairs")
     epd = ep.add_parser("delete", help="remove an endpoint")
     epd.add_argument("id", type=int)
+    epg = ep.add_parser("get", help="one endpoint's model")
+    epg.add_argument("id", type=int)
+    epr = ep.add_parser("regenerate", help="force policy regeneration")
+    epr.add_argument("id", type=int, nargs="?", default=None)
+    epl = ep.add_parser("labels", help="modify labels (new identity)")
+    epl.add_argument("id", type=int)
+    epl.add_argument("-a", "--add", action="append", default=[])
+    epl.add_argument("-d", "--delete", action="append", default=[])
 
     # identity
     idp = sub.add_parser("identity", help="identity operations").add_subparsers(
@@ -287,6 +324,8 @@ def build_parser() -> argparse.ArgumentParser:
             dest="mapop", required=True
         )
         mp.add_parser("list", help=f"dump {mhelp}")
+        if mname == "ct":
+            mp.add_parser("flush", help="flush all conntrack entries")
     bp = bpf.add_parser("policy", help="policymap ops").add_subparsers(
         dest="op", required=True
     )
@@ -313,6 +352,24 @@ def build_parser() -> argparse.ArgumentParser:
     pf.add_parser("get", help="dump deny CIDRs")
     pfu = pf.add_parser("update", help="insert deny CIDRs")
     pfu.add_argument("cidrs", nargs="+")
+    pfd = pf.add_parser("delete", help="remove deny CIDRs")
+    pfd.add_argument("cidrs", nargs="+")
+
+    # node / map inventory / version / cleanup
+    nd = sub.add_parser("node", help="cluster nodes").add_subparsers(
+        dest="sub", required=True
+    )
+    nd.add_parser("list", help="known cluster nodes")
+    mp2 = sub.add_parser("map", help="open-map inventory").add_subparsers(
+        dest="sub", required=True
+    )
+    mp2.add_parser("list", help="map names + entry counts")
+    mg = mp2.add_parser("get", help="dump one map by name")
+    mg.add_argument("name")
+    sub.add_parser("version", help="framework + backend versions")
+    cl = sub.add_parser("cleanup", help="remove agent state/sockets")
+    cl.add_argument("-f", "--force", action="store_true",
+                    help="actually delete (dry run without)")
 
     # kvstore: serve the cluster fabric / direct key access
     # (cilium kvstore get|set|delete, cilium/cmd/kvstore*.go)
@@ -485,6 +542,48 @@ def main(argv: Optional[List[str]] = None) -> int:
             pass
         return 0
 
+    if args.cmd == "version":
+        # local by design: version must print even with no daemon
+        from . import __version__
+
+        print(f"cilium-tpu {__version__}")
+        try:
+            import jax
+
+            devs = jax.devices()
+            print(f"jax {jax.__version__} ({devs[0].platform}, "
+                  f"{len(devs)} device(s))")
+        except Exception as e:
+            print(f"jax unavailable: {e}")
+        return 0
+
+    if args.cmd == "cleanup":
+        # cilium cleanup: remove agent state + sockets (the reference
+        # removes BPF maps/veths; our datapath state is the state dir)
+        import shutil
+
+        targets = [p for p in (
+            args.state,
+            args.socket, args.socket + ".monitor", args.socket + ".xds",
+            args.socket + ".accesslog",
+        ) if os.path.exists(p)]
+        if not targets:
+            print("nothing to clean")
+            return 0
+        for t in targets:
+            print(("removing " if args.force else "would remove ") + t)
+            if args.force:
+                if os.path.isdir(t):
+                    shutil.rmtree(t, ignore_errors=True)
+                else:
+                    try:
+                        os.unlink(t)
+                    except OSError:
+                        pass
+        if not args.force:
+            print("dry run — pass --force to delete")
+        return 0
+
     if args.cmd == "kvstore":
         from .kvstore.netstore import KVStoreServer, backend_from_target
 
@@ -645,6 +744,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             _print(s.endpoint_config(args.id, opts))
         elif args.sub == "delete":
             _print(s.endpoint_delete(args.id))
+        elif args.sub == "get":
+            _print(s.endpoint_get(args.id))
+        elif args.sub == "regenerate":
+            _print(s.endpoint_regenerate(args.id))
+        elif args.sub == "labels":
+            _print(s.endpoint_labels(args.id, add=args.add,
+                                     delete=args.delete))
     elif args.cmd == "identity":
         if args.sub == "list":
             _print(s.identity_list())
@@ -660,8 +766,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             _print(s.config_get())
     elif args.cmd == "bpf":
-        if args.sub in ("ct", "ipcache", "tunnel", "proxy", "metrics",
-                        "routes"):
+        if args.sub == "ct" and args.mapop == "flush":
+            _print(s.ct_flush())
+        elif args.sub in ("ct", "ipcache", "tunnel", "proxy", "metrics",
+                          "routes"):
             _print(s.map_dump(args.sub))
         else:
             _print(s.policymap_get(args.endpoint, egress=args.egress))
@@ -688,10 +796,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.cmd == "prefilter":
         if args.sub == "get":
             _print(s.prefilter_get())
+        elif args.sub == "delete":
+            _print(s.prefilter_delete(args.cidrs))
         else:
             _print(s.prefilter_patch(args.cidrs))
+    elif args.cmd == "node":
+        _print(s.node_list())
+    elif args.cmd == "map":
+        if args.sub == "list":
+            _print(s.map_list())
+        else:
+            _print(s.map_dump(args.name))
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `cilium-tpu ... | head` closing the pipe is not an error;
+        # devnull swap avoids a second BrokenPipeError at interpreter
+        # shutdown when stdout flushes
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -413,6 +413,122 @@ class Daemon:
         return [self._endpoint_model(ep)
                 for ep in self.endpoint_manager.endpoints()]
 
+    def endpoint_get(self, endpoint_id: int) -> Optional[Dict]:
+        """GET /endpoint/{id} (cilium endpoint get)."""
+        ep = self.endpoint_manager.lookup(endpoint_id)
+        return self._endpoint_model(ep) if ep is not None else None
+
+    def endpoint_regenerate(self, endpoint_id: Optional[int] = None) -> Dict:
+        """Force regeneration (cilium endpoint regenerate; endpoint.go
+        regenerate REST modifier). One endpoint given an id, else all —
+        the device tables rebuild either way (regeneration is
+        whole-engine here, not per-endpoint program compiles)."""
+        if endpoint_id is not None and (
+            self.endpoint_manager.lookup(endpoint_id) is None
+        ):
+            raise ValueError(f"endpoint {endpoint_id} not found")
+        self._regenerate_now("manual regeneration")
+        return {"regenerated": (
+            1 if endpoint_id is not None else len(self.endpoint_manager)
+        )}
+
+    def endpoint_labels(
+        self,
+        endpoint_id: int,
+        add: Sequence[str] = (),
+        delete: Sequence[str] = (),
+    ) -> Dict:
+        """Modify an endpoint's labels → new identity → regenerate
+        (cilium endpoint labels -a/-d; the reference resolves the new
+        identity exactly like a fresh endpoint,
+        daemon/endpoint.go modifyEndpointIdentityLabelsFromAPI)."""
+        from .labels.label import parse_label
+
+        with self._lock:
+            ep = self.endpoint_manager.lookup(endpoint_id)
+            if ep is None:
+                raise ValueError(f"endpoint {endpoint_id} not found")
+            current = {str(l) for l in ep.labels}
+            # canonicalize through the label parser: the user spells
+            # 'app=web', the store holds 'unspec:app=web' (or
+            # 'k8s:app=web') — raw-string set math would silently
+            # no-op the delete and duplicate the add under a second
+            # source. Source-less deletes remove the label from ANY
+            # source (cilium endpoint labels -d semantics).
+            removed = set()
+            for spec in delete:
+                lab = parse_label(spec)
+                if ":" in spec.split("=", 1)[0]:
+                    removed.add(str(lab))  # exact source given
+                else:
+                    removed |= {
+                        str(l) for l in ep.labels
+                        if l.key == lab.key and l.value == lab.value
+                    }
+            kv_present = {
+                (l.key, l.value) for l in ep.labels
+                if str(l) not in removed  # allow delete+add to retag source
+            }
+            added = {
+                str(lab) for lab in (parse_label(s) for s in add)
+                # same key=value under another source is already there —
+                # adding a second copy would force a spurious identity
+                if (lab.key, lab.value) not in kv_present
+            }
+            wanted = (current - removed) | added
+            if wanted == current:
+                return self._endpoint_model(ep)
+            old_ident = ep.identity
+            lbls = parse_label_array(sorted(wanted))
+            ep.labels = lbls
+            ep.identity = self.allocate_identity(lbls)
+            if old_ident is not None:
+                self.release_identity(old_ident)
+            for ip, plen in ((ep.ipv4, 32), (ep.ipv6, 128)):
+                if ip:
+                    self.ipcache.upsert(
+                        f"{ip}/{plen}", ep.identity.id, source=SOURCE_AGENT
+                    )
+            self._sync_pipeline_endpoints()
+            self._regenerate("endpoint labels changed")
+            self.save_state()
+        self.notify_agent(
+            "endpoint-labels",
+            f"endpoint {endpoint_id} identity {ep.identity.id}",
+        )
+        return self._endpoint_model(ep)
+
+    def ct_flush(self) -> Dict:
+        """Flush the connection-tracking table (cilium bpf ct flush)."""
+        n = self.conntrack.flush() if self.conntrack is not None else 0
+        return {"flushed": n}
+
+    def node_list(self) -> List[Dict]:
+        """Known cluster nodes (cilium node list). Standalone daemons
+        know no peers."""
+        reg = getattr(self.health, "nodes", None)
+        if reg is None or not hasattr(reg, "remote_nodes"):
+            return []
+        out = []
+        for n in reg.remote_nodes():
+            out.append({
+                "name": n.name,
+                "ipv4": n.ipv4,
+                "ipv4_alloc_cidr": n.ipv4_alloc_cidr,
+                "cluster": getattr(n, "cluster", "default"),
+            })
+        return out
+
+    def map_list(self) -> List[Dict]:
+        """Open-map inventory (cilium map list): name + entry count."""
+        out = []
+        for name in ("ct", "ipcache", "tunnel", "proxy", "metrics", "routes"):
+            try:
+                out.append({"name": name, "entries": len(self.map_dump(name))})
+            except Exception:
+                out.append({"name": name, "entries": -1})
+        return out
+
     def _endpoint_model(self, ep: Endpoint) -> Dict:
         return {
             "id": ep.id,

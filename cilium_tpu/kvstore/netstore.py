@@ -22,7 +22,8 @@ Two halves:
   blocking callers and watch events (by watch id) into
   :class:`Watcher` queues; a keepalive thread renews the lease.
 
-Wire protocol: 4-byte big-endian length + one JSON object. Binary
+Wire protocol: 4-byte little-endian length + one JSON object
+(utils/framing.py — the repo-wide socket convention). Binary
 values ride base64. Requests carry ``id``; responses echo it; watch
 events carry ``watch`` instead. The first frame from the server is the
 hello: ``{"lease": <id>, "ttl": <seconds>, "rev": <revision>}``.
@@ -37,13 +38,13 @@ the reference's session-loss semantics.
 from __future__ import annotations
 
 import base64
-import json
 import socket
-import struct
 import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from ..utils.framing import recv_json as _recv_frame
+from ..utils.framing import send_json
 from ..utils.logging import get_logger
 from .backend import (
     BackendOperations,
@@ -55,40 +56,9 @@ from .backend import (
 
 log = get_logger("kvstore-net")
 
-_HDR = struct.Struct(">I")
-_MAX_FRAME = 64 << 20
-
 
 def _send_frame(sock: socket.socket, wlock: threading.Lock, obj: dict) -> None:
-    data = json.dumps(obj, separators=(",", ":")).encode()
-    with wlock:
-        sock.sendall(_HDR.pack(len(data)) + data)
-
-
-def _recv_frame(sock: socket.socket) -> Optional[dict]:
-    hdr = _recv_exact(sock, _HDR.size)
-    if hdr is None:
-        return None
-    (size,) = _HDR.unpack(hdr)
-    if size > _MAX_FRAME:
-        raise ValueError(f"frame of {size} bytes exceeds limit")
-    body = _recv_exact(sock, size)
-    if body is None:
-        return None
-    return json.loads(body)
-
-
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        try:
-            chunk = sock.recv(n - len(buf))
-        except OSError:
-            return None
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
+    send_json(sock, obj, wlock)
 
 
 def _b64(v: Optional[bytes]) -> Optional[str]:
@@ -346,7 +316,6 @@ class NetBackend(BackendOperations):
         self.op_timeout = op_timeout
         self._sock = socket.create_connection((host, int(port)), timeout=10.0)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.settimeout(None)
         self._wlock = threading.Lock()
         self._pending: Dict[int, Tuple[threading.Event, list]] = {}
         self._plock = threading.Lock()
@@ -354,9 +323,14 @@ class NetBackend(BackendOperations):
         self._watchers: Dict[int, Watcher] = {}
         self._closed = threading.Event()
         try:
+            # the connect timeout still arms the socket here, so a peer
+            # that accepts but never speaks (firewall blackhole, wrong
+            # service) fails the probe instead of hanging forever
             hello = _recv_frame(self._sock)
             if hello is None or "lease" not in hello:
-                raise ConnectionError("kvstore server hello missing")
+                raise ConnectionError(
+                    "kvstore server hello missing (timeout or wrong service)"
+                )
             self.lease_id = int(hello["lease"])
             self.lease_ttl = float(hello.get("ttl", 15.0))
         except Exception:
@@ -364,6 +338,7 @@ class NetBackend(BackendOperations):
             # (a supervisor retry loop would bleed one per attempt)
             self._sock.close()
             raise
+        self._sock.settimeout(None)
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
         self._ka = threading.Thread(target=self._keepalive_loop, daemon=True)

@@ -99,14 +99,7 @@ class _PortPolicy:
         )
 
 
-def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        chunk = conn.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
+from ..utils.framing import recv_exact as _recv_exact  # shared framing
 
 
 def _read_http_head(conn: socket.socket, limit: int = 65536) -> Optional[bytes]:

@@ -206,3 +206,109 @@ class TestCLI:
         assert self._run(tmp_path, "status") == 0
         st = json.loads(capsys.readouterr().out)
         assert st["endpoints"] == 2
+
+
+class TestParityCommands:
+    """The round-out of the reference command set: endpoint get/
+    regenerate/labels, bpf ct flush, map list, node list, prefilter
+    delete, version, cleanup (cilium/cmd/*.go)."""
+
+    @pytest.fixture()
+    def server(self, daemon, tmp_path):
+        sock = str(tmp_path / "api.sock")
+        srv = APIServer(daemon, sock)
+        srv.start()
+        yield APIClient(sock)
+        srv.stop()
+
+    def test_endpoint_get_and_regenerate(self, server):
+        server.policy_put(RULES)
+        server.endpoint_put(7, ["k8s:app=web"], ipv4="10.1.0.7")
+        model = server.endpoint_get(7)
+        assert model["id"] == 7 and model["ipv4"] == "10.1.0.7"
+        with pytest.raises(APIError):
+            server.endpoint_get(404)
+        assert server.endpoint_regenerate(7)["regenerated"] == 1
+        assert server.endpoint_regenerate()["regenerated"] >= 1
+        with pytest.raises(APIError):
+            server.endpoint_regenerate(404)
+
+    def test_endpoint_labels_changes_identity_and_verdict(self, server):
+        """Label modification must re-resolve the identity AND flip
+        enforcement (the modifyEndpointIdentityLabels contract)."""
+        server.policy_put(RULES)
+        server.endpoint_put(7, ["k8s:app=other"], ipv4="10.1.0.7")
+        server.endpoint_put(9, ["k8s:app=lb"], ipv4="10.1.0.9")  # peer
+        before = server.endpoint_get(7)["identity"]
+        out = server.endpoint_labels(
+            7, add=["k8s:app=web"], delete=["k8s:app=other"]
+        )
+        assert out["labels"] == ["k8s:app=web"]
+        assert out["identity"] != before
+        # the policymap now carries the web allow rule
+        dump = server.policymap_get(7)
+        assert any(r["dport"] == 80 for r in dump)
+
+    def test_endpoint_labels_sourceless_spelling(self, server):
+        """The spelling the user typed must round-trip: `-l app=web`
+        stores unspec:app=web, and `-d app=web` (no source) must
+        delete it — raw-string set math would silently no-op."""
+        server.policy_put(RULES)
+        server.endpoint_put(7, ["app=web"], ipv4="10.1.0.7")
+        out = server.endpoint_labels(7, delete=["app=web"], add=["app=db"])
+        assert out["labels"] == ["unspec:app=db"]
+        # adding the same key=value under its existing source is a no-op
+        before = server.endpoint_get(7)["identity"]
+        out = server.endpoint_labels(7, add=["app=db"])
+        assert out["identity"] == before
+
+    def test_map_list_ct_flush_node_list(self, server):
+        maps = {m["name"] for m in server.map_list()}
+        assert {"ct", "ipcache", "tunnel", "proxy", "metrics",
+                "routes"} <= maps
+        assert server.ct_flush()["flushed"] >= 0
+        assert server.node_list() == []  # standalone: no peers
+
+    def test_prefilter_delete(self, server):
+        rev = server.prefilter_patch(["10.9.0.0/16"])["revision"]
+        assert "10.9.0.0/16" in server.prefilter_get()["cidrs"]
+        server.prefilter_delete(["10.9.0.0/16"], revision=rev)
+        assert "10.9.0.0/16" not in server.prefilter_get()["cidrs"]
+
+
+class TestLocalCommands:
+    def test_version(self, capsys):
+        assert cli_main(["version"]) == 0
+        out = capsys.readouterr().out
+        assert out.startswith("cilium-tpu ")
+
+    def test_cleanup_dry_run_then_force(self, tmp_path, capsys):
+        state = tmp_path / "state"
+        state.mkdir()
+        (state / "f").write_text("x")
+        sock = str(tmp_path / "sock")
+        args = ["--socket", sock, "--state", str(state)]
+        assert cli_main([*args, "cleanup"]) == 0
+        assert "dry run" in capsys.readouterr().out
+        assert state.exists()
+        assert cli_main([*args, "cleanup", "--force"]) == 0
+        capsys.readouterr()
+        assert not state.exists()
+
+    def test_cli_labels_and_flush_standalone(self, tmp_path, capsys):
+        state = str(tmp_path / "state")
+        sock = str(tmp_path / "nonexistent.sock")
+        args = ["--socket", sock, "--state", state]
+        rules_file = tmp_path / "rules.json"
+        rules_file.write_text(json.dumps(RULES))
+        assert cli_main([*args, "policy", "import", str(rules_file)]) == 0
+        assert cli_main([*args, "endpoint", "add", "7",
+                         "-l", "k8s:app=other", "--ipv4", "10.1.0.7"]) == 0
+        capsys.readouterr()
+        assert cli_main([*args, "endpoint", "labels", "7",
+                         "-a", "k8s:app=web", "-d", "k8s:app=other"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["labels"] == ["k8s:app=web"]
+        assert cli_main([*args, "bpf", "ct", "flush"]) == 0
+        assert cli_main([*args, "map", "list"]) == 0
+        assert cli_main([*args, "node", "list"]) == 0
