@@ -149,10 +149,16 @@ class _Surface:
         return out
 
     def _d_endpoint_regenerate(self, ep_id=None):
-        return self._daemon.endpoint_regenerate(ep_id)
+        try:
+            return self._daemon.endpoint_regenerate(ep_id)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
 
     def _d_endpoint_labels(self, ep_id, add=(), delete=()):
-        return self._daemon.endpoint_labels(ep_id, add=add, delete=delete)
+        try:
+            return self._daemon.endpoint_labels(ep_id, add=add, delete=delete)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
 
     def _d_map_list(self):
         return self._daemon.map_list()
@@ -440,12 +446,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                 cluster=args.cluster,
             )
             cluster_node.export_services()
+
             # convergence controller: drain cluster subscriptions on
             # an interval (the kvstore watch pump of the reference's
-            # controller loops)
+            # controller loops). A dead backend (kvstore outage)
+            # triggers a rejoin attempt on a fresh connection; while
+            # the server is down the factory raises and the
+            # controller's backoff keeps retrying — enforcement keeps
+            # running on local state the whole time.
+            def _cluster_sync():
+                if not cluster_node.backend.alive():
+                    cluster_node.rejoin(backend_from_target(args.join, name))
+                cluster_node.pump()
+                cluster_node.export_services()
+
             cluster_pump = Controller(
-                "cluster-sync",
-                lambda: (cluster_node.pump(), cluster_node.export_services()),
+                "cluster-sync", _cluster_sync,
                 run_interval=args.sync_interval,
             )
         server = APIServer(daemon, args.socket)
@@ -810,12 +826,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     return 0
 
 
-if __name__ == "__main__":
+def run() -> int:
+    """Entry point shared by `python -m cilium_tpu` and
+    `python -m cilium_tpu.cli`."""
     try:
-        sys.exit(main())
+        return main()
     except BrokenPipeError:
         # `cilium-tpu ... | head` closing the pipe is not an error;
         # devnull swap avoids a second BrokenPipeError at interpreter
         # shutdown when stdout flushes
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
-        sys.exit(0)
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

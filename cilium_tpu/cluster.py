@@ -33,6 +33,11 @@ from .utils.logging import get_logger
 
 log = get_logger("cluster")
 
+# a backend that died mid-operation (kvstore outage) raises these;
+# teardown paths treat them as "the server's lease expiry will finish
+# the job"
+_KV_DOWN = (ConnectionError, TimeoutError, RuntimeError, OSError)
+
 
 class ClusterNode:
     def __init__(
@@ -47,6 +52,8 @@ class ClusterNode:
         self.daemon = daemon
         self.backend = backend
         self.cluster = cluster
+        self.probe_interval = probe_interval
+        self._closed = False
         # cluster-wide identity numbering (InitIdentityAllocator)
         self.identities = DistributedIdentityAllocator(
             backend, daemon.registry, node.name
@@ -153,7 +160,12 @@ class ClusterNode:
         are WITHDRAWN (not left to lease expiry: peers must stop
         routing here immediately), learned tunnel/route state is
         flushed, and the prober is halted rather than probing a
-        frozen node list forever."""
+        frozen node list forever.
+
+        Tolerates a DEAD backend (kvstore outage): the remote
+        withdrawals are skipped — the server-side lease expiry is
+        already doing that job — while every local teardown still
+        runs, so a rejoin can follow."""
         if getattr(self, "_closed", False):
             return
         self._closed = True
@@ -163,7 +175,10 @@ class ClusterNode:
         daemon.ipcache.remove_listener(self._on_ipcache_change)
         daemon.health.stop()
         daemon.health.nodes = None
-        self.ipsync.withdraw_all()
+        try:
+            self.ipsync.withdraw_all()
+        except _KV_DOWN:
+            log.warning("kvstore unreachable; leaving withdrawals to lease expiry")
         # learned state must not outlive the membership: encap tables
         # AND the kvstore-sourced ip→identity entries (with the
         # watcher gone they would never update again — a reused peer
@@ -177,6 +192,33 @@ class ClusterNode:
                 daemon.ipcache.delete(cidr, SOURCE_KVSTORE)
         self.mesh.close()
         self.ipsync.close()
-        self.nodes.unregister()
+        try:
+            self.nodes.unregister()
+        except _KV_DOWN:
+            pass  # lease expiry withdraws the registration
         self.nodes.close()
         self.identities.close()
+
+    # -- failure recovery ------------------------------------------------
+    def rejoin(self, backend: BackendOperations) -> "ClusterNode":
+        """Recover from a kvstore outage: tear this membership down
+        (tolerating the dead backend) and rebuild it on a fresh one.
+        Everything __init__ does runs again — identities re-CAS
+        (endpoints keep or re-agree their numbers), this node
+        re-registers, and every agent-sourced ip→identity entry
+        re-announces via the replaying ipcache listener. The reference
+        analog: the etcd session-loss → reconnect → re-create path of
+        pkg/kvstore/allocator + node store. Returns self."""
+        # under the daemon lock (an RLock — the constructors re-enter
+        # it): an endpoint PUT landing between close() and the
+        # adoption snapshot would otherwise keep a local-cursor
+        # identity number the new cluster never CAS-agreed, and two
+        # nodes could map one id to different label sets
+        with self.daemon._lock:
+            self.close()
+            self.__init__(
+                self.daemon, backend, self.nodes.local,
+                cluster=self.cluster, probe_interval=self.probe_interval,
+            )
+        self.export_services()
+        return self

@@ -104,6 +104,12 @@ class BackendOperations:
     def status(self) -> str:
         raise NotImplementedError
 
+    def alive(self) -> bool:
+        """False once the backend can no longer reach the store (a
+        network client whose connection died). Local backends are
+        alive until closed."""
+        return True
+
     def lock_path(self, path: str, timeout: float = 10.0) -> "KVLock":
         """Distributed lock by CAS-creating a lease-bound lock key,
         retried until acquired (etcd-style, pkg/kvstore/lock.go). The

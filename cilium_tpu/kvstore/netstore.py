@@ -426,6 +426,9 @@ class NetBackend(BackendOperations):
         return resp
 
     # -- BackendOperations ---------------------------------------------
+    def alive(self) -> bool:
+        return not self._closed.is_set()
+
     def status(self) -> str:
         try:
             return self._call({"op": "status"})["status"]

@@ -237,3 +237,90 @@ class TestCrossProcess:
         finally:
             proc.terminate()
             proc.wait(timeout=5)
+
+
+class TestOutageRecovery:
+    """The kvstore-outage chaos scenario (reference:
+    test/runtime/kvstore.go): the server dies, enforcement keeps
+    running on local state, and when a server is back the agents
+    REJOIN — re-register, re-announce, re-agree identities."""
+
+    def test_agents_survive_outage_and_rejoin(self):
+        from cilium_tpu.cluster import ClusterNode
+        from cilium_tpu.daemon import Daemon
+        from cilium_tpu.nodes.registry import Node
+
+        srv = KVStoreServer(lease_ttl=0.5).start()
+        made = []
+
+        def make(name, ip, pod_cidr):
+            d = Daemon(pod_cidr=pod_cidr, health_probe=lambda a, p: 0.001)
+            cn = ClusterNode(
+                d, NetBackend(srv.url, name),
+                Node(name=name, ipv4=ip, ipv4_alloc_cidr=pod_cidr),
+                probe_interval=3600,
+            )
+            made.append((d, cn))
+            return d, cn
+
+        da, ca = make("node-a", "192.168.0.1", "10.1.0.0/16")
+        db_, cb = make("node-b", "192.168.0.2", "10.2.0.0/16")
+        try:
+            da.endpoint_add(1, ["k8s:app=client"], ipv4="10.1.0.7")
+            for _ in range(6):
+                ca.pump(); cb.pump()
+            ident_before = da.endpoint_manager.lookup(1).identity.id
+            assert db_.ipcache.lookup_by_ip("10.1.0.7") is not None
+
+            # ---- outage: the server dies mid-flight ----
+            srv.stop()
+            deadline = time.monotonic() + 5
+            while (ca.backend.alive() or cb.backend.alive()) and (
+                time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            assert not ca.backend.alive() and not cb.backend.alive()
+            # enforcement state is untouched: the endpoint keeps its
+            # identity, pumps are no-ops (not crashes)
+            assert da.endpoint_manager.lookup(1).identity.id == ident_before
+            assert ca.pump() == 0
+
+            # ---- recovery: a fresh (empty) server on the same port ----
+            srv2 = KVStoreServer(lease_ttl=0.5).start()
+            try:
+                ca.rejoin(NetBackend(srv2.url, "node-a"))
+                cb.rejoin(NetBackend(srv2.url, "node-b"))
+                for _ in range(6):
+                    ca.pump(); cb.pump()
+                # node B re-learned node A's endpoint from the new fabric
+                info = db_.ipcache.lookup_by_ip("10.1.0.7")
+                assert info is not None and info.source == "kvstore"
+                assert info.identity == da.endpoint_manager.lookup(1).identity.id
+                assert "node-a" in {n.name for n in cb.nodes.remote_nodes()}
+            finally:
+                srv2.stop()
+        finally:
+            for d, cn in made:
+                cn.close()
+                d.shutdown()
+
+    def test_close_with_dead_backend_does_not_raise(self):
+        from cilium_tpu.cluster import ClusterNode
+        from cilium_tpu.daemon import Daemon
+        from cilium_tpu.nodes.registry import Node
+
+        srv = KVStoreServer(lease_ttl=0.5).start()
+        d = Daemon(pod_cidr="10.1.0.0/16", health_probe=lambda a, p: 0.001)
+        cn = ClusterNode(
+            d, NetBackend(srv.url, "node-a"),
+            Node(name="node-a", ipv4="192.168.0.1",
+                 ipv4_alloc_cidr="10.1.0.0/16"),
+            probe_interval=3600,
+        )
+        d.endpoint_add(1, ["k8s:app=x"], ipv4="10.1.0.9")
+        srv.stop()
+        deadline = time.monotonic() + 5
+        while cn.backend.alive() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        cn.close()  # must not raise despite the dead backend
+        d.shutdown()
