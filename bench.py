@@ -16,6 +16,7 @@ import os
 import random
 import sys
 import time
+from typing import Tuple
 
 os.environ.setdefault("XLA_FLAGS", "")
 import jax
@@ -211,29 +212,55 @@ def _bench_rule_delete(engine, repo, rng) -> float:
     return sorted(samples)[len(samples) // 2] * 1000
 
 
-def _bench_lpm_50k(nrng: np.random.Generator) -> float:
-    """50k-prefix LPM match rate (BASELINE.md north-star: the ipcache
+def _bench_lpm_50k(nrng: np.random.Generator) -> Tuple[float, float]:
+    """50k-prefix LPM match rates (BASELINE.md north-star: the ipcache
     identity-derivation stage at production prefix counts,
-    bpf/node_config.h IPCACHE_MAP_SIZE envelope). Measures the wide
-    (dense-16-bit-first-stride) trie the IPv4 datapath actually runs."""
-    from cilium_tpu.ops.lpm import WideTrieBuilder, lpm_lookup_wide
+    bpf/node_config.h IPCACHE_MAP_SIZE envelope). Two shapes:
 
+    - scattered: prefixes uniform over 2^32 — the adversarial spread
+      that forces the 16-8-8 pointer layout (3 chained gathers)
+    - clustered: prefixes inside 100 pod-CIDR /16s — the real cluster
+      shape, which build_wide_trie serves with the flat 16+16 layout
+      (2 chained gathers)
+    """
+    from cilium_tpu.ops.lpm import WideTrieBuilder, build_wide_trie, lpm_lookup_wide
+
+    def rate(arrays, q):
+        arrays = tuple(jnp.asarray(a) for a in arrays)
+        q = jnp.asarray(q)
+        r = lpm_lookup_wide(*arrays, q)
+        jax.block_until_ready(r)
+        iters = 10
+        t0 = time.time()
+        for _ in range(iters):
+            r = lpm_lookup_wide(*arrays, q)
+        jax.block_until_ready(r)
+        return iters * q.shape[0] / (time.time() - t0)
+
+    b = 1 << 20
     tb = WideTrieBuilder()
     addrs = nrng.integers(0, 2**32, 50_000, dtype=np.uint64).astype(np.uint32)
     plens = nrng.choice(np.array([8, 12, 16, 20, 24, 28, 32]), 50_000)
     for a, pl in zip(addrs.tolist(), plens.tolist()):
         tb.insert(a, pl, a % 65000)
-    arrays = tuple(jnp.asarray(a) for a in tb.arrays())
-    b = 1 << 20
-    q = jnp.asarray(nrng.integers(0, 2**32, b, dtype=np.uint64).astype(np.uint32))
-    r = lpm_lookup_wide(*arrays, q)
-    jax.block_until_ready(r)
-    iters = 10
-    t0 = time.time()
-    for _ in range(iters):
-        r = lpm_lookup_wide(*arrays, q)
-    jax.block_until_ready(r)
-    return iters * b / (time.time() - t0)
+    scattered = rate(
+        tb.arrays(),
+        nrng.integers(0, 2**32, b, dtype=np.uint64).astype(np.uint32),
+    )
+
+    hi16 = nrng.integers(0, 2**16, 100, dtype=np.uint64).astype(np.uint32)
+    lo = nrng.integers(0, 2**16, 50_000, dtype=np.uint64).astype(np.uint32)
+    c_addrs = (nrng.choice(hi16, 50_000) << np.uint32(16)) | lo
+    c_plens = nrng.choice(np.array([20, 24, 28, 32]), 50_000)
+    clustered = rate(
+        build_wide_trie(
+            (f"{a >> 24 & 255}.{a >> 16 & 255}.{a >> 8 & 255}.{a & 255}/{pl}", int(a % 65000))
+            for a, pl in zip(c_addrs.tolist(), c_plens.tolist())
+        ),
+        (nrng.choice(hi16, b) << np.uint32(16))
+        | nrng.integers(0, 2**16, b, dtype=np.uint64).astype(np.uint32),
+    )
+    return scattered, clustered
 
 
 def _bench_l7_dfa() -> float:
@@ -343,6 +370,62 @@ def _bench_native(snaps, idents, nrng: np.random.Generator):
         if ncpu >= 2:  # scaling is meaningless on one core
             mt[k] = run_threads(k)
     return single, mt
+
+
+def _bench_pipeline_e2e(repo, reg, idents, nrng: np.random.Generator) -> float:
+    """Device-resident FULL datapath chain (deny-LPM skip on empty
+    prefilter → identity LPM → policymap lookup → counters) on one
+    pre-staged batch — the cold-flow batch path a host front-end feeds.
+    Host→device transfer is excluded: over the axon tunnel the PCIe
+    analogue costs ~seconds/GB and would measure the tunnel, not the
+    engine (production front-ends stream batches asynchronously)."""
+    from cilium_tpu.datapath.pipeline import (
+        TRAFFIC_INGRESS,
+        DatapathPipeline,
+        process_flows_wide,
+    )
+    from cilium_tpu.engine import PolicyEngine
+    from cilium_tpu.ipcache.ipcache import IPCache
+    from cilium_tpu.ipcache.prefilter import PreFilter
+
+    eng = PolicyEngine(repo, reg)
+    cache = IPCache()
+    for i, ident in enumerate(idents):
+        cache.upsert(
+            f"10.{(i >> 8) & 255}.{i & 255}.1/32", ident.id, source="k8s"
+        )
+    pipe = DatapathPipeline(eng, cache, PreFilter(), conntrack=None)
+    pipe.set_endpoints([idents[j].id for j in range(N_ENDPOINTS)])
+    b = 1 << 20
+    i_sel = nrng.integers(0, len(idents), b)
+    ips = (
+        np.uint32(10) << 24
+        | ((i_sel >> 8) & 255).astype(np.uint32) << 16
+        | (i_sel & 255).astype(np.uint32) << 8
+        | 1
+    ).astype(np.uint32)
+    eps = nrng.integers(0, N_ENDPOINTS, b).astype(np.int32)
+    dports = nrng.choice(np.array([80, 443, 8080, 53, 22], np.int32), b)
+    protos = np.where(dports == 53, 17, 6).astype(np.int32)
+    pipe.process(ips[:1024], eps[:1024], dports[:1024], protos[:1024])
+    t = pipe._tables[(TRAFFIC_INGRESS, 4)]
+    d = [jnp.asarray(a) for a in (ips, eps, dports, protos)]
+    pf_stage = not pipe._pf_empty[0]
+
+    def run():
+        v, _red, _c = process_flows_wide(
+            t, *d, ep_count=N_ENDPOINTS, prefilter=pf_stage,
+            row_override=None,
+        )
+        return v
+
+    jax.block_until_ready(run())
+    iters = 10
+    t0 = time.time()
+    for _ in range(iters):
+        v = run()
+    jax.block_until_ready(v)
+    return iters * b / (time.time() - t0)
 
 
 def _bench_native_e2e(snaps, idents, nrng: np.random.Generator):
@@ -650,7 +733,9 @@ def main() -> None:
     # native C++ front-end on the same realized state, and a warm full
     # re-materialization (the rebuild path rule deletion takes).
     extra = os.environ.get("BENCH_EXTRA", "1") != "0"
-    lpm50k = _bench_lpm_50k(np.random.default_rng(3)) if extra else 0.0
+    lpm50k, lpm50k_clustered = (
+        _bench_lpm_50k(np.random.default_rng(3)) if extra else (0.0, 0.0)
+    )
     l7_dfa = _bench_l7_dfa() if extra else 0.0
     kafka_acl = _bench_kafka_acl() if extra else 0.0
     native_vps, native_mt = (
@@ -661,6 +746,10 @@ def main() -> None:
     native_e2e_vps, native_e2e_est_vps = (
         _bench_native_e2e(_snaps, idents, np.random.default_rng(9))
         if extra else (0.0, 0.0)
+    )
+    pipeline_e2e_vps = (
+        _bench_pipeline_e2e(repo, reg, idents, np.random.default_rng(13))
+        if extra else 0.0
     )
     t0 = time.time()
     tables2, _ = materialize_endpoints(
@@ -689,6 +778,7 @@ def main() -> None:
         "update_rule_ms": round(update_rule_ms, 1),
         "update_rule_delete_ms": round(update_rule_delete_ms, 1),
         "lpm50k_lps": round(lpm50k),
+        "lpm50k_clustered_lps": round(lpm50k_clustered),
         "l7_dfa_rps": round(l7_dfa),
         "kafka_acl_rps": round(kafka_acl),
         "native_vps": round(native_vps),
@@ -701,6 +791,7 @@ def main() -> None:
         "native_l7_rps": round(native_l7_rps),
         "native_e2e_vps": round(native_e2e_vps),
         "native_e2e_est_vps": round(native_e2e_est_vps),
+        "pipeline_e2e_vps": round(pipeline_e2e_vps),
         "rebuild_warm_s": round(rebuild_warm_s, 2),
         "stretch_100k": stretch,
     }

@@ -455,8 +455,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             # controller's backoff keeps retrying — enforcement keeps
             # running on local state the whole time.
             def _cluster_sync():
-                if not cluster_node.backend.alive():
+                if not cluster_node.joined():
                     cluster_node.rejoin(backend_from_target(args.join, name))
+                    # export follows below — rejoin itself doesn't
                 cluster_node.pump()
                 cluster_node.export_services()
 

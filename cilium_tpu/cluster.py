@@ -21,7 +21,7 @@ loop in daemons), matching the rest of the kvstore layer.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 from .identity.distributed import DistributedIdentityAllocator
 from .ipcache.ipcache import SOURCE_AGENT
@@ -54,6 +54,9 @@ class ClusterNode:
         self.cluster = cluster
         self.probe_interval = probe_interval
         self._closed = False
+        # name → (backend, factory) for every add_remote_cluster call,
+        # so rejoin() can re-establish clustermesh subscriptions
+        self._remote_clusters: Dict[str, Tuple] = {}
         # cluster-wide identity numbering (InitIdentityAllocator)
         self.identities = DistributedIdentityAllocator(
             backend, daemon.registry, node.name
@@ -139,7 +142,14 @@ class ClusterNode:
         (the clustermesh services export)."""
         return self.daemon.services.export_to_store(self.backend, self.cluster)
 
-    def add_remote_cluster(self, name: str, backend: BackendOperations):
+    def add_remote_cluster(self, name: str, backend: BackendOperations,
+                           factory=None):
+        """Subscribe a remote cluster's state (clustermesh). ``factory``
+        (→ a fresh BackendOperations) lets rejoin() re-establish the
+        subscription after an outage; without one a rejoin re-uses
+        ``backend`` if it is still alive and otherwise drops the
+        cluster with a warning."""
+        self._remote_clusters[name] = (backend, factory)
         return self.mesh.add_cluster(name, backend)
 
     # -- convergence ----------------------------------------------------
@@ -214,11 +224,60 @@ class ClusterNode:
         # adoption snapshot would otherwise keep a local-cursor
         # identity number the new cluster never CAS-agreed, and two
         # nodes could map one id to different label sets
+        remotes = dict(self._remote_clusters)
+        # Held across the rebuild on purpose: API calls stall for the
+        # duration (bounded by the backend's op timeout per CAS), but
+        # an endpoint created mid-rebuild with an un-agreed identity
+        # number would poison cross-node enforcement — correctness
+        # over availability, and the controller only retries on the
+        # backoff schedule. Callers can hand rejoin a backend with a
+        # short op_timeout to bound the worst case.
         with self.daemon._lock:
             self.close()
-            self.__init__(
-                self.daemon, backend, self.nodes.local,
-                cluster=self.cluster, probe_interval=self.probe_interval,
-            )
-        self.export_services()
+            try:
+                self.__init__(
+                    self.daemon, backend, self.nodes.local,
+                    cluster=self.cluster, probe_interval=self.probe_interval,
+                )
+            except Exception:
+                # the server died AGAIN mid-rebuild: restore the
+                # standalone fallbacks a half-run __init__ may have
+                # rebound (allocation must keep working locally) and
+                # leave the node closed so the next controller tick
+                # retries the whole rejoin
+                d = self.daemon
+                d.allocate_identity = d.registry.allocate
+                d.release_identity = d.registry.release
+                try:
+                    d.ipcache.remove_listener(self._on_ipcache_change)
+                except Exception:
+                    pass
+                d.health.stop()
+                d.health.nodes = None
+                self._closed = True
+                try:
+                    backend.close()
+                except Exception:
+                    pass
+                raise
+        # clustermesh subscriptions are per-remote-backend: re-add each
+        # (fresh backend from its factory when given; else reuse the
+        # old one if it survived the outage)
+        for cname, (rbe, factory) in remotes.items():
+            try:
+                fresh = factory() if factory is not None else rbe
+                if not fresh.alive():
+                    raise ConnectionError("remote backend not alive")
+                self.add_remote_cluster(cname, fresh, factory)
+            except Exception as e:
+                log.warning("remote cluster dropped at rejoin", fields={
+                    "cluster": cname, "err": f"{type(e).__name__}: {e}",
+                })
+        # no export_services() here: the cluster-sync controller runs
+        # one right after every successful rejoin anyway
         return self
+
+    def joined(self) -> bool:
+        """True while this membership is live (backend reachable and
+        not torn down) — the cluster-sync controller's rejoin gate."""
+        return not self._closed and self.backend.alive()

@@ -403,6 +403,12 @@ class DatapathPipeline:
         self._mat_sig: Tuple = ()  # endpoint list the policymap was built for
         self._last_delta_seq = 0  # engine delta cursor
         self._trie_versions: Tuple = ()  # (ipcache.version, prefilter.revision)
+        # (v4_empty, v6_empty) for the COMPILED prefilter tries: an
+        # empty deny set skips the whole deny-LPM walk (which would
+        # otherwise cost as much as the identity walk — half the
+        # end-to-end pipeline), matching the reference's no-op empty
+        # XDP maps. Updated together with self._tables.
+        self._pf_empty: Tuple[bool, bool] = (True, True)
         self._tries: Optional[Tuple] = None  # ((pf4, ip4), (pf6, ip6), world_row)
         self.counters = np.zeros((0, 3), np.int64)
 
@@ -504,6 +510,10 @@ class DatapathPipeline:
                 )
                 # IPv4 rides the wide (dense-16-bit-first) tries
                 _, pf_cidrs = self.prefilter.dump()
+                self._pf_empty = (
+                    not any(":" not in c for c in pf_cidrs),
+                    not any(":" in c for c in pf_cidrs),
+                )
                 pf_wide = build_wide_trie(
                     (c, 0) for c in pf_cidrs if ":" not in c
                 )
@@ -731,6 +741,10 @@ class DatapathPipeline:
                 row_override=row_override,
             )
         ro = None if row_override is None else jnp.asarray(row_override)
+        # XDP prefilter guards traffic entering the node only, and an
+        # empty deny set skips the walk entirely (it's one of the two
+        # LPM walks that dominate the pipeline)
+        pf_stage = ingress and not self._pf_empty[0 if family == 4 else 1]
         if family == 4:
             peer_u32 = _pack_v4_u32(peer_bytes)
             v, red, counters = process_flows_wide(
@@ -740,8 +754,7 @@ class DatapathPipeline:
                 jnp.asarray(dports),
                 jnp.asarray(protos),
                 ep_count=max(1, len(self._endpoints)),
-                # XDP prefilter guards traffic entering the node only
-                prefilter=ingress,
+                prefilter=pf_stage,
                 row_override=ro,
             )
         else:
@@ -753,7 +766,7 @@ class DatapathPipeline:
                 jnp.asarray(protos),
                 ep_count=max(1, len(self._endpoints)),
                 levels=16,
-                prefilter=ingress,
+                prefilter=pf_stage,
                 row_override=ro,
             )
         return (
@@ -1022,7 +1035,10 @@ class DatapathPipeline:
                 now,
                 jnp.asarray(valid),
                 ep_count=max(1, len(self._endpoints)),
-                prefilter=ingress,
+                prefilter=(
+                    ingress
+                    and not self._pf_empty[0 if family == 4 else 1]
+                ),
                 levels=16,
                 family=family,
             )

@@ -370,6 +370,9 @@ class InMemoryBackend(BackendOperations):
     def status(self) -> str:
         return "in-memory: %d leases live" % len(self.store._leases)
 
+    def alive(self) -> bool:
+        return not self._closed
+
     def _lease(self, lease: bool) -> Optional[int]:
         if not lease:
             return None

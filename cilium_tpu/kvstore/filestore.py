@@ -186,6 +186,9 @@ class FileBackend(BackendOperations):
         )
 
     # -- BackendOperations ----------------------------------------------
+    def alive(self) -> bool:
+        return not self._closed.is_set()
+
     def status(self) -> str:
         with self._read() as cur:
             n = cur.execute(

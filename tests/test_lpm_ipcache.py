@@ -218,3 +218,44 @@ class TestWideTrie:
         rw = lpm_lookup_wide(*(jnp.asarray(a) for a in wide), jnp.asarray(q))
         assert np.array_equal(np.asarray(r8), np.asarray(rw))
         assert (np.asarray(r8) > 0).sum() > 5000  # matches actually occur
+
+
+class TestFlatTrieParity:
+    def test_flat_and_wide_layouts_agree(self):
+        """build_wide_trie's two layouts (2-gather flat 16+16 vs
+        3-gather 16-8-8) must return identical LPM results on the same
+        prefix set — the layout switch at FLAT_TRIE_MAX_NODES must
+        never change semantics."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from cilium_tpu.ops.lpm import (
+            FlatTrieBuilder,
+            WideTrieBuilder,
+            lpm_lookup_wide,
+        )
+
+        rng = np.random.default_rng(21)
+        hi16 = rng.integers(0, 2**16, 9, dtype=np.uint64).astype(np.uint32)
+        n = 4000
+        addrs = (
+            (rng.choice(hi16, n) << np.uint32(16))
+            | rng.integers(0, 2**16, n, dtype=np.uint64).astype(np.uint32)
+        )
+        plens = rng.choice(np.array([8, 12, 16, 17, 20, 24, 28, 31, 32]), n)
+        flat, wide = FlatTrieBuilder(), WideTrieBuilder()
+        for a, pl in zip(addrs.tolist(), plens.tolist()):
+            flat.insert(a, pl, a % 60000)
+            wide.insert(a, pl, a % 60000)
+        q = np.concatenate([
+            addrs[:2000],  # exact hits
+            (rng.choice(hi16, 2000) << np.uint32(16))
+            | rng.integers(0, 2**16, 2000, dtype=np.uint64).astype(np.uint32),
+            rng.integers(0, 2**32, 2000, dtype=np.uint64).astype(np.uint32),
+        ]).astype(np.uint32)
+        rf = np.asarray(lpm_lookup_wide(*[jnp.asarray(a) for a in flat.arrays()], jnp.asarray(q)))
+        rw = np.asarray(lpm_lookup_wide(*[jnp.asarray(a) for a in wide.arrays()], jnp.asarray(q)))
+        assert flat.arrays()[3].shape[-1] == 65536  # flat layout actually built
+        assert wide.arrays()[3].shape[-1] == 256
+        np.testing.assert_array_equal(rf, rw)
