@@ -259,6 +259,10 @@ class ClusterNode:
                     backend.close()
                 except Exception:
                     pass
+                # the partial __init__ reset _remote_clusters: keep the
+                # snapshot so the NEXT successful rejoin still re-adds
+                # every clustermesh subscription
+                self._remote_clusters = remotes
                 raise
         # clustermesh subscriptions are per-remote-backend: re-add each
         # (fresh backend from its factory when given; else reuse the

@@ -2,8 +2,10 @@
 lossy multicast hub, and the monitor socket protocol (the roles of
 monitor/ + pkg/monitor in the reference)."""
 
+from .dissect import Dissection, dissect
 from .events import (
     EVENT_AGENT,
+    EVENT_CAPTURE,
     EVENT_DROP,
     EVENT_L7,
     EVENT_TRACE,
@@ -11,6 +13,7 @@ from .events import (
     REASON_POLICY,
     REASON_PREFILTER,
     AgentNotify,
+    DebugCapture,
     DropNotify,
     L7Notify,
     TraceNotify,
@@ -23,6 +26,9 @@ from .server import MonitorServer, monitor_stream
 
 __all__ = [
     "AgentNotify",
+    "DebugCapture",
+    "Dissection",
+    "dissect",
     "DropNotify",
     "EVENT_AGENT",
     "EVENT_DROP",

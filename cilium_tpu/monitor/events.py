@@ -22,6 +22,7 @@ EVENT_DROP = 1
 EVENT_TRACE = 2
 EVENT_AGENT = 3
 EVENT_L7 = 4
+EVENT_CAPTURE = 5  # DebugCapture (datapath_debug.go:368)
 
 # drop reasons (bpf/lib/common.h DROP_* / pkg/monitor/api errors)
 REASON_POLICY = 133  # DROP_POLICY
@@ -152,6 +153,32 @@ class L7Notify:
 # Flow events: type u8, sub u8 (reason/obs), flags u8 (bit0 ingress,
 # bit1 family==6), proto u8, endpoint u32, identity u32, dport u16,
 # pad u16, timestamp f64, addr 16s (v4 left-aligned, zero-padded).
+@dataclasses.dataclass(frozen=True)
+class DebugCapture:
+    """A raw packet capture from the datapath (DebugCapture,
+    pkg/monitor/datapath_debug.go:368): the monitor dissects the
+    payload into a per-layer summary (dissect.py — the gopacket role
+    of pkg/monitor/dissect.go)."""
+
+    endpoint: int
+    data: bytes  # raw Ethernet frame (possibly truncated by the capture)
+    orig_len: int = 0  # pre-truncation length (0 = len(data))
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+    @property
+    def type(self) -> int:
+        return EVENT_CAPTURE
+
+    def summary(self) -> str:
+        from .dissect import dissect
+
+        n = self.orig_len or len(self.data)
+        return (
+            f"** capture ep {self.endpoint} ({n} bytes): "
+            f"{dissect(self.data).summary()}"
+        )
+
+
 _FLOW_FMT = "<BBBBIIHHd16s"
 _FLOW_LEN = struct.calcsize(_FLOW_FMT)
 
@@ -174,6 +201,17 @@ def encode(ev) -> bytes:
         v = ev.verdict.encode()
         d = ev.detail.encode()
         return struct.pack("<BHH", t, len(v), len(d)) + v + d + struct.pack("<d", ev.timestamp)
+    if t == EVENT_CAPTURE:
+        # the wire length field is u16: oversized aggregates (GRO/
+        # jumbo) ship their head + the true length — never a codec
+        # crash inside the publish path
+        data = ev.data[:65535]
+        return (
+            struct.pack("<BIIHd", t, ev.endpoint,
+                        ev.orig_len or len(ev.data), len(data),
+                        ev.timestamp)
+            + data
+        )
     raise ValueError(f"unknown event type {t}")
 
 
@@ -201,4 +239,11 @@ def decode(buf: bytes):
         if t == EVENT_AGENT:
             return AgentNotify(kind=a, message=b, timestamp=ts)
         return L7Notify(verdict=a, detail=b, timestamp=ts)
+    if t == EVENT_CAPTURE:
+        hdr = struct.calcsize("<BIIHd")
+        _, ep, orig, dlen, ts = struct.unpack("<BIIHd", buf[:hdr])
+        return DebugCapture(
+            endpoint=ep, data=buf[hdr:hdr + dlen], orig_len=orig,
+            timestamp=ts,
+        )
     raise ValueError(f"unknown event type {t}")

@@ -226,3 +226,106 @@ class TestDaemonIntegration:
         l7 = [e for e in sub.drain() if isinstance(e, L7Notify)]
         assert len(l7) == 1 and l7[0].verdict == VERDICT_DENIED
         d.shutdown()
+
+
+class TestDissect:
+    """Packet dissection (pkg/monitor/dissect.go role): raw frames →
+    per-layer summary lines, resilient to truncation."""
+
+    @staticmethod
+    def _eth(payload, etype, vlan=None):
+        hdr = bytes(range(6)) + bytes(range(6, 12))
+        if vlan is not None:
+            import struct
+            return hdr + struct.pack(">HHH", 0x8100, vlan, etype) + payload
+        import struct
+        return hdr + struct.pack(">H", etype) + payload
+
+    @staticmethod
+    def _ipv4(proto, payload, src="10.1.0.5", dst="10.1.0.7"):
+        import ipaddress
+        import struct
+        return (
+            struct.pack(
+                ">BBHHHBBH", 0x45, 0, 20 + len(payload), 1, 0, 64, proto, 0
+            )
+            + ipaddress.IPv4Address(src).packed
+            + ipaddress.IPv4Address(dst).packed
+            + payload
+        )
+
+    def test_tcp_syn(self):
+        import struct
+
+        from cilium_tpu.monitor import dissect
+
+        tcp = struct.pack(">HHIIBBHHH", 3380, 80, 1, 0, 5 << 4, 0x02, 512, 0, 0)
+        d = dissect(self._eth(self._ipv4(6, tcp), 0x0800))
+        assert d.summary() == "IP 10.1.0.5:3380 -> 10.1.0.7:80 tcp SYN"
+        assert d.ttl == 64
+
+    def test_udp_with_vlan(self):
+        import struct
+
+        from cilium_tpu.monitor import dissect
+
+        udp = struct.pack(">HHHH", 53530, 53, 8, 0)
+        d = dissect(self._eth(self._ipv4(17, udp), 0x0800, vlan=7))
+        assert d.vlan == 7
+        assert "udp" in d.summary() and ":53 " in d.summary() + " "
+
+    def test_icmp_and_arp(self):
+        import ipaddress
+        import struct
+
+        from cilium_tpu.monitor import dissect
+
+        icmp = bytes([8, 0, 0, 0])
+        d = dissect(self._eth(self._ipv4(1, icmp), 0x0800))
+        assert "icmp EchoRequest" in d.summary()
+        arp = (
+            struct.pack(">HHBBH", 1, 0x0800, 6, 4, 1)
+            + bytes(6) + ipaddress.IPv4Address("10.0.0.2").packed
+            + bytes(6) + ipaddress.IPv4Address("10.0.0.1").packed
+        )
+        d = dissect(self._eth(arp, 0x0806))
+        assert d.summary() == "ARP request 10.0.0.1 tell 10.0.0.2"
+
+    def test_ipv6_tcp_with_ext_header(self):
+        import ipaddress
+        import struct
+
+        from cilium_tpu.monitor import dissect
+
+        tcp = struct.pack(">HHIIBBHHH", 1000, 443, 0, 0, 5 << 4, 0x12, 512, 0, 0)
+        # hop-by-hop ext header (next=6, len=0 → 8 bytes)
+        ext = bytes([6, 0, 0, 0, 0, 0, 0, 0])
+        ip6 = (
+            struct.pack(">IHBB", 6 << 28, len(ext) + len(tcp), 0, 64)
+            + ipaddress.IPv6Address("fd00::1").packed
+            + ipaddress.IPv6Address("fd00::2").packed
+            + ext + tcp
+        )
+        d = dissect(self._eth(ip6, 0x86DD))
+        assert d.summary() == "IPv6 fd00::1:1000 -> fd00::2:443 tcp SYN, ACK"
+
+    def test_truncation_never_raises(self):
+        from cilium_tpu.monitor import dissect
+
+        frame = self._eth(self._ipv4(6, b"\x00\x01"), 0x0800)
+        for cut in range(len(frame)):
+            d = dissect(frame[:cut])  # every prefix must decode safely
+            assert isinstance(d.summary(), str)
+
+    def test_capture_event_roundtrip(self):
+        import struct
+
+        from cilium_tpu.monitor import DebugCapture, decode, encode
+
+        tcp = struct.pack(">HHIIBBHHH", 1, 2, 0, 0, 5 << 4, 0x10, 0, 0, 0)
+        frame = self._eth(self._ipv4(6, tcp), 0x0800)
+        ev = DebugCapture(endpoint=7, data=frame, orig_len=1500)
+        back = decode(encode(ev))
+        assert back.endpoint == 7 and back.data == frame
+        assert back.orig_len == 1500
+        assert "** capture ep 7 (1500 bytes): IP" in back.summary()
