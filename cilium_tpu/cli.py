@@ -247,7 +247,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "ServiceAccount pattern)")
 
     # status / metrics
-    sub.add_parser("status", help="agent status")
+    st = sub.add_parser("status", help="agent status")
+    st.add_argument("--all-controllers", action="store_true",
+                    help="show only the background controller table")
     sub.add_parser("metrics", help="Prometheus metrics dump")
 
     # policy
@@ -435,7 +437,6 @@ def main(argv: Optional[List[str]] = None) -> int:
             from .cluster import ClusterNode
             from .kvstore.netstore import backend_from_target
             from .nodes.registry import Node as _Node
-            from .utils.controller import Controller
 
             name = args.node_name or _socket.gethostname()
             cluster_node = ClusterNode(
@@ -461,7 +462,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 cluster_node.pump()
                 cluster_node.export_services()
 
-            cluster_pump = Controller(
+            # registered with the daemon's manager so it shows in
+            # `cilium status --all-controllers`
+            cluster_pump = daemon.controllers.update_controller(
                 "cluster-sync", _cluster_sync,
                 run_interval=args.sync_interval,
             )
@@ -550,8 +553,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             for ev in monitor_stream(path, timeout=args.timeout):
                 if args.json:
                     d = dataclasses.asdict(ev)
-                    if isinstance(d.get("peer_addr"), bytes):
-                        d["peer_addr"] = d["peer_addr"].hex()
+                    # bytes fields (peer_addr, capture payloads) ride
+                    # as hex — json has no bytes type
+                    for k, v in d.items():
+                        if isinstance(v, bytes):
+                            d[k] = v.hex()
                     print(json.dumps(d))
                 else:
                     print(ev.summary())
@@ -659,7 +665,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     s = _Surface(args.socket, args.state)
 
     if args.cmd == "status":
-        _print(s.status())
+        status = s.status()
+        if getattr(args, "all_controllers", False):
+            _print(status.get("controllers", []))
+        else:
+            _print(status)
     elif args.cmd == "metrics":
         _print(s.metrics())
     elif args.cmd == "policy":

@@ -84,6 +84,7 @@ class Daemon:
         health_probe=None,
         pod_cidr: str = "10.200.0.0/16",
         regen_debounce: float = 0.0,
+        ct_gc_interval: float = 60.0,
     ) -> None:
         self.state_dir = state_dir
         self.repo = Repository()
@@ -101,6 +102,17 @@ class Daemon:
         )
         self.endpoint_manager = EndpointManager()
         self.proxy = Proxy()
+        # named background loops w/ retry + status surfacing
+        # (pkg/controller; `cilium status --all-controllers`). CT GC
+        # reaps expired flows on an interval — the
+        # endpointmanager.EnableConntrackGC role (ctmap.go GC:345).
+        from .utils.controller import ControllerManager
+
+        self.controllers = ControllerManager()
+        if self.conntrack is not None and ct_gc_interval > 0:
+            self.controllers.update_controller(
+                "ct-gc", self.conntrack.gc, run_interval=ct_gc_interval
+            )
         # boot-time capability probes on a daemon thread (the
         # run_probes.sh-at-boot analog; status() peeks, never blocks)
         from . import probes as _probes
@@ -899,6 +911,9 @@ class Daemon:
                 if (peeked := self._peek_features()) is not None
                 else ["probing"]
             ),
+            # controller.go:282 status surfacing (`cilium status
+            # --all-controllers`)
+            "controllers": self.controllers.statuses(),
         }
 
     def _peek_features(self):
@@ -998,6 +1013,7 @@ class Daemon:
         return n
 
     def shutdown(self) -> None:
+        self.controllers.remove_all()
         self.health.stop()
         self.fqdn.stop()
         self.endpoint_manager.shutdown()

@@ -104,7 +104,12 @@ def dissect(data: bytes) -> Dissection:
     d.src_mac = _mac(data[6:12])
     (etype,) = struct.unpack(">H", data[12:14])
     off = 14
-    if etype == ETH_P_8021Q and len(data) >= 18:
+    if etype == ETH_P_8021Q:
+        if len(data) < 18:
+            # cut inside the VLAN tag: the payload ethertype is gone
+            d.ethertype = etype
+            d.truncated = True
+            return d
         (tci, etype) = struct.unpack(">HH", data[14:18])
         d.vlan = tci & 0x0FFF
         off = 18
