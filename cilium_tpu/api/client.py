@@ -157,6 +157,9 @@ class APIClient:
                 else "/endpoint/regenerate")
         return self._request("POST", path)
 
+    def endpoint_log(self, ep_id: int):
+        return self._request("GET", f"/endpoint/{ep_id}/log")
+
     def endpoint_labels(self, ep_id: int, add=(), delete=()):
         return self._request("PATCH", f"/endpoint/{ep_id}/labels",
                              {"add": list(add), "delete": list(delete)})

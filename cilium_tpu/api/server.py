@@ -137,6 +137,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, d.endpoint_regenerate(int(m.group(1))))
         elif path == "/endpoint/regenerate" and method == "POST":
             self._json(200, d.endpoint_regenerate())
+        elif (m := re.fullmatch(r"/endpoint/(\d+)/log", path)) and method == "GET":
+            self._json(200, d.endpoint_log(int(m.group(1))))
         elif (m := re.fullmatch(r"/endpoint/(\d+)/labels", path)) and method == "PATCH":
             body = self._body()
             self._json(200, d.endpoint_labels(

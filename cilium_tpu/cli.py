@@ -154,6 +154,12 @@ class _Surface:
         except ValueError as e:
             raise SystemExit(str(e)) from None
 
+    def _d_endpoint_log(self, ep_id):
+        try:
+            return self._daemon.endpoint_log(ep_id)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+
     def _d_endpoint_labels(self, ep_id, add=(), delete=()):
         try:
             return self._daemon.endpoint_labels(ep_id, add=add, delete=delete)
@@ -302,6 +308,8 @@ def build_parser() -> argparse.ArgumentParser:
     epg.add_argument("id", type=int)
     epr = ep.add_parser("regenerate", help="force policy regeneration")
     epr.add_argument("id", type=int, nargs="?", default=None)
+    eplog = ep.add_parser("log", help="per-endpoint status log")
+    eplog.add_argument("id", type=int)
     epl = ep.add_parser("labels", help="modify labels (new identity)")
     epl.add_argument("id", type=int)
     epl.add_argument("-a", "--add", action="append", default=[])
@@ -775,6 +783,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             _print(s.endpoint_get(args.id))
         elif args.sub == "regenerate":
             _print(s.endpoint_regenerate(args.id))
+        elif args.sub == "log":
+            import datetime as _dt
+
+            for rec in s.endpoint_log(args.id):
+                ts = _dt.datetime.fromtimestamp(rec["timestamp"])
+                print(f"{ts:%H:%M:%S} [{rec['code']}] {rec['message']}")
         elif args.sub == "labels":
             _print(s.endpoint_labels(args.id, add=args.add,
                                      delete=args.delete))

@@ -100,18 +100,20 @@ class Daemon:
             conntrack=self.conntrack, lb=self.services,
             monitor=self.monitor,
         )
-        self.endpoint_manager = EndpointManager()
-        self.proxy = Proxy()
-        # named background loops w/ retry + status surfacing
-        # (pkg/controller; `cilium status --all-controllers`). CT GC
-        # reaps expired flows on an interval — the
-        # endpointmanager.EnableConntrackGC role (ctmap.go GC:345).
+        # ONE controller registry for the whole daemon (pkg/controller;
+        # `cilium status --all-controllers` reads it) — the endpoint
+        # manager registers its loops here too, so nothing hides in a
+        # second manager
         from .utils.controller import ControllerManager
 
         self.controllers = ControllerManager()
+        self.endpoint_manager = EndpointManager(controllers=self.controllers)
+        self.proxy = Proxy()
         if self.conntrack is not None and ct_gc_interval > 0:
-            self.controllers.update_controller(
-                "ct-gc", self.conntrack.gc, run_interval=ct_gc_interval
+            # periodic CT reaping (endpointmanager.EnableConntrackGC,
+            # ctmap.go GC:345)
+            self.endpoint_manager.enable_conntrack_gc(
+                self.conntrack, interval=ct_gc_interval
             )
         # boot-time capability probes on a daemon thread (the
         # run_probes.sh-at-boot analog; status() peeks, never blocks)
@@ -509,6 +511,17 @@ class Daemon:
             f"endpoint {endpoint_id} identity {ep.identity.id}",
         )
         return self._endpoint_model(ep)
+
+    def endpoint_log(self, endpoint_id: int) -> List[Dict]:
+        """Per-endpoint status log (cilium endpoint log): state moves
+        + regeneration outcomes, newest last."""
+        ep = self.endpoint_manager.lookup(endpoint_id)
+        if ep is None:
+            raise ValueError(f"endpoint {endpoint_id} not found")
+        return [
+            {"timestamp": ts, "code": code, "message": msg}
+            for ts, code, msg in ep.status_log_snapshot()
+        ]
 
     def ct_flush(self) -> Dict:
         """Flush the connection-tracking table (cilium bpf ct flush)."""

@@ -178,11 +178,15 @@ class FlowConntrack:
 
     # ------------------------------------------------------------------
     def lookup_batch(
-        self, ka, kb, kc, *, refresh: bool = True
-    ) -> Tuple[np.ndarray, np.ndarray]:
-        """→ (state [B] uint8 CT_*, slot [B] int64). Established hits
-        optionally refresh lifetimes (the kernel updates ct lifetime on
-        every packet)."""
+        self, ka, kb, kc, *, refresh: bool = True, want_revnat: bool = False
+    ):
+        """→ (state [B] uint8 CT_*, slot [B] int64)[, revnat [B] u16].
+        Established hits optionally refresh lifetimes (the kernel
+        updates ct lifetime on every packet). ``want_revnat`` reads
+        each hit's revNAT id UNDER THE SAME LOCK HOLD as the find — a
+        slot index used after the lock drops can be tombstoned, reused,
+        or moved by a concurrent gc()/compact, so post-hoc revnat reads
+        would return another flow's id."""
         now = time.monotonic()
         with self._lock:
             slot = self._find(ka, kb, kc, now)
@@ -203,6 +207,10 @@ class FlowConntrack:
                 )
                 self.expires[s] = now + life
                 np.add.at(self.packets, s, 1)
+            if want_revnat:
+                rev = np.zeros(slot.shape, np.uint16)
+                rev[live] = self.revnat[slot[live]]
+                return state, slot, rev
             return state, slot
 
     def dump(self, limit: int = 4096) -> list:
@@ -235,11 +243,15 @@ class FlowConntrack:
         return out
 
     def revnat_of(self, slots: np.ndarray) -> np.ndarray:
-        """[B] uint16 revNAT id per CT slot (0 for misses / no NAT)."""
+        """[B] uint16 revNAT id per CT slot (0 for misses / no NAT).
+        Prefer lookup_batch(want_revnat=True): slots can be reused or
+        moved by gc()/compact between the find and this read — this
+        accessor only locks against torn reads, not staleness."""
         slots = np.asarray(slots)
         out = np.zeros(slots.shape, np.uint16)
         live = slots >= 0
-        out[live] = self.revnat[slots[live]]
+        with self._lock:
+            out[live] = self.revnat[slots[live]]
         return out
 
     def create_batch(self, ka, kb, kc, revnat: Optional[np.ndarray] = None) -> int:

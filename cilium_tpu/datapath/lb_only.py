@@ -131,8 +131,9 @@ class LBOnlyDatapath:
             dports.astype(np.uint64), protos.astype(np.uint64),
             np.zeros(b, np.uint64),
         )
-        state, slot = self.conntrack.lookup_batch(ka, kb, kc, refresh=False)
-        rev = self.conntrack.revnat_of(slot)
+        state, _slot, rev = self.conntrack.lookup_batch(
+            ka, kb, kc, refresh=False, want_revnat=True
+        )
         rev[state != CT_REPLY] = 0
         for i in np.nonzero(rev)[0]:
             fe = self.lb.rev_nat(int(rev[i]))

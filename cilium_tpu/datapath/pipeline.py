@@ -913,7 +913,16 @@ class DatapathPipeline:
             peer_hi, peer_lo, ep_idx.astype(np.uint64), sports,
             dports.astype(np.uint64), protos.astype(np.uint64), direction,
         )
-        state, slot = ct.lookup_batch(ka, kb, kc)
+        if want_rev_nat:
+            from .conntrack import CT_REPLY
+
+            # revNAT ids read under the SAME lock hold as the find: a
+            # timer gc()/compact between the lookup and a post-hoc
+            # revnat read could hand back another flow's id
+            state, slot, ct_rev = ct.lookup_batch(ka, kb, kc, want_revnat=True)
+            ct_rev[state != CT_REPLY] = 0
+        else:
+            state, slot = ct.lookup_batch(ka, kb, kc)
         miss = state == CT_NEW
 
         verdict = np.full(b, FORWARD, np.int8)
@@ -983,11 +992,7 @@ class DatapathPipeline:
             # REPLY direction carry the id of the service that
             # translated the original request — the caller rewrites
             # the reply source back to that VIP (rev_nat_frontend()).
-            from .conntrack import CT_REPLY
-
-            rev = ct.revnat_of(slot)
-            rev[state != CT_REPLY] = 0
-            return verdict, redirect, rev
+            return verdict, redirect, ct_rev
         return verdict, redirect
 
     def _process_device_ct(

@@ -14,6 +14,7 @@ RegenerationStats (spanstat, pkg/endpoint/metrics.go).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import enum
 import json
@@ -95,6 +96,21 @@ class Endpoint:
         # via the build queue, pkg/endpoint/policy.go:812).
         self._build_lock = threading.Lock()
         self._state_log: List[Tuple[float, EndpointState]] = [(time.time(), self.state)]
+        # bounded status log: state moves + regeneration outcomes (the
+        # per-endpoint status log `cilium endpoint log` prints;
+        # pkg/endpoint StatusLog / endpoint_log.go)
+        self.status_log: collections.deque = collections.deque(maxlen=64)
+        self._log_status("state", self.state.value)
+
+    def _log_status(self, code: str, message: str) -> None:
+        with self._lock:
+            self.status_log.append((time.time(), code, message))
+
+    def status_log_snapshot(self):
+        """Copy under the lock: builder threads append concurrently and
+        a bare iteration would raise 'deque mutated during iteration'."""
+        with self._lock:
+            return list(self.status_log)
 
     # -- state machine --------------------------------------------------
     def set_state(self, new: EndpointState) -> bool:
@@ -105,6 +121,7 @@ class Endpoint:
                 return False
             self.state = new
             self._state_log.append((time.time(), new))
+            self._log_status("state", new.value)
             return True
 
     def set_identity(self, identity: Identity) -> None:
@@ -166,6 +183,12 @@ class Endpoint:
                 ok = True
             finally:
                 stats.success = ok
+                self._log_status(
+                    "regen-ok" if ok else "regen-fail",
+                    (reason or "regeneration")
+                    + f" ({stats.total.total() * 1000:.1f}ms, "
+                      f"rev {self.policy_revision})",
+                )
                 self.set_state(EndpointState.READY)
                 metrics.endpoint_regeneration_count.inc(
                     labels={"outcome": "success" if ok else "failure"}

@@ -20,7 +20,8 @@ from .endpoint import Endpoint
 
 
 class EndpointManager:
-    def __init__(self, workers: Optional[int] = None) -> None:
+    def __init__(self, workers: Optional[int] = None,
+                 controllers: Optional[ControllerManager] = None) -> None:
         self._lock = threading.RLock()
         self._by_id: Dict[int, Endpoint] = {}
         self._by_container: Dict[str, Endpoint] = {}
@@ -30,7 +31,9 @@ class EndpointManager:
             max_workers=workers or os.cpu_count() or 4,
             thread_name_prefix="ep-builder",
         )
-        self._controllers = ControllerManager()
+        # shared with the daemon when embedded (one status registry);
+        # standalone managers own their own
+        self._controllers = controllers or ControllerManager()
 
     # -- registry -------------------------------------------------------
     def insert(self, ep: Endpoint) -> None:

@@ -262,6 +262,21 @@ class TestParityCommands:
         out = server.endpoint_labels(7, add=["app=db"])
         assert out["identity"] == before
 
+    def test_endpoint_log(self, server):
+        """State transitions and regeneration outcomes appear in the
+        per-endpoint status log (cilium endpoint log)."""
+        server.policy_put(RULES)
+        server.endpoint_put(7, ["k8s:app=web"], ipv4="10.1.0.7")
+        server.endpoint_regenerate(7)
+        recs = server.endpoint_log(7)
+        codes = [r["code"] for r in recs]
+        assert "state" in codes
+        assert any(c == "regen-ok" for c in codes), codes
+        msgs = [r["message"] for r in recs if r["code"] == "state"]
+        assert "ready" in msgs
+        with pytest.raises(APIError):
+            server.endpoint_log(404)
+
     def test_map_list_ct_flush_node_list(self, server):
         maps = {m["name"] for m in server.map_list()}
         assert {"ct", "ipcache", "tunnel", "proxy", "metrics",
