@@ -372,7 +372,9 @@ def _bench_native(snaps, idents, nrng: np.random.Generator):
     return single, mt
 
 
-def _bench_pipeline_e2e(repo, reg, idents, nrng: np.random.Generator) -> float:
+def _bench_pipeline_e2e(
+    repo, reg, idents, nrng: np.random.Generator
+) -> Tuple[float, float]:
     """Device-resident FULL datapath chain (deny-LPM skip on empty
     prefilter → identity LPM → policymap lookup → counters) on one
     pre-staged batch — the cold-flow batch path a host front-end feeds.
@@ -425,7 +427,46 @@ def _bench_pipeline_e2e(repo, reg, idents, nrng: np.random.Generator) -> float:
     for _ in range(iters):
         v = run()
     jax.block_until_ready(v)
-    return iters * b / (time.time() - t0)
+    v4_rate = iters * b / (time.time() - t0)
+
+    # IPv6: same chain over the elided stride-8 tries (shared-prefix
+    # bytes compared, not walked)
+    from cilium_tpu.datapath.pipeline import process_flows
+
+    cache6 = IPCache()
+    for i, ident in enumerate(idents):
+        cache6.upsert(
+            f"fd00::{(i >> 8) & 255:x}:{i & 255:x}/128", ident.id,
+            source="k8s",
+        )
+    pipe6 = DatapathPipeline(eng, cache6, PreFilter(), conntrack=None)
+    pipe6.set_endpoints([idents[j].id for j in range(N_ENDPOINTS)])
+    b6 = 1 << 18
+    i6 = nrng.integers(0, len(idents), b6)
+    addrs = np.zeros((b6, 16), np.int32)
+    addrs[:, 0] = 0xFD
+    addrs[:, 13] = (i6 >> 8) & 255
+    addrs[:, 15] = i6 & 255
+    eps6 = nrng.integers(0, N_ENDPOINTS, b6).astype(np.int32)
+    dp6 = nrng.choice(np.array([80, 443, 8080, 53, 22], np.int32), b6)
+    pr6 = np.where(dp6 == 53, 17, 6).astype(np.int32)
+    pipe6.process_v6(addrs[:1024], eps6[:1024], dp6[:1024], pr6[:1024])
+    t6 = pipe6._tables[(TRAFFIC_INGRESS, 6)]
+    d6 = [jnp.asarray(a) for a in (addrs, eps6, dp6, pr6)]
+
+    def run6():
+        v, _red, _c = process_flows(
+            t6, *d6, ep_count=N_ENDPOINTS, levels=16,
+            prefilter=False, row_override=None,
+        )
+        return v
+
+    jax.block_until_ready(run6())
+    t0 = time.time()
+    for _ in range(iters):
+        v = run6()
+    jax.block_until_ready(v)
+    return v4_rate, iters * b6 / (time.time() - t0)
 
 
 def _bench_native_e2e(snaps, idents, nrng: np.random.Generator):
@@ -747,9 +788,9 @@ def main() -> None:
         _bench_native_e2e(_snaps, idents, np.random.default_rng(9))
         if extra else (0.0, 0.0)
     )
-    pipeline_e2e_vps = (
+    pipeline_e2e_vps, pipeline_e2e_v6_vps = (
         _bench_pipeline_e2e(repo, reg, idents, np.random.default_rng(13))
-        if extra else 0.0
+        if extra else (0.0, 0.0)
     )
     t0 = time.time()
     tables2, _ = materialize_endpoints(
@@ -792,6 +833,7 @@ def main() -> None:
         "native_e2e_vps": round(native_e2e_vps),
         "native_e2e_est_vps": round(native_e2e_est_vps),
         "pipeline_e2e_vps": round(pipeline_e2e_vps),
+        "pipeline_e2e_v6_vps": round(pipeline_e2e_v6_vps),
         "rebuild_warm_s": round(rebuild_warm_s, 2),
         "stretch_100k": stretch,
     }

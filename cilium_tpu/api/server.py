@@ -138,7 +138,11 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/endpoint/regenerate" and method == "POST":
             self._json(200, d.endpoint_regenerate())
         elif (m := re.fullmatch(r"/endpoint/(\d+)/log", path)) and method == "GET":
-            self._json(200, d.endpoint_log(int(m.group(1))))
+            ep_id = int(m.group(1))
+            if d.endpoint_manager.lookup(ep_id) is None:
+                self._json(404, {"error": f"endpoint {ep_id} not found"})
+            else:
+                self._json(200, d.endpoint_log(ep_id))
         elif (m := re.fullmatch(r"/endpoint/(\d+)/labels", path)) and method == "PATCH":
             body = self._body()
             self._json(200, d.endpoint_labels(

@@ -242,18 +242,6 @@ class FlowConntrack:
                 })
         return out
 
-    def revnat_of(self, slots: np.ndarray) -> np.ndarray:
-        """[B] uint16 revNAT id per CT slot (0 for misses / no NAT).
-        Prefer lookup_batch(want_revnat=True): slots can be reused or
-        moved by gc()/compact between the find and this read — this
-        accessor only locks against torn reads, not staleness."""
-        slots = np.asarray(slots)
-        out = np.zeros(slots.shape, np.uint16)
-        live = slots >= 0
-        with self._lock:
-            out[live] = self.revnat[slots[live]]
-        return out
-
     def create_batch(self, ka, kb, kc, revnat: Optional[np.ndarray] = None) -> int:
         """Insert forward-tuple entries (vectorized claim, P rounds of
         first-writer-wins per slot). Duplicate keys in the batch are

@@ -37,6 +37,7 @@ from ..ipcache.ipcache import IPCache
 from ..ipcache.prefilter import PreFilter
 from ..ops.lookup import PolicymapTables, lookup_batch
 from ..ops.lpm import (
+    build_trie_elided,
     build_wide_trie,
     ipv4_to_bytes,
     lpm_lookup,
@@ -62,12 +63,16 @@ DROP_NO_SERVICE = 4  # frontend matched but zero backends (lb4_local)
 @chex.dataclass(frozen=True)
 class DatapathTables:
     """Device state for one address family + one traffic direction.
-    Trie arrays are shared between the two directions' instances."""
+    Trie arrays are shared between the two directions' instances.
+    ``*_common`` carry each trie's elided shared prefix bytes ([K]
+    int32, [0] = no elision) — compared vectorized, not walked."""
 
     pf_child: jnp.ndarray
     pf_info: jnp.ndarray
+    pf_common: jnp.ndarray
     ip_child: jnp.ndarray
     ip_info: jnp.ndarray
+    ip_common: jnp.ndarray
     world_row: jnp.ndarray  # [] int32
     policymap: PolicymapTables
 
@@ -88,6 +93,24 @@ class WideDatapathTables:
     ip_sub_info: jnp.ndarray
     world_row: jnp.ndarray  # [] int32
     policymap: PolicymapTables
+
+
+def _elided_lpm(
+    child: jnp.ndarray,
+    info: jnp.ndarray,
+    common: jnp.ndarray,
+    addr_bytes: jnp.ndarray,
+    levels: int,
+) -> jnp.ndarray:
+    """LPM walk with the trie's shared prefix compared (one vectorized
+    equality, zero gathers) instead of walked — K is static from the
+    common array's shape, so each table set compiles its own depth."""
+    k = common.shape[0]
+    hit = lpm_lookup(child, info, addr_bytes[:, k:], levels=levels - k)
+    if k:
+        ok = jnp.all(addr_bytes[:, :k] == common[None, :], axis=1)
+        hit = jnp.where(ok, hit, 0)
+    return hit
 
 
 def _verdict_tail(
@@ -152,10 +175,12 @@ def process_flows(
     scatter stays on the MXU.
     """
     if prefilter:
-        denied_pf = lpm_lookup(t.pf_child, t.pf_info, peer_bytes, levels=levels) > 0
+        denied_pf = _elided_lpm(
+            t.pf_child, t.pf_info, t.pf_common, peer_bytes, levels
+        ) > 0
     else:
         denied_pf = jnp.zeros(peer_bytes.shape[0], jnp.bool_)
-    hit = lpm_lookup(t.ip_child, t.ip_info, peer_bytes, levels=levels)
+    hit = _elided_lpm(t.ip_child, t.ip_info, t.ip_common, peer_bytes, levels)
     peer_row = jnp.where(hit > 0, hit - 1, t.world_row)
     if row_override is not None:
         trusted = row_override >= 0
@@ -258,11 +283,11 @@ def process_flows_ct(
         ka_w, kb_w = (z, z), (z, peer)
     else:
         denied_pf = (
-            lpm_lookup(t.pf_child, t.pf_info, peer, levels=levels) > 0
+            _elided_lpm(t.pf_child, t.pf_info, t.pf_common, peer, levels) > 0
             if prefilter
             else jnp.zeros(peer.shape[0], jnp.bool_)
         )
-        hit = lpm_lookup(t.ip_child, t.ip_info, peer, levels=levels)
+        hit = _elided_lpm(t.ip_child, t.ip_info, t.ip_common, peer, levels)
         b32 = peer.astype(jnp.uint32)
 
         def word(i):
@@ -503,13 +528,24 @@ class DatapathPipeline:
                 or saw_row_event  # any row move can re-point trie targets
                 or not self._tables
             ):
-                (_pf4, pf6) = self.prefilter.build_device(build_v4=False)
-                _ip4, ip6 = self.ipcache.build_device(
-                    lambda ident: compiled.id_to_row.get(ident),
-                    build_v4=False,
+                _, pf_cidrs = self.prefilter.dump()
+                # IPv6: stride-8 tries with the shared prefix elided
+                # (pod allocations live under one /48-/64 — compare
+                # those bytes once instead of walking them)
+                pf6 = build_trie_elided(
+                    ((c, 0) for c in pf_cidrs if ":" in c), ipv6=True
+                )
+                ip6 = build_trie_elided(
+                    (
+                        (cidr, row)
+                        for cidr, e in self.ipcache.items()
+                        if ":" in cidr
+                        and (row := compiled.id_to_row.get(e.identity))
+                        is not None
+                    ),
+                    ipv6=True,
                 )
                 # IPv4 rides the wide (dense-16-bit-first) tries
-                _, pf_cidrs = self.prefilter.dump()
                 self._pf_empty = (
                     not any(":" not in c for c in pf_cidrs),
                     not any(":" in c for c in pf_cidrs),
@@ -585,8 +621,10 @@ class DatapathPipeline:
                 tables[(direction, 6)] = DatapathTables(
                     pf_child=v6[0],
                     pf_info=v6[1],
-                    ip_child=v6[2],
-                    ip_info=v6[3],
+                    pf_common=v6[2],
+                    ip_child=v6[3],
+                    ip_info=v6[4],
+                    ip_common=v6[5],
                     world_row=world,
                     policymap=mat.tables,
                 )

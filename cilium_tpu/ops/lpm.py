@@ -99,6 +99,47 @@ def build_trie(
     return t.arrays()
 
 
+def build_trie_elided(
+    prefixes: Iterable[Tuple[str, int]], *, ipv6: bool = True
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """[(cidr_string, value)] → (child, info, common_bytes) with the
+    longest shared whole-byte prefix ELIDED from the trie.
+
+    IPv6 pod allocations share a long prefix (everything under one
+    /48-/64), so a full 16-level byte walk wastes most of its chained
+    gathers traversing single-child nodes. The shared K bytes come
+    back as ``common_bytes`` ([K] int32): the lookup compares them
+    against the batch in one vectorized equality (no gathers) and
+    walks only the remaining 16-K levels. Elision applies only while
+    EVERY prefix is at least K whole bytes long (a shorter deny CIDR
+    disables it), and K is capped one byte short so at least one walk
+    level remains."""
+    size = 16 if ipv6 else 4
+    entries = []
+    for cidr, value in prefixes:
+        net = ipaddress.ip_network(cidr, strict=False)
+        if (net.version == 6) != ipv6:
+            continue
+        entries.append((net.network_address.packed, net.prefixlen, value))
+    k = 0
+    if entries:
+        first = entries[0][0]
+        k = min(min(p for _, p, _ in entries) // 8, size - 1)
+        for packed, _p, _v in entries:
+            while k and packed[:k] != first[:k]:
+                k -= 1
+    t = TrieBuilder(size - k)
+    for packed, plen, value in entries:
+        t.insert(packed[k:], plen - 8 * k, value)
+    child, info = t.arrays()
+    common = (
+        np.frombuffer(entries[0][0][:k], np.uint8).astype(np.int32)
+        if k
+        else np.zeros(0, np.int32)
+    )
+    return child, info, common
+
+
 @functools.partial(jax.jit, static_argnames=("levels",))
 def lpm_lookup(
     child: jnp.ndarray,  # [M, 256] int32

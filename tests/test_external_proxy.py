@@ -45,7 +45,8 @@ def _http_get(port: int, path: str, source: str = "127.0.0.1") -> int:
     selects the loopback alias to bind (the NPHDS identity input)."""
     c = socket.socket()
     c.bind((source, 0))
-    c.settimeout(15.0)  # generous: first request may race module import
+    c.settimeout(60.0)  # generous: on a loaded single-CPU host the
+    # child's first request can wait on interpreter start + imports
     c.connect(("127.0.0.1", port))
     c.sendall(
         f"GET {path} HTTP/1.1\r\nHost: svc.local\r\n\r\n".encode()

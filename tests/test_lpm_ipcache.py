@@ -259,3 +259,63 @@ class TestFlatTrieParity:
         assert flat.arrays()[3].shape[-1] == 65536  # flat layout actually built
         assert wide.arrays()[3].shape[-1] == 256
         np.testing.assert_array_equal(rf, rw)
+
+
+class TestElidedV6Trie:
+    def test_elided_matches_full_walk(self):
+        """build_trie_elided must agree with the full 16-level walk on
+        in-prefix, out-of-prefix, and miss addresses — and a shorter
+        prefix in the set must disable (shrink) the elision rather
+        than break matching."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from cilium_tpu.ops.lpm import (
+            build_trie,
+            build_trie_elided,
+            ipv6_to_bytes,
+            lpm_lookup,
+        )
+
+        prefixes = [
+            ("fd00:aa::1/128", 5),
+            ("fd00:aa::2/128", 6),
+            ("fd00:aa::/64", 7),
+            ("fd00:aa:0:1::/64", 8),
+        ]
+        queries = ipv6_to_bytes([
+            "fd00:aa::1", "fd00:aa::2", "fd00:aa::9",  # under /64
+            "fd00:aa:0:1::42",                          # second /64
+            "fd00:bb::1", "2001:db8::1",                # outside common
+        ])
+        full = np.asarray(lpm_lookup(
+            *[jnp.asarray(a) for a in build_trie(prefixes, ipv6=True)],
+            jnp.asarray(queries), levels=16,
+        ))
+        child, info, common = build_trie_elided(prefixes, ipv6=True)
+        k = common.shape[0]
+        assert k > 0  # elision actually engaged
+        sub = np.asarray(lpm_lookup(
+            jnp.asarray(child), jnp.asarray(info),
+            jnp.asarray(queries[:, k:]), levels=16 - k,
+        ))
+        ok = (queries[:, :k] == common[None, :]).all(axis=1)
+        elided = np.where(ok, sub, 0)
+        np.testing.assert_array_equal(elided, full)
+        assert full[0] == 6 and full[1] == 7  # value+1 of the /128s
+        assert full[4] == 0 and full[5] == 0
+
+        # a wide deny (fd00::/16-ish) must shrink the elision
+        child2, info2, common2 = build_trie_elided(
+            prefixes + [("fd00::/16", 9)], ipv6=True
+        )
+        assert common2.shape[0] <= 2
+        q2 = ipv6_to_bytes(["fd00:bb::1"])
+        k2 = common2.shape[0]
+        hit = np.asarray(lpm_lookup(
+            jnp.asarray(child2), jnp.asarray(info2),
+            jnp.asarray(q2[:, k2:]), levels=16 - k2,
+        ))
+        ok2 = (q2[:, :k2] == common2[None, :]).all(axis=1)
+        assert np.where(ok2, hit, 0)[0] == 10  # the /16 catches it
