@@ -6,9 +6,10 @@ identity (:438-493), and listener fan-out (`IPIdentityMappingListener`,
 listener.go) that keeps derived state (the datapath LPM tensors here;
 the BPF ipcache map + Envoy NPHDS in the reference) in sync.
 
-The device view is a pair of stride-8 tries (ops/lpm.py) mapping
-prefixes to identity *rows*; the datapath pipeline rebuilds them via
-``build_device`` when ``version`` moves.
+The device view: the datapath pipeline rebuilds its LPM tries
+(ops/lpm.py — wide 16-bit-stride for IPv4, shared-prefix-elided
+stride-8 for IPv6) from ``items()`` whenever ``version`` moves,
+mapping prefixes to identity *rows*.
 """
 
 from __future__ import annotations
@@ -18,9 +19,6 @@ import ipaddress
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
-import numpy as np
-
-from ..ops.lpm import build_trie
 
 # Source priorities (ipcache.go allowOverwrite: agent-local knowledge
 # beats the kvstore, which beats k8s-derived, which beats generated).
@@ -146,25 +144,3 @@ class IPCache:
         with self._lock:
             return list(self._by_prefix.items())
 
-    # -- device view ----------------------------------------------------
-    def build_device(
-        self, row_of: Callable[[int], Optional[int]], *, build_v4: bool = True
-    ) -> Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]:
-        """→ ((child4, info4), (child6, info6)) stride-8 tries holding
-        identity rows (the datapath's cilium_ipcache equivalent).
-        Entries whose identity has no row yet are skipped.
-        ``build_v4=False`` skips the v4 half (the pipeline's IPv4 path
-        uses the wide trie instead — rebuilding an unused 50k-prefix
-        stride-8 trie per ipcache move would dominate rebuild cost)."""
-        with self._lock:
-            v4, v6 = [], []
-            for cidr, e in self._by_prefix.items():
-                row = row_of(e.identity)
-                if row is None:
-                    continue
-                (v6 if ":" in cidr else v4).append((cidr, int(row)))
-        empty = build_trie([], ipv6=False)
-        return (
-            build_trie(v4, ipv6=False) if build_v4 else empty,
-            build_trie(v6, ipv6=True),
-        )

@@ -17,10 +17,6 @@ import ipaddress
 import threading
 from typing import Iterable, List, Tuple
 
-import numpy as np
-
-from ..ops.lpm import build_trie
-
 
 class PreFilter:
     def __init__(self) -> None:
@@ -70,14 +66,3 @@ class PreFilter:
         with self._lock:
             return self._revision, sorted(self._dyn | self._fix)
 
-    def build_device(self, *, build_v4: bool = True):
-        """→ ((child4, info4), (child6, info6)) deny tries (value 1).
-        ``build_v4=False`` skips the v4 half (wide-trie datapath)."""
-        with self._lock:
-            entries = [(c, 0) for c in self._dyn | self._fix]
-        v4 = [(c, v) for c, v in entries if ":" not in c]
-        v6 = [(c, v) for c, v in entries if ":" in c]
-        return (
-            build_trie(v4, ipv6=False) if build_v4 else build_trie([], ipv6=False),
-            build_trie(v6, ipv6=True),
-        )

@@ -115,7 +115,14 @@ class TestFileBackend:
             # same key ⇒ same id across processes; new key ⇒ distinct
             assert int(remote_web) == id_web and created == "0"
             assert int(remote_db) != id_web
-            a1.pump()
+            # the file watcher POLLS (poll_interval 50ms): give the
+            # other process's write time to land in b1's event queue
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                a1.pump()
+                if a1.get("k8s:app=db") == int(remote_db):
+                    break
+                time.sleep(0.05)
             assert a1.get("k8s:app=db") == int(remote_db)
         finally:
             b1.close()

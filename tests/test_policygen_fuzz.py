@@ -24,7 +24,7 @@ import random
 import numpy as np
 import pytest
 
-from cilium_tpu.datapath.pipeline import FORWARD, DatapathPipeline
+from cilium_tpu.datapath.pipeline import DROP_PREFILTER, FORWARD, DatapathPipeline
 from cilium_tpu.engine import PolicyEngine
 from cilium_tpu.identity import IdentityRegistry
 from cilium_tpu.ipcache.ipcache import IPCache
@@ -32,7 +32,7 @@ from cilium_tpu.ipcache.prefilter import PreFilter
 from cilium_tpu.labels import LabelArray, parse_label_array
 from cilium_tpu.labels.cidr import cidr_labels
 from cilium_tpu.native import NativeFastpath, native_available
-from cilium_tpu.ops.lpm import ip_strings_to_u32
+from cilium_tpu.ops.lpm import ip_strings_to_u32, ipv6_to_bytes
 from cilium_tpu.policy.api import (
     EgressRule,
     EndpointSelector,
@@ -105,8 +105,10 @@ class World:
     refcount-shared Identity (which would desync the harness's
     ip↔identity bookkeeping under del_ident churn)."""
 
-    def __init__(self, seed: int, n_rules: int = 24, n_idents: int = 24):
+    def __init__(self, seed: int, n_rules: int = 24, n_idents: int = 24,
+                 family: int = 4):
         self.rng = random.Random(seed)
+        self.family = family
         self._uid = 0
         self.repo = Repository()
         self.repo.add_list(
@@ -117,15 +119,25 @@ class World:
         # (identity | None, ip) pairs the flow generator samples —
         # None = expect world resolution
         self.peers = []
+        self.deny_cidrs = []  # live XDP prefilter entries (oracle input)
         self.ipcache = IPCache()
         idents = []
+        plen = 32 if family == 4 else 128
         for i in range(n_idents):
             ident = self._alloc_ident()
-            ip = f"172.16.{i // 250}.{(i % 250) + 1}"
-            self.ipcache.upsert(f"{ip}/32", ident.id, source="k8s")
+            ip = (
+                f"172.16.{i // 250}.{(i % 250) + 1}"
+                if family == 4
+                # v6: /128s under one shared prefix — the elided-trie
+                # shape — plus the outside-prefix churn in mutate()
+                else f"fd00:aa::{i + 1:x}"
+            )
+            self.ipcache.upsert(f"{ip}/{plen}", ident.id, source="k8s")
             idents.append(ident)
             self.peers.append((ident, ip))
-        self.peers.append((None, "8.8.8.8"))  # world
+        self.peers.append(
+            (None, "8.8.8.8" if family == 4 else "2001:db8::8")
+        )  # world
         # CIDR identities: every egress to_cidr prefix gets a local
         # identity carrying its covering labels and an ipcache entry,
         # so the CIDR allow path is actually exercised (the
@@ -133,7 +145,7 @@ class World:
         seen = set()
         with self.repo._lock:
             rules = list(self.repo.rules)
-        for r in rules:
+        for r in (rules if family == 4 else []):
             for eg in r.egress:
                 for cidr in eg.to_cidr:
                     if cidr in seen:
@@ -152,7 +164,8 @@ class World:
                     ))
                     self.peers.append((cid, inside))
         self.engine = PolicyEngine(self.repo, self.reg)
-        self.pipe = DatapathPipeline(self.engine, self.ipcache, PreFilter())
+        self.prefilter = PreFilter()
+        self.pipe = DatapathPipeline(self.engine, self.ipcache, self.prefilter)
         self.ep_idents = idents[:6]
         self.pipe.set_endpoints([i.id for i in self.ep_idents])
 
@@ -191,26 +204,49 @@ class World:
             flows.append((ep_i, peer, ip, port, proto, ingress))
         return flows
 
+    def pf_denied(self, ip: str, ingress: bool) -> bool:
+        """Host-side XDP-prefilter oracle: ingress-only deny LPM."""
+        if not ingress or not self.deny_cidrs:
+            return False
+        addr = ipaddress.ip_address(ip)
+        return any(
+            addr in net
+            for net in map(ipaddress.ip_network, self.deny_cidrs)
+            if net.version == addr.version
+        )
+
     def check_parity(self, flows, native: "NativeFastpath" = None):
-        """Every flow: oracle == pipeline (== native when given)."""
+        """Every flow: oracle == pipeline (== native when given),
+        including prefilter-denied verdicts."""
         for direction in (True, False):
             batch = [f for f in flows if f[5] == direction]
             if not batch:
                 continue
-            ips = ip_strings_to_u32([f[2] for f in batch])
             eps = np.array([f[0] for f in batch], np.int32)
             dports = np.array([f[3] for f in batch], np.int32)
             protos = np.array([f[4] for f in batch], np.int32)
-            v, red = self.pipe.process(
-                ips, eps, dports, protos, ingress=direction
-            )
-            if native is not None:
+            if self.family == 4:
+                ips = ip_strings_to_u32([f[2] for f in batch])
+                v, red = self.pipe.process(
+                    ips, eps, dports, protos, ingress=direction
+                )
+            else:
+                ips = ipv6_to_bytes([f[2] for f in batch])
+                v, red = self.pipe.process_v6(
+                    ips, eps, dports, protos, ingress=direction
+                )
+            if native is not None and self.family == 4:
                 nv, nred = native.process(
                     ips, eps, dports, protos, ingress=direction
                 )
                 assert np.array_equal(v, nv), "pipeline vs native diverged"
                 assert np.array_equal(red, nred)
             for i, (ep_i, peer, ip, port, proto, ing) in enumerate(batch):
+                if self.pf_denied(ip, ing):
+                    assert int(v[i]) == DROP_PREFILTER, (
+                        f"expected prefilter drop for {ip}, got {int(v[i])}"
+                    )
+                    continue
                 want = self.oracle(ep_i, peer, port, proto, ing)
                 got = int(v[i]) == FORWARD
                 assert got == want, (
@@ -222,7 +258,8 @@ class World:
     # -- mutations ------------------------------------------------------
     def mutate(self, step: int) -> str:
         kind = self.rng.choice(
-            ["add_rule", "del_rule", "add_ident", "del_ident", "ipcache"]
+            ["add_rule", "del_rule", "add_ident", "del_ident", "ipcache",
+             "prefilter"]
         )
         if kind == "add_rule":
             self.repo.add_list([_random_rule(self.rng, 1000 + step)])
@@ -235,8 +272,12 @@ class World:
                 self.repo.delete_by_labels(parse_label_array(labels[:1]))
         elif kind == "add_ident":
             ident = self._alloc_ident()
-            ip = f"172.16.200.{step + 1}"
-            self.ipcache.upsert(f"{ip}/32", ident.id, source="k8s")
+            ip = (
+                f"172.16.200.{step + 1}" if self.family == 4
+                else f"fd00:aa::2:{step + 1:x}"
+            )
+            plen = 32 if self.family == 4 else 128
+            self.ipcache.upsert(f"{ip}/{plen}", ident.id, source="k8s")
             self.peers.append((ident, ip))
         elif kind == "del_ident":
             victims = [
@@ -248,17 +289,47 @@ class World:
             if victims:
                 victim, ip = self.rng.choice(victims)
                 self.reg.release(victim)
-                self.ipcache.delete(f"{ip}/32", "k8s")
+                plen = 32 if self.family == 4 else 128
+                self.ipcache.delete(f"{ip}/{plen}", "k8s")
                 self.peers.remove((victim, ip))
                 # the address now resolves to world — keep probing it
                 self.peers.append((None, ip))
-        else:
+        elif kind == "ipcache":
             # remap a fresh prefix onto an existing identity and PROBE
-            # it, so the churned entry itself is observed
+            # it, so the churned entry itself is observed. v6 draws
+            # OUTSIDE the shared prefix half the time — each such add
+            # or delete recomputes the trie's elision depth
             ident = self._alloc_ident()
-            ip = f"192.0.2.{(step % 250) + 1}"
-            self.ipcache.upsert(f"{ip}/32", ident.id, source="k8s")
+            if self.family == 4:
+                ip, plen = f"192.0.2.{(step % 250) + 1}", 32
+            elif self.rng.random() < 0.5:
+                ip, plen = f"fd00:aa::3:{step + 1:x}", 128
+            else:
+                ip, plen = f"fd77::{step + 1:x}", 128
+            self.ipcache.upsert(f"{ip}/{plen}", ident.id, source="k8s")
             self.peers.append((ident, ip))
+        else:
+            # XDP deny churn: insert or remove a deny CIDR over the
+            # probe space (exercises the empty<->nonempty static-flag
+            # switch and, in v6, elision-depth shrink via wide denies)
+            if self.deny_cidrs and self.rng.random() < 0.4:
+                gone = self.rng.choice(self.deny_cidrs)
+                self.deny_cidrs.remove(gone)
+                self.prefilter.delete(self.prefilter.revision, [gone])
+            else:
+                pool = (
+                    ["172.16.0.0/20", "192.0.2.0/28", "8.8.8.0/24",
+                     "172.16.200.0/28"]
+                    if self.family == 4
+                    else ["fd00:aa::/120", "fd77::/32", "2001:db8::/64",
+                          "fd00:aa::2:0/112"]
+                )
+                cidr = self.rng.choice(
+                    [c for c in pool if c not in self.deny_cidrs] or pool
+                )
+                if cidr not in self.deny_cidrs:
+                    self.deny_cidrs.append(cidr)
+                    self.prefilter.insert(self.prefilter.revision, [cidr])
         return kind
 
 
@@ -290,6 +361,18 @@ def test_parity_under_incremental_mutation(seed):
             if native_available() else None
         )
         w.check_parity(w.random_flows(60), native)
+
+
+@pytest.mark.parametrize("seed", [211, 223])
+def test_v6_parity_under_mutation(seed):
+    """The IPv6 pipeline (elided stride-8 tries) against the oracle
+    across mutation steps that churn the elision depth: in-prefix and
+    out-of-prefix identity adds, wide v6 denies, deletes."""
+    w = World(seed, family=6)
+    w.check_parity(w.random_flows(80))
+    for step in range(8):
+        w.mutate(step)
+        w.check_parity(w.random_flows(50))
 
 
 def _random_http_rules(rng: random.Random, n: int):
