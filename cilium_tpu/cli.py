@@ -399,6 +399,9 @@ def build_parser() -> argparse.ArgumentParser:
     kvs.add_argument("--listen", default="127.0.0.1:4240",
                      metavar="HOST:PORT")
     kvs.add_argument("--lease-ttl", type=float, default=15.0)
+    kvs.add_argument("--state-file", default=None, metavar="PATH",
+                     help="persist non-lease keys across restarts "
+                          "(periodic + on-stop atomic snapshots)")
     for opname, ophelp in (
         ("get", "read keys under a prefix"),
         ("set", "write one key"),
@@ -625,7 +628,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                       file=sys.stderr)
                 return 2
             server = KVStoreServer(
-                host or "127.0.0.1", int(port), lease_ttl=args.lease_ttl
+                host or "127.0.0.1", int(port), lease_ttl=args.lease_ttl,
+                state_path=args.state_file,
             ).start()
             print(f"kvstore serving on {server.url}", flush=True)
             try:

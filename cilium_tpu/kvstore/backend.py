@@ -328,6 +328,16 @@ class InMemoryStore:
                 k: e.value for k, e in self._data.items() if k.startswith(prefix)
             }
 
+    def snapshot_non_lease(self) -> Tuple[int, Dict[str, bytes]]:
+        """(revision, {key: value}) for every key NOT bound to a lease
+        — the durable subset a server snapshot persists (lease-bound
+        state dies with its sessions by design)."""
+        with self._lock:
+            return self._rev, {
+                k: e.value for k, e in self._data.items()
+                if e.lease_id is None
+            }
+
     def attach_watcher(self, prefix: str, watcher: Watcher) -> None:
         with self._lock:
             self._watchers.append((prefix, watcher))

@@ -38,6 +38,8 @@ the reference's session-loss semantics.
 from __future__ import annotations
 
 import base64
+import json
+import os
 import socket
 import threading
 import time
@@ -114,9 +116,24 @@ class KVStoreServer:
         host: str = "127.0.0.1",
         port: int = 0,
         lease_ttl: float = 15.0,
+        state_path: Optional[str] = None,
+        snapshot_interval: float = 5.0,
     ) -> None:
         self.store = InMemoryStore()
         self.lease_ttl = lease_ttl
+        # durability (the etcd WAL role, snapshot-grained): non-lease
+        # keys persist across server restarts via a periodically (and
+        # on stop) rewritten JSON snapshot. Lease-bound keys are
+        # DELIBERATELY excluded — their owners' sessions died with the
+        # old server, so restoring them would resurrect state whose
+        # death signal (the lease) already fired; owners re-create
+        # them through their normal resync paths on reconnect.
+        self.state_path = state_path
+        self.snapshot_interval = snapshot_interval
+        self._dirty_rev = -1
+        self._snap_lock = threading.Lock()  # serializes writers
+        if state_path:
+            self._load_snapshot()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -137,6 +154,10 @@ class KVStoreServer:
         s = threading.Thread(target=self._sweep_loop, daemon=True)
         s.start()
         self._threads += [t, s]
+        if self.state_path:
+            p = threading.Thread(target=self._snapshot_loop, daemon=True)
+            p.start()
+            self._threads.append(p)
         return self
 
     def stop(self) -> None:
@@ -149,6 +170,59 @@ class KVStoreServer:
             sessions = list(self._sessions.values())
         for sess in sessions:
             sess.close()
+        if self.state_path:
+            try:
+                self._write_snapshot()
+            except OSError as e:
+                # a failing disk must not turn shutdown into a crash
+                log.warning("final kvstore snapshot failed",
+                            fields={"err": str(e)})
+
+    # -- durability -----------------------------------------------------
+    def _load_snapshot(self) -> None:
+        try:
+            with open(self.state_path, "rb") as f:
+                data = json.loads(f.read())
+            kv = data["kv"] if isinstance(data, dict) else None
+            if not isinstance(kv, dict):
+                raise ValueError("snapshot is not a {rev, kv} object")
+            decoded = {
+                key: base64.b64decode(v64) for key, v64 in kv.items()
+            }
+        except FileNotFoundError:
+            return
+        except Exception as e:  # half-damaged disks produce ANY shape
+            log.warning("kvstore snapshot unreadable; starting empty",
+                        fields={"path": self.state_path, "err": str(e)})
+            return
+        for key, value in decoded.items():
+            self.store.put(key, value, None)
+        log.info("kvstore snapshot restored", fields={
+            "path": self.state_path, "keys": len(decoded),
+        })
+
+    def _write_snapshot(self) -> None:
+        with self._snap_lock:  # stop() vs periodic loop share one tmp
+            rev, data = self.store.snapshot_non_lease()
+            if rev == self._dirty_rev:
+                return  # nothing moved since the last write
+            kv = {
+                k: base64.b64encode(v).decode("ascii")
+                for k, v in data.items()
+            }
+            tmp = f"{self.state_path}.tmp"
+            with open(tmp, "w") as f:
+                f.write(json.dumps({"rev": rev, "kv": kv}))
+            os.replace(tmp, self.state_path)  # atomic: never torn
+            self._dirty_rev = rev
+
+    def _snapshot_loop(self) -> None:
+        while not self._stop.wait(self.snapshot_interval):
+            try:
+                self._write_snapshot()
+            except OSError as e:
+                log.warning("kvstore snapshot write failed",
+                            fields={"err": str(e)})
 
     # -- internals ------------------------------------------------------
     def _drop(self, sess: _ClientSession) -> None:
