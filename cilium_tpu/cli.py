@@ -222,6 +222,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     mon = sub.add_parser("monitor", help="stream datapath/agent events")
     mon.add_argument("--json", action="store_true", help="print raw events")
+    mon.add_argument("--type", action="append", default=None,
+                     dest="types", metavar="TYPE",
+                     choices=["drop", "trace", "agent", "l7", "capture"],
+                     help="only these event types (repeatable; "
+                          "cilium monitor --type)")
     mon.add_argument("--timeout", type=float, default=None,
                      help="stop after N idle seconds (default: run forever)")
 
@@ -231,8 +236,9 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--join", default=None, metavar="KVSTORE",
                    help="join a cluster via a shared kvstore: a SQLite "
                         "path (all agents on one host pass the same "
-                        "file) or tcp://host:port of a `kvstore serve` "
-                        "server for multi-host clusters")
+                        "file) or tcp://host:port[,tcp://h2:p2,...] of "
+                        "`kvstore serve` servers (first reachable "
+                        "endpoint wins; rejoin retries the list)")
     d.add_argument("--node-name", default=None,
                    help="cluster node name (default: hostname)")
     d.add_argument("--node-ip", default=None,
@@ -560,8 +566,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 1
         print(f"Listening for events on {path}...", file=sys.stderr)
+        from .monitor.events import (
+            EVENT_AGENT,
+            EVENT_CAPTURE,
+            EVENT_DROP,
+            EVENT_L7,
+            EVENT_TRACE,
+        )
+
+        _type_names = {EVENT_DROP: "drop", EVENT_TRACE: "trace",
+                       EVENT_AGENT: "agent", EVENT_L7: "l7",
+                       EVENT_CAPTURE: "capture"}
         try:
             for ev in monitor_stream(path, timeout=args.timeout):
+                if args.types and _type_names.get(ev.type) not in args.types:
+                    continue
                 if args.json:
                     d = dataclasses.asdict(ev)
                     # bytes fields (peer_addr, capture payloads) ride

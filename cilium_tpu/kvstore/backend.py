@@ -329,14 +329,18 @@ class InMemoryStore:
             }
 
     def snapshot_non_lease(self) -> Tuple[int, Dict[str, bytes]]:
-        """(revision, {key: value}) for every key NOT bound to a lease
-        — the durable subset a server snapshot persists (lease-bound
-        state dies with its sessions by design)."""
+        """(durable_rev, {key: value}) for every key NOT bound to a
+        lease — the durable subset a server snapshot persists
+        (lease-bound state dies with its sessions by design).
+        durable_rev is the max mod-revision of THOSE keys, so pure
+        lease churn (node announces, ipcache updates) does not make
+        the snapshot look dirty."""
         with self._lock:
-            return self._rev, {
-                k: e.value for k, e in self._data.items()
-                if e.lease_id is None
+            data = {
+                k: e for k, e in self._data.items() if e.lease_id is None
             }
+            rev = max((e.mod_rev for e in data.values()), default=0)
+            return rev, {k: e.value for k, e in data.items()}
 
     def attach_watcher(self, prefix: str, watcher: Watcher) -> None:
         with self._lock:

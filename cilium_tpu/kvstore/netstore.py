@@ -197,6 +197,13 @@ class KVStoreServer:
             return
         for key, value in decoded.items():
             self.store.put(key, value, None)
+        # keep revisions monotonic across restarts (etcd-like): the
+        # hello advertises rev, and a reconnecting client must not see
+        # it move backwards
+        try:
+            self.store._rev = max(self.store._rev, int(data.get("rev", 0)))
+        except (TypeError, ValueError):
+            pass
         log.info("kvstore snapshot restored", fields={
             "path": self.state_path, "keys": len(decoded),
         })
@@ -605,10 +612,28 @@ class NetBackend(BackendOperations):
 
 
 def backend_from_target(target: str, name: str) -> BackendOperations:
-    """``tcp://host:port`` → :class:`NetBackend`; anything else is a
-    path for the SQLite :class:`FileBackend` (single-host fabric)."""
+    """``tcp://host:port[,tcp://host2:port2,...]`` → :class:`NetBackend`
+    connected to the first reachable endpoint (the etcd client's
+    endpoint-list failover); anything else is a path for the SQLite
+    :class:`FileBackend` (single-host fabric)."""
     if target.startswith("tcp://"):
-        return NetBackend(target, name)
+        endpoints = [e.strip() for e in target.split(",")]
+        for ep in endpoints:  # malformed syntax fails FAST (ValueError),
+            t = ep[len("tcp://"):] if ep.startswith("tcp://") else ep
+            host, _, port = t.rpartition(":")
+            if not host or not port.isdigit():  # not as "unreachable"
+                raise ValueError(
+                    f"kvstore endpoint {ep!r} must be tcp://host:port"
+                )
+        last: Optional[Exception] = None
+        for ep in endpoints:
+            try:
+                return NetBackend(ep, name)
+            except (OSError, ConnectionError) as e:
+                last = e
+        raise ConnectionError(
+            f"no kvstore endpoint reachable in {target!r}: {last}"
+        )
     from .filestore import FileBackend
 
     return FileBackend(target, name)

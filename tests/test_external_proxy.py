@@ -145,7 +145,7 @@ class TestExternalProcess:
             # denied identity (unmapped 127.0.0.2 → world) → 403
             assert _http_get(proxy_port, "/public/index", source="127.0.0.2") == 403
             # access logs crossed the process boundary
-            assert _wait_for(lambda: len(sink.recent()) >= 3)
+            assert _wait_for(lambda: len(sink.recent()) >= 3, timeout=30)
             recs = sink.recent()
             verdicts = [r.verdict for r in recs[-3:]]
             assert verdicts == ["Forwarded", "Denied", "Denied"]

@@ -366,3 +366,18 @@ class TestDurability:
             c.close()
         finally:
             srv.stop()
+
+
+class TestEndpointFailover:
+    def test_backend_from_target_tries_endpoints_in_order(self, server):
+        from cilium_tpu.kvstore.netstore import backend_from_target
+
+        # first endpoint dead, second alive → connects to the second
+        be = backend_from_target(
+            f"tcp://127.0.0.1:1,{server.url}", "node-a"
+        )
+        be.set("k", b"v")
+        assert be.get("k") == b"v"
+        be.close()
+        with pytest.raises(ConnectionError):
+            backend_from_target("tcp://127.0.0.1:1,tcp://127.0.0.1:2", "x")
