@@ -213,6 +213,11 @@ class InMemoryStore:
         self._lock = threading.RLock()
         self._data: Dict[str, _Entry] = {}
         self._rev = 0
+        # bumps whenever the DURABLE (non-lease) key set or its values
+        # change — including deletes, which leave no surviving mod_rev
+        # to witness them — so snapshot dirty-checks can't miss a
+        # deletion or churn on pure lease traffic
+        self._durable_rev = 0
         self._next_lease = 1
         self._leases: Dict[int, set] = {}  # lease id -> set of keys
         self._watchers: List[Tuple[str, Watcher]] = []
@@ -265,6 +270,9 @@ class InMemoryStore:
             old.mod_rev = self._rev
         if lease_id is not None:
             self._leases.setdefault(lease_id, set()).add(key)
+        if lease_id is None or (old is not None and old.lease_id is None):
+            # a durable write, or a key leaving the durable set
+            self._durable_rev = self._rev
         self._emit(
             KVEvent(EventTypeCreate if old is None else EventTypeModify, key, value)
         )
@@ -274,7 +282,9 @@ class InMemoryStore:
         if entry is None:
             return
         self._rev += 1
-        if entry.lease_id is not None:
+        if entry.lease_id is None:
+            self._durable_rev = self._rev  # durable deletion
+        else:
             self._leases.get(entry.lease_id, set()).discard(key)
         self._emit(KVEvent(EventTypeDelete, key, entry.value))
 
@@ -328,19 +338,19 @@ class InMemoryStore:
                 k: e.value for k, e in self._data.items() if k.startswith(prefix)
             }
 
-    def snapshot_non_lease(self) -> Tuple[int, Dict[str, bytes]]:
-        """(durable_rev, {key: value}) for every key NOT bound to a
-        lease — the durable subset a server snapshot persists
-        (lease-bound state dies with its sessions by design).
-        durable_rev is the max mod-revision of THOSE keys, so pure
-        lease churn (node announces, ipcache updates) does not make
-        the snapshot look dirty."""
+    def snapshot_non_lease(self) -> Tuple[int, int, Dict[str, bytes]]:
+        """(durable_rev, global_rev, {key: value}) for every key NOT
+        bound to a lease — the durable subset a server snapshot
+        persists (lease-bound state dies with its sessions by design).
+        durable_rev witnesses every durable put AND delete, so pure
+        lease churn never dirties a snapshot and a deletion always
+        does; global_rev is what a restart restores so client-visible
+        revisions stay monotonic."""
         with self._lock:
-            data = {
-                k: e for k, e in self._data.items() if e.lease_id is None
+            return self._durable_rev, self._rev, {
+                k: e.value for k, e in self._data.items()
+                if e.lease_id is None
             }
-            rev = max((e.mod_rev for e in data.values()), default=0)
-            return rev, {k: e.value for k, e in data.items()}
 
     def attach_watcher(self, prefix: str, watcher: Watcher) -> None:
         with self._lock:

@@ -198,30 +198,33 @@ class KVStoreServer:
         for key, value in decoded.items():
             self.store.put(key, value, None)
         # keep revisions monotonic across restarts (etcd-like): the
-        # hello advertises rev, and a reconnecting client must not see
-        # it move backwards
+        # hello advertises the GLOBAL rev persisted at snapshot time,
+        # and a reconnecting client must not see it move backwards
         try:
             self.store._rev = max(self.store._rev, int(data.get("rev", 0)))
         except (TypeError, ValueError):
             pass
+        # the restore itself is not "dirt": skip the first periodic
+        # write unless something actually changes
+        self._dirty_rev = self.store._durable_rev
         log.info("kvstore snapshot restored", fields={
             "path": self.state_path, "keys": len(decoded),
         })
 
     def _write_snapshot(self) -> None:
         with self._snap_lock:  # stop() vs periodic loop share one tmp
-            rev, data = self.store.snapshot_non_lease()
-            if rev == self._dirty_rev:
-                return  # nothing moved since the last write
+            durable_rev, global_rev, data = self.store.snapshot_non_lease()
+            if durable_rev == self._dirty_rev:
+                return  # no durable put OR delete since the last write
             kv = {
                 k: base64.b64encode(v).decode("ascii")
                 for k, v in data.items()
             }
             tmp = f"{self.state_path}.tmp"
             with open(tmp, "w") as f:
-                f.write(json.dumps({"rev": rev, "kv": kv}))
+                f.write(json.dumps({"rev": global_rev, "kv": kv}))
             os.replace(tmp, self.state_path)  # atomic: never torn
-            self._dirty_rev = rev
+            self._dirty_rev = durable_rev
 
     def _snapshot_loop(self) -> None:
         while not self._stop.wait(self.snapshot_interval):

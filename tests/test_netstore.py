@@ -381,3 +381,99 @@ class TestEndpointFailover:
         be.close()
         with pytest.raises(ConnectionError):
             backend_from_target("tcp://127.0.0.1:1,tcp://127.0.0.1:2", "x")
+
+
+class TestThreeProcessCluster:
+    def test_two_real_daemons_over_tcp_server(self, tmp_path):
+        """The flagship multi-host topology as REAL processes: one
+        `kvstore serve` + two `daemon --join tcp://...` agents.
+        Node A's endpoint propagates into node B's ipcache over the
+        network; killing A withdraws it (lease revocation on
+        disconnect). Heavy (two interpreter boots) but it is the only
+        test of the full 3-process shape."""
+        srv = subprocess.Popen(
+            [sys.executable, "-m", "cilium_tpu.cli", "kvstore", "serve",
+             "--listen", "127.0.0.1:0", "--lease-ttl", "2"],
+            stdout=subprocess.PIPE, text=True,
+        )
+        daemons = []
+        try:
+            url = srv.stdout.readline().split()[-1]
+            for name, ip, cidr in (
+                ("node-a", "192.168.9.1", "10.8.0.0/16"),
+                ("node-b", "192.168.9.2", "10.9.0.0/16"),
+            ):
+                sock = str(tmp_path / f"{name}.sock")
+                daemons.append((sock, subprocess.Popen(
+                    [sys.executable, "-m", "cilium_tpu.cli",
+                     "--socket", sock, "--state", str(tmp_path / name),
+                     "daemon", "--join", url, "--node-name", name,
+                     "--node-ip", ip, "--pod-cidr", cidr,
+                     "--sync-interval", "0.2"],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                )))
+            from cilium_tpu.api.client import APIClient
+
+            deadline = time.monotonic() + 120  # parallel jax boots
+            import os as _os
+            while time.monotonic() < deadline and not all(
+                _os.path.exists(s) for s, _ in daemons
+            ):
+                time.sleep(0.3)
+            a = APIClient(daemons[0][0], timeout=60)
+            b = APIClient(daemons[1][0], timeout=60)
+            a.endpoint_put(7, ["k8s:app=web"], ipv4="10.8.0.7")
+            ident = a.endpoint_get(7)["identity"]
+
+            def b_sees():
+                return any(
+                    e.get("cidr", "").startswith("10.8.0.7")
+                    and e.get("identity") == ident
+                    for e in b.map_dump("ipcache")
+                )
+
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not b_sees():
+                time.sleep(0.3)
+            assert b_sees(), b.map_dump("ipcache")
+            assert any(n["name"] == "node-a" for n in b.node_list())
+
+            # node A dies → lease revoked → B withdraws the entry
+            daemons[0][1].kill()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and b_sees():
+                time.sleep(0.3)
+            assert not b_sees()
+        finally:
+            for _s, p in daemons:
+                p.terminate()
+            for _s, p in daemons:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            srv.terminate()
+            srv.wait(timeout=5)
+
+
+def test_snapshot_persists_deletions(tmp_path):
+    """A durable DELETE must dirty the snapshot: the deleted key stays
+    gone after a restart (regression: a dirty-check keyed on surviving
+    keys' revisions resurrected deletions)."""
+    state = str(tmp_path / "kv.json")
+    srv = KVStoreServer(state_path=state, snapshot_interval=3600).start()
+    c = NetBackend(srv.url, "a")
+    c.set("cilium/a", b"1")
+    c.set("cilium/b", b"2")
+    srv._write_snapshot()
+    c.delete("cilium/a")
+    c.close()
+    srv.stop()  # final snapshot must notice the delete
+    srv2 = KVStoreServer(state_path=state).start()
+    try:
+        c2 = NetBackend(srv2.url, "b")
+        assert c2.get("cilium/a") is None
+        assert c2.get("cilium/b") == b"2"
+        c2.close()
+    finally:
+        srv2.stop()
