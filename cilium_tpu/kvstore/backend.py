@@ -252,6 +252,10 @@ class InMemoryStore:
     def _put_locked(
         self, key: str, value: bytes, lease_id: Optional[int]
     ) -> None:
+        was_durable = (
+            self._data.get(key) is not None
+            and self._data[key].lease_id is None
+        )
         # a write racing its own lease's revocation must fail, not
         # resurrect the popped lease entry: nothing would ever revoke
         # that id again, so the key (e.g. a '/.lock') would be orphaned
@@ -270,8 +274,11 @@ class InMemoryStore:
             old.mod_rev = self._rev
         if lease_id is not None:
             self._leases.setdefault(lease_id, set()).add(key)
-        if lease_id is None or (old is not None and old.lease_id is None):
+        if lease_id is None or was_durable:
             # a durable write, or a key leaving the durable set
+            # (was_durable is captured BEFORE old.lease_id is
+            # overwritten above — the post-mutation value would make
+            # durable->leased transitions invisible to snapshots)
             self._durable_rev = self._rev
         self._emit(
             KVEvent(EventTypeCreate if old is None else EventTypeModify, key, value)

@@ -102,8 +102,13 @@ class _PortPolicy:
 from ..utils.framing import recv_exact as _recv_exact  # shared framing
 
 
-def _read_http_head(conn: socket.socket, limit: int = 65536) -> Optional[bytes]:
-    buf = b""
+def _read_http_head(
+    conn: socket.socket, carry: bytes = b"", limit: int = 65536
+) -> Optional[bytes]:
+    """Read up to and past one request head. ``carry`` holds bytes a
+    previous request on this keep-alive connection already pulled off
+    the socket (pipelined requests / over-read body tails)."""
+    buf = carry
     while b"\r\n\r\n" not in buf:
         if len(buf) > limit:
             return None
@@ -204,8 +209,13 @@ class StandaloneProxy:
         with self._lock:
             return self._policies.get(port)
 
+    # idle keep-alive connections are reaped after this long; also
+    # bounds a stalled mid-request body (Envoy's idle_timeout role)
+    IDLE_TIMEOUT_S = 60.0
+
     def _serve_conn(self, conn: socket.socket, peer, port: int) -> None:
         try:
+            conn.settimeout(self.IDLE_TIMEOUT_S)
             pol = self._policy(port)
             if pol is None:
                 return
@@ -225,9 +235,35 @@ class StandaloneProxy:
     def _serve_http(
         self, conn: socket.socket, pol: _PortPolicy, src_identity: int
     ) -> None:
-        head = _read_http_head(conn)
+        """HTTP/1.1 keep-alive: requests are served off this connection
+        until the client closes or asks for Connection: close (the
+        reference's Envoy terminates/keeps connections the same way).
+        Each request is policy-checked independently."""
+        carry = b""
+        while not self._stop.is_set():
+            carry = self._serve_one_http(conn, pol, src_identity, carry)
+            if carry is None:
+                return
+
+    @staticmethod
+    def _drain(conn: socket.socket, n: int) -> bool:
+        """Consume n body bytes still on the socket; False on EOF."""
+        while n > 0:
+            chunk = conn.recv(min(65536, n))
+            if not chunk:
+                return False
+            n -= len(chunk)
+        return True
+
+    def _serve_one_http(
+        self, conn: socket.socket, pol: _PortPolicy, src_identity: int,
+        carry: bytes,
+    ) -> Optional[bytes]:
+        """One request/response exchange → leftover bytes for the next
+        request, or None to close the connection."""
+        head = _read_http_head(conn, carry)
         if head is None:
-            return
+            return None
         try:
             head_text, _, body_rest = head.partition(b"\r\n\r\n")
             lines = head_text.decode("latin1").split("\r\n")
@@ -243,7 +279,7 @@ class StandaloneProxy:
                     host = value.strip()
         except (ValueError, IndexError):
             conn.sendall(b"HTTP/1.1 400 Bad Request\r\ncontent-length: 0\r\n\r\n")
-            return
+            return None  # can't re-sync a malformed stream
         req = HTTPRequest(
             method=method, path=path, host=host,
             headers=tuple(headers), src_identity=src_identity,
@@ -253,26 +289,43 @@ class StandaloneProxy:
             conn.sendall(
                 b"HTTP/1.1 501 Not Implemented\r\ncontent-length: 0\r\n\r\n"
             )
-            return
+            return None  # unknown body framing: cannot find next request
         try:
             content_length = int(hdr_map.get("content-length", "0"))
         except ValueError:
             content_length = 0
-        # body bytes not yet read off the client socket when the head
-        # completed — the forward path must drain + relay them
+        # split what we over-read into this request's body vs the next
+        # request's head (pipelining); drain any body still in flight
         body_pending = max(0, content_length - len(body_rest))
+        leftover = body_rest[content_length:] if content_length < len(body_rest) else b""
+        wants_close = "close" in hdr_map.get("connection", "").lower()
         allowed = pol.http is None or bool(pol.http.check(req))
         code = 200 if allowed else 403
         if allowed:
             if self.upstream is not None:
-                code = self._forward_http(conn, head, body_pending, pol)
+                # forward ONLY this request's bytes: the over-read tail
+                # may hold a pipelined next request that must be
+                # policy-checked here, never smuggled upstream
+                this_request = (
+                    head_text + b"\r\n\r\n" + body_rest[:content_length]
+                )
+                code = self._forward_http(
+                    conn, this_request, body_pending, pol
+                )
+                leftover = None  # upstream response framing is opaque:
+                # we stream it until close, so the connection cannot be
+                # reused afterwards (pipelined tail is dropped unserved)
             else:
+                if not self._drain(conn, body_pending):
+                    return None
                 body = b"OK\n"
                 conn.sendall(
                     b"HTTP/1.1 200 OK\r\ncontent-length: "
                     + str(len(body)).encode() + b"\r\n\r\n" + body
                 )
         else:
+            if not self._drain(conn, body_pending):  # denied: eat body
+                return None
             body = b"Access denied\r\n"
             conn.sendall(
                 b"HTTP/1.1 403 Forbidden\r\ncontent-length: "
@@ -287,6 +340,7 @@ class StandaloneProxy:
             "proto": "http",
             "http": {"method": method, "path": path, "host": host, "code": code},
         })
+        return None if wants_close else leftover
 
     def _forward_http(
         self, conn: socket.socket, head: bytes, body_pending: int,

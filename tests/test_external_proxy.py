@@ -299,3 +299,122 @@ class TestKafkaWire:
             assert v == ["Denied", "Forwarded"]
         finally:
             proxy.close()
+
+
+class TestKeepAlive:
+    def test_multiple_requests_one_connection(self, control_plane):
+        """HTTP/1.1 keep-alive: one TCP connection carries several
+        requests, each policy-checked independently; Connection: close
+        ends it."""
+        cache, xds_path, al_path, sink = control_plane
+        proxy_port = _free_port()
+        _publish_world(cache, proxy_port)
+        proxy = StandaloneProxy(xds_path, al_path)
+        try:
+            assert proxy.wait_ready()
+            c = socket.create_connection(("127.0.0.1", proxy_port), timeout=10)
+            c.settimeout(10)
+
+            def roundtrip(path, body=b"", close=False):
+                hdrs = f"POST {path} HTTP/1.1\r\nHost: h\r\n" \
+                       f"content-length: {len(body)}\r\n"
+                if close:
+                    hdrs += "Connection: close\r\n"
+                c.sendall(hdrs.encode() + b"\r\n" + body)
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    data += c.recv(4096)
+                head, _, rest = data.partition(b"\r\n\r\n")
+                clen = int([l for l in head.split(b"\r\n")
+                            if l.lower().startswith(b"content-length")][0].split(b":")[1])
+                while len(rest) < clen:
+                    rest += c.recv(4096)
+                return int(head.split(b" ")[1])
+
+            assert roundtrip("/public/a", body=b"xyz") == 200
+            assert roundtrip("/secret") == 403  # same connection
+            assert roundtrip("/public/b") == 200  # still alive after a 403
+            assert roundtrip("/public/c", close=True) == 200
+            # server honors Connection: close
+            assert c.recv(4096) == b""
+            c.close()
+        finally:
+            proxy.close()
+
+    def test_pipelined_requests(self, control_plane):
+        """Two requests sent back-to-back before reading: the carry
+        buffer must hand request 2's head to the next iteration."""
+        cache, xds_path, al_path, sink = control_plane
+        proxy_port = _free_port()
+        _publish_world(cache, proxy_port)
+        proxy = StandaloneProxy(xds_path, al_path)
+        try:
+            assert proxy.wait_ready()
+            c = socket.create_connection(("127.0.0.1", proxy_port), timeout=10)
+            c.settimeout(10)
+            c.sendall(b"GET /public/1 HTTP/1.1\r\nHost: h\r\n\r\n"
+                      b"GET /secret HTTP/1.1\r\nHost: h\r\n\r\n")
+            data = b""
+            deadline = time.monotonic() + 10
+            while data.count(b"HTTP/1.1") < 2 and time.monotonic() < deadline:
+                data += c.recv(4096)
+            codes = [int(seg.split(b" ")[0])
+                     for seg in data.split(b"HTTP/1.1 ")[1:]]
+            assert codes == [200, 403], codes
+            c.close()
+        finally:
+            proxy.close()
+
+
+def test_pipelined_bytes_never_smuggled_upstream(control_plane):
+    """With an upstream configured, the over-read tail of an allowed
+    request (a pipelined second request policy would deny) must not be
+    relayed upstream unchecked — only the current request's bytes go."""
+    cache, xds_path, al_path, sink = control_plane
+    proxy_port = _free_port()
+    _publish_world(cache, proxy_port)
+    # capture-everything upstream
+    up_srv = socket.socket()
+    up_srv.bind(("127.0.0.1", 0))
+    up_srv.listen(1)
+    got = []
+
+    def upstream():
+        conn, _ = up_srv.accept()
+        conn.settimeout(2)
+        buf = b""
+        try:
+            while True:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+                conn.sendall(b"HTTP/1.1 200 OK\r\ncontent-length: 0\r\n\r\n")
+        except socket.timeout:
+            pass
+        got.append(buf)
+        conn.close()
+
+    t = threading.Thread(target=upstream, daemon=True)
+    t.start()
+    proxy = StandaloneProxy(
+        xds_path, al_path, upstream=up_srv.getsockname()
+    )
+    try:
+        assert proxy.wait_ready()
+        c = socket.create_connection(("127.0.0.1", proxy_port), timeout=10)
+        body = b"xy"
+        c.sendall(
+            b"POST /public/a HTTP/1.1\r\nHost: h\r\ncontent-length: 2\r\n\r\n"
+            + body
+            + b"GET /secret HTTP/1.1\r\nHost: h\r\n\r\n"  # pipelined, denied
+        )
+        time.sleep(1.0)
+        c.close()
+        t.join(timeout=5)
+        assert got, "upstream saw nothing"
+        assert b"/public/a" in got[0]
+        assert b"/secret" not in got[0], "pipelined request smuggled upstream"
+    finally:
+        proxy.close()
+        up_srv.close()

@@ -477,3 +477,24 @@ def test_snapshot_persists_deletions(tmp_path):
         c2.close()
     finally:
         srv2.stop()
+
+
+def test_snapshot_sees_durable_to_leased_transition(tmp_path):
+    """Re-writing a durable key WITH a lease moves it out of the
+    durable set; the snapshot dirty-check must notice (regression: the
+    old-entry lease check ran after the mutation and never fired)."""
+    state = str(tmp_path / "kv.json")
+    srv = KVStoreServer(state_path=state, snapshot_interval=3600).start()
+    c = NetBackend(srv.url, "a")
+    c.set("cilium/x", b"durable")
+    srv._write_snapshot()  # snapshot contains x
+    c.update("cilium/x", b"leased-now", lease=True)
+    c.close()  # lease dies; key should be fully gone
+    srv.stop()
+    srv2 = KVStoreServer(state_path=state).start()
+    try:
+        c2 = NetBackend(srv2.url, "b")
+        assert c2.get("cilium/x") is None, "stale durable copy resurrected"
+        c2.close()
+    finally:
+        srv2.stop()
