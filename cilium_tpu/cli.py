@@ -341,6 +341,8 @@ def build_parser() -> argparse.ArgumentParser:
         ("ct", "conntrack entries"), ("ipcache", "IP→identity cache"),
         ("tunnel", "tunnel endpoints"), ("proxy", "proxy handoffs"),
         ("metrics", "per-endpoint counters"), ("routes", "route table"),
+        ("lxc", "local endpoints (bpf endpoint list)"),
+        ("lb", "service tables (bpf lb list)"),
     ):
         mp = bpf.add_parser(mname, help=mhelp).add_subparsers(
             dest="mapop", required=True
@@ -833,7 +835,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.sub == "ct" and args.mapop == "flush":
             _print(s.ct_flush())
         elif args.sub in ("ct", "ipcache", "tunnel", "proxy", "metrics",
-                          "routes"):
+                          "routes", "lxc", "lb"):
             _print(s.map_dump(args.sub))
         else:
             _print(s.policymap_get(args.endpoint, egress=args.egress))

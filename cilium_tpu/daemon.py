@@ -547,7 +547,8 @@ class Daemon:
     def map_list(self) -> List[Dict]:
         """Open-map inventory (cilium map list): name + entry count."""
         out = []
-        for name in ("ct", "ipcache", "tunnel", "proxy", "metrics", "routes"):
+        for name in ("ct", "ipcache", "tunnel", "proxy", "metrics",
+                     "routes", "lxc", "lb"):
             try:
                 out.append({"name": name, "entries": len(self.map_dump(name))})
             except Exception:
@@ -769,6 +770,22 @@ class Daemon:
             "metrics": self.metricsmap_dump,
             "routes": lambda: [
                 dataclasses_asdict(r) for r in self.routes.items()
+            ],
+            # cilium bpf endpoint list (lxcmap) / bpf lb list (lbmap)
+            "lxc": lambda: [
+                {"ip": ip, **dataclasses_asdict(info)}
+                for ip, info in self.lxcmap.items()
+            ],
+            "lb": lambda: [
+                {
+                    "frontend": str(s.frontend),
+                    "backends": [
+                        {"ip": b.ip, "port": b.port, "weight": b.weight}
+                        for b in s.backends
+                    ],
+                    "id": s.id,
+                }
+                for s in self.services.list()
             ],
         }
         fn = dumps.get(name)

@@ -240,7 +240,13 @@ class StandaloneProxy:
         reference's Envoy terminates/keeps connections the same way).
         Each request is policy-checked independently."""
         carry = b""
+        port = pol.proxy_port
         while not self._stop.is_set():
+            # re-resolve per request: an NPDS push mid-connection must
+            # apply to the NEXT request, not only to new connections
+            pol = self._policy(port)
+            if pol is None:
+                return  # redirect removed: stop serving this port
             carry = self._serve_one_http(conn, pol, src_identity, carry)
             if carry is None:
                 return
@@ -290,10 +296,24 @@ class StandaloneProxy:
                 b"HTTP/1.1 501 Not Implemented\r\ncontent-length: 0\r\n\r\n"
             )
             return None  # unknown body framing: cannot find next request
+        # RFC 7230: repeated Content-Length with differing values, a
+        # non-numeric value, or a negative one is a framing attack
+        # (CL.CL smuggling / parser desync) — reject and close, never
+        # guess
+        cl_values = {
+            v.strip() for k, v in headers if k.lower() == "content-length"
+        }
+        if len(cl_values) > 1:
+            conn.sendall(b"HTTP/1.1 400 Bad Request\r\ncontent-length: 0\r\n\r\n")
+            return None
         try:
-            content_length = int(hdr_map.get("content-length", "0"))
+            content_length = int(next(iter(cl_values), "0"))
         except ValueError:
-            content_length = 0
+            conn.sendall(b"HTTP/1.1 400 Bad Request\r\ncontent-length: 0\r\n\r\n")
+            return None
+        if content_length < 0:
+            conn.sendall(b"HTTP/1.1 400 Bad Request\r\ncontent-length: 0\r\n\r\n")
+            return None
         # split what we over-read into this request's body vs the next
         # request's head (pipelining); drain any body still in flight
         body_pending = max(0, content_length - len(body_rest))

@@ -280,7 +280,10 @@ class TestParityCommands:
     def test_map_list_ct_flush_node_list(self, server):
         maps = {m["name"] for m in server.map_list()}
         assert {"ct", "ipcache", "tunnel", "proxy", "metrics",
-                "routes"} <= maps
+                "routes", "lxc", "lb"} <= maps
+        server.endpoint_put(3, ["k8s:app=z"], ipv4="10.1.0.3")
+        lxc = server.map_dump("lxc")
+        assert any(e["ip"] == "10.1.0.3" for e in lxc)
         assert server.ct_flush()["flushed"] >= 0
         assert server.node_list() == []  # standalone: no peers
 

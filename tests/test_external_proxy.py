@@ -418,3 +418,80 @@ def test_pipelined_bytes_never_smuggled_upstream(control_plane):
     finally:
         proxy.close()
         up_srv.close()
+
+
+class TestFramingStrictness:
+    def test_policy_update_applies_to_live_keepalive_connection(self, control_plane):
+        """An NPDS push must change verdicts for the NEXT request on an
+        ALREADY-OPEN keep-alive connection (stale-policy regression)."""
+        cache, xds_path, al_path, sink = control_plane
+        proxy_port = _free_port()
+        _publish_world(cache, proxy_port)
+        proxy = StandaloneProxy(xds_path, al_path)
+        try:
+            assert proxy.wait_ready()
+            c = socket.create_connection(("127.0.0.1", proxy_port), timeout=10)
+            c.settimeout(10)
+
+            def get(path):
+                c.sendall(f"GET {path} HTTP/1.1\r\nHost: h\r\n\r\n".encode())
+                d = b""
+                while b"\r\n\r\n" not in d:
+                    d += c.recv(4096)
+                while not (b"OK\n" in d or b"denied" in d):
+                    d += c.recv(4096)
+                return int(d.split(b" ")[1])
+
+            assert get("/secret") == 403
+            # widen policy while the connection stays open
+            cache.upsert(NETWORK_POLICY_TYPE, "7", {
+                "endpoint_id": 7,
+                "l7_ports": [{
+                    "port": 80, "ingress": True, "parser": "http",
+                    "proxy_port": proxy_port,
+                    "http_rules": [{"path": "/.*",
+                                    "remote_policies": [CLIENT_IDENTITY]}],
+                }],
+            })
+            deadline = time.monotonic() + 10
+            code = 403
+            while code != 200 and time.monotonic() < deadline:
+                time.sleep(0.2)
+                code = get("/secret")
+            assert code == 200  # same connection, new policy
+            c.close()
+        finally:
+            proxy.close()
+
+    def test_duplicate_and_invalid_content_length_rejected(self, control_plane):
+        """CL.CL smuggling / parser-desync inputs get 400 + close."""
+        cache, xds_path, al_path, sink = control_plane
+        proxy_port = _free_port()
+        _publish_world(cache, proxy_port)
+        proxy = StandaloneProxy(xds_path, al_path)
+        try:
+            assert proxy.wait_ready()
+            for bad in (
+                b"POST /public/a HTTP/1.1\r\nHost: h\r\n"
+                b"content-length: 0\r\ncontent-length: 60\r\n\r\n",
+                b"POST /public/a HTTP/1.1\r\nHost: h\r\n"
+                b"content-length: -5\r\n\r\n",
+                b"POST /public/a HTTP/1.1\r\nHost: h\r\n"
+                b"content-length: 5, 5\r\n\r\n",
+            ):
+                c = socket.create_connection(
+                    ("127.0.0.1", proxy_port), timeout=10
+                )
+                c.settimeout(10)
+                c.sendall(bad)
+                d = b""
+                while b"\r\n\r\n" not in d:
+                    chunk = c.recv(4096)
+                    if not chunk:
+                        break
+                    d += chunk
+                assert b" 400 " in d, (bad, d)
+                assert c.recv(4096) == b""  # connection closed
+                c.close()
+        finally:
+            proxy.close()
