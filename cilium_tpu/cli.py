@@ -643,13 +643,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .kvstore.netstore import KVStoreServer, backend_from_target
 
         if args.sub == "serve":
-            host, _, port = args.listen.rpartition(":")
-            if not port.isdigit():
-                print(f"--listen {args.listen!r} must be HOST:PORT",
-                      file=sys.stderr)
+            from .kvstore.netstore import parse_hostport
+
+            try:
+                host, port = parse_hostport(args.listen)
+            except ValueError as e:
+                print(f"--listen: {e}", file=sys.stderr)
                 return 2
             server = KVStoreServer(
-                host or "127.0.0.1", int(port), lease_ttl=args.lease_ttl,
+                host or "127.0.0.1", port, lease_ttl=args.lease_ttl,
                 state_path=args.state_file,
             ).start()
             print(f"kvstore serving on {server.url}", flush=True)

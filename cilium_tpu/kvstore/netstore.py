@@ -59,6 +59,31 @@ from .backend import (
 log = get_logger("kvstore-net")
 
 
+def parse_hostport(text: str) -> Tuple[str, int]:
+    """``host:port`` / ``[v6literal]:port`` → (host, port).
+
+    An empty host (``:4240``) is allowed — callers supply their own
+    default. Raises ValueError on anything else — including a bare v6
+    literal like ``::1:4240``, which is ambiguous without brackets
+    (RFC 3986 requires them for exactly this reason)."""
+    if text.startswith("["):
+        host, sep, port = text.rpartition("]:")
+        if not sep or len(host) < 2 or not port.isdigit():
+            raise ValueError(f"{text!r} must be [host]:port")
+        host = host[1:]
+    else:
+        host, sep, port = text.rpartition(":")
+        if not sep or not port.isdigit():
+            raise ValueError(f"{text!r} must be host:port")
+        if ":" in host:
+            raise ValueError(
+                f"{text!r}: IPv6 literals need brackets — [{host}]:{port}"
+            )
+    if int(port) > 65535:
+        raise ValueError(f"{text!r}: port must be 0-65535")
+    return host, int(port)
+
+
 def _send_frame(sock: socket.socket, wlock: threading.Lock, obj: dict) -> None:
     send_json(sock, obj, wlock)
 
@@ -134,7 +159,8 @@ class KVStoreServer:
         self._snap_lock = threading.Lock()  # serializes writers
         if state_path:
             self._load_snapshot()
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        family = socket.AF_INET6 if ":" in host else socket.AF_INET
+        self._listener = socket.socket(family, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
         self._listener.listen(64)
@@ -146,6 +172,8 @@ class KVStoreServer:
 
     @property
     def url(self) -> str:
+        if ":" in self.host:  # v6 literal needs brackets
+            return f"tcp://[{self.host}]:{self.port}"
         return f"tcp://{self.host}:{self.port}"
 
     def start(self) -> "KVStoreServer":
@@ -223,7 +251,18 @@ class KVStoreServer:
             tmp = f"{self.state_path}.tmp"
             with open(tmp, "w") as f:
                 f.write(json.dumps({"rev": global_rev, "kv": kv}))
+                f.flush()
+                os.fsync(f.fileno())  # rename must not outlive the data
             os.replace(tmp, self.state_path)  # atomic: never torn
+            try:  # make the rename itself durable
+                dfd = os.open(os.path.dirname(self.state_path) or ".",
+                              os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass
             self._dirty_rev = durable_rev
 
     def _snapshot_loop(self) -> None:
@@ -391,14 +430,15 @@ class NetBackend(BackendOperations):
     ) -> None:
         if target.startswith("tcp://"):
             target = target[len("tcp://"):]
-        host, _, port = target.rpartition(":")
-        if not host or not port.isdigit():
-            raise ValueError(
-                f"kvstore target {target!r} must be tcp://host:port"
-            )
+        try:
+            host, port = parse_hostport(target)
+            if not host:
+                raise ValueError(f"{target!r}: host is required")
+        except ValueError as e:
+            raise ValueError(f"kvstore target: {e}") from None
         self.name = name
         self.op_timeout = op_timeout
-        self._sock = socket.create_connection((host, int(port)), timeout=10.0)
+        self._sock = socket.create_connection((host, port), timeout=10.0)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._wlock = threading.Lock()
         self._pending: Dict[int, Tuple[threading.Event, list]] = {}
@@ -481,7 +521,7 @@ class NetBackend(BackendOperations):
             except (ConnectionError, OSError):
                 return
 
-    def _call(self, req: dict) -> dict:
+    def _call(self, req: dict, *, nowait: bool = False) -> dict:
         if self._closed.is_set():
             raise ConnectionError("kvstore connection closed")
         ev = threading.Event()
@@ -489,7 +529,8 @@ class NetBackend(BackendOperations):
         with self._plock:
             rid = self._next_id
             self._next_id += 1
-            self._pending[rid] = (ev, out)
+            if not nowait:
+                self._pending[rid] = (ev, out)
         req["id"] = rid
         try:
             _send_frame(self._sock, self._wlock, req)
@@ -497,6 +538,8 @@ class NetBackend(BackendOperations):
             with self._plock:
                 self._pending.pop(rid, None)
             raise ConnectionError(f"kvstore send failed: {e}") from None
+        if nowait:  # fire-and-forget: the reader drops the stray reply
+            return {}
         if not ev.wait(self.op_timeout):
             with self._plock:
                 self._pending.pop(rid, None)
@@ -588,6 +631,14 @@ class NetBackend(BackendOperations):
         except Exception:
             self._watchers.pop(wid, None)
             w.stop()
+            try:  # the server still has the watch attached; detach it so
+                # its pump thread stops streaming frames nobody reads.
+                # Fire-and-forget (no reply wait): this path only runs
+                # when the server is already misbehaving, and a blocking
+                # _call here would double the caller's failure latency
+                self._call({"op": "unwatch", "wid": wid}, nowait=True)
+            except (ConnectionError, TimeoutError, RuntimeError, OSError):
+                pass
             raise
         return w
 
@@ -623,11 +674,12 @@ def backend_from_target(target: str, name: str) -> BackendOperations:
         endpoints = [e.strip() for e in target.split(",")]
         for ep in endpoints:  # malformed syntax fails FAST (ValueError),
             t = ep[len("tcp://"):] if ep.startswith("tcp://") else ep
-            host, _, port = t.rpartition(":")
-            if not host or not port.isdigit():  # not as "unreachable"
-                raise ValueError(
-                    f"kvstore endpoint {ep!r} must be tcp://host:port"
-                )
+            try:  # not as "unreachable"
+                h, _ = parse_hostport(t)
+                if not h:
+                    raise ValueError(f"{t!r}: host is required")
+            except ValueError as e:
+                raise ValueError(f"kvstore endpoint: {e}") from None
         last: Optional[Exception] = None
         for ep in endpoints:
             try:

@@ -10,6 +10,7 @@ sessions against a real etcd endpoint.
 
 from __future__ import annotations
 
+import socket
 import subprocess
 import sys
 import time
@@ -495,6 +496,65 @@ def test_snapshot_sees_durable_to_leased_transition(tmp_path):
     try:
         c2 = NetBackend(srv2.url, "b")
         assert c2.get("cilium/x") is None, "stale durable copy resurrected"
+        c2.close()
+    finally:
+        srv2.stop()
+
+
+class TestHostPortParsing:
+    """ADVICE r04: IPv6 listeners — [host]:port syntax, AF from host."""
+
+    def test_parse_hostport(self):
+        from cilium_tpu.kvstore.netstore import parse_hostport
+
+        assert parse_hostport("127.0.0.1:4240") == ("127.0.0.1", 4240)
+        assert parse_hostport("[::1]:4240") == ("::1", 4240)
+        assert parse_hostport("[2001:db8::2]:80") == ("2001:db8::2", 80)
+        # empty host is the caller's default (CLI binds 127.0.0.1)
+        assert parse_hostport(":4240") == ("", 4240)
+        for bad in ("::1:4240", "host", "[::1]", "[::1]:x", "h:p",
+                    "[]:4240", "127.0.0.1:99999"):
+            with pytest.raises(ValueError):
+                parse_hostport(bad)
+
+    @pytest.mark.skipif(
+        not socket.has_ipv6, reason="host has no IPv6 support"
+    )
+    def test_ipv6_server_roundtrip(self):
+        try:
+            probe = socket.socket(socket.AF_INET6, socket.SOCK_STREAM)
+            probe.bind(("::1", 0))
+            probe.close()
+        except OSError:
+            pytest.skip("::1 not bindable on this host")
+        srv = KVStoreServer(host="::1").start()
+        try:
+            assert srv.url.startswith("tcp://[::1]:")
+            c = NetBackend(srv.url, "v6-client")
+            c.set("cilium/v6", b"over-v6")
+            assert c.get("cilium/v6") == b"over-v6"
+            c.close()
+        finally:
+            srv.stop()
+
+
+def test_snapshot_survives_partial_write(tmp_path):
+    """ADVICE r04: the tmp file is fsync'd before the rename, and a
+    torn tmp never replaces a good snapshot."""
+    state = str(tmp_path / "kv.json")
+    srv = KVStoreServer(state_path=state, snapshot_interval=3600).start()
+    c = NetBackend(srv.url, "a")
+    c.set("cilium/durable", b"v1")
+    srv._write_snapshot()
+    c.close()
+    srv.stop()
+    # a stale tmp from a crashed writer must not shadow the real file
+    with open(state + ".tmp", "w") as f:
+        f.write('{"rev": 999, "kv"')  # torn JSON
+    srv2 = KVStoreServer(state_path=state).start()
+    try:
+        c2 = NetBackend(srv2.url, "b")
+        assert c2.get("cilium/durable") == b"v1"
         c2.close()
     finally:
         srv2.stop()
