@@ -19,3 +19,20 @@ shapes, functional transforms, sharding via jax.sharding.Mesh).
 """
 
 __version__ = "0.1.0"
+
+# Honor an EXPLICIT JAX_PLATFORMS env choice over any site-level
+# override (the axon sitecustomize force-sets jax_platforms="axon,cpu"
+# at interpreter startup, which routes subprocesses — e.g. the daemon
+# children of the three-process cluster tests — onto the TPU tunnel
+# even when the parent asked for CPU). Only acts when the variable is
+# set, so bench/production runs keep the real device.
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:  # pragma: no cover - jax absent or too old
+        pass
+del _os

@@ -7,13 +7,15 @@ bootstrap/lifecycle): a SEPARATE process that
   addresses) from the agent's xDS socket (xds/client.py — the
   subscription side of envoy/cilium_network_policy.cc and
   envoy/cilium_host_map.cc),
-- listens on every redirect's proxy port, parses HTTP/1.1 request
-  heads or Kafka request frames off real TCP connections, resolves the
-  peer's identity from the NPHDS map (the cilium_host_map.cc role;
-  the reference's bpf_metadata recovers it from the proxymap), and
-  enforces the per-port rules: 403 / Kafka reject on deny, forward to
-  the upstream (or synthesize a 200 when terminating) on allow
-  (envoy/cilium_l7policy.cc AccessFilter::decodeHeaders),
+- listens on every redirect's proxy port, codec-sniffs each TCP
+  connection (HTTP/1.1 incl. chunked bodies, HTTP/2 + gRPC via
+  proxy/http2.py, or Kafka frames), resolves the peer's identity from
+  the NPHDS map (the cilium_host_map.cc role; the reference's
+  bpf_metadata recovers it from the proxymap), and enforces the
+  per-port rules: 403 / grpc-status PERMISSION_DENIED / Kafka reject
+  on deny, forward to the upstream (or synthesize a 200 when
+  terminating) on allow (envoy/cilium_l7policy.cc
+  AccessFilter::decodeHeaders — codec-independent like Envoy's),
 - streams one access-log record per request back to the agent over the
   accesslog unix socket (envoy/accesslog.cc → accesslog_server.go:50).
 
@@ -35,6 +37,7 @@ from typing import Dict, List, Optional, Tuple
 from ..l7.http_policy import HTTPPolicy, HTTPRequest
 from ..l7.kafka_policy import KafkaACL, KafkaRequest
 from ..utils.logging import get_logger
+from .http2 import PREFACE as H2_PREFACE
 from ..xds.cache import NETWORK_POLICY_HOSTS_TYPE, NETWORK_POLICY_TYPE
 from ..xds.client import XDSClient
 from ..xds.server import _send_msg
@@ -222,8 +225,23 @@ class StandaloneProxy:
             src_identity = self.hosts.identity_of(peer[0])
             if pol.parser == "kafka":
                 self._serve_kafka(conn, pol, src_identity)
+                return
+            # Codec sniff on one port (Envoy's codec auto-detect): the
+            # H2 connection preface starts "PRI * HTTP/2.0" — no
+            # HTTP/1.1 method collides with it, so read until the bytes
+            # either diverge (HTTP/1.1, sniffed bytes become carry) or
+            # complete the preface (HTTP/2).
+            PREFACE = H2_PREFACE
+            buf = b""
+            while len(buf) < len(PREFACE) and PREFACE.startswith(buf):
+                chunk = conn.recv(len(PREFACE) - len(buf))
+                if not chunk:
+                    return
+                buf += chunk
+            if buf == PREFACE:
+                self._serve_http2(conn, pol, src_identity)
             else:
-                self._serve_http(conn, pol, src_identity)
+                self._serve_http(conn, pol, src_identity, carry=buf)
         except OSError:
             pass
         finally:
@@ -233,13 +251,13 @@ class StandaloneProxy:
                 pass
 
     def _serve_http(
-        self, conn: socket.socket, pol: _PortPolicy, src_identity: int
+        self, conn: socket.socket, pol: _PortPolicy, src_identity: int,
+        carry: bytes = b"",
     ) -> None:
         """HTTP/1.1 keep-alive: requests are served off this connection
         until the client closes or asks for Connection: close (the
         reference's Envoy terminates/keeps connections the same way).
         Each request is policy-checked independently."""
-        carry = b""
         port = pol.proxy_port
         while not self._stop.is_set():
             # re-resolve per request: an NPDS push mid-connection must
@@ -251,6 +269,239 @@ class StandaloneProxy:
             if carry is None:
                 return
 
+    def _serve_http2(
+        self, conn: socket.socket, pol: _PortPolicy, src_identity: int
+    ) -> None:
+        """HTTP/2 (and gRPC-over-H2) enforcement on the same proxy
+        port. Each stream's request HEADERS are the policy decision
+        point — the codec-independence of the reference's Envoy filter
+        (envoy/cilium_l7policy.cc:193 works per-stream, any codec).
+        Deny: 403 for plain HTTP, 200 + grpc-status PERMISSION_DENIED
+        trailers for gRPC (status rides trailers in gRPC). Allow:
+        terminate with 200, or relay the stream over an upstream H2
+        connection (one per downstream connection, ids reused)."""
+        from .http2 import (
+            GRPC_PERMISSION_DENIED,
+            H2ClientConnection,
+            H2ServerConnection,
+        )
+
+        port = pol.proxy_port
+        # sid → ("deny"|"terminate", None) or ("forward", pinned
+        # upstream conn). The pin matters: after an upstream re-dial a
+        # mid-body stream must keep talking to the connection its
+        # HEADERS went to — DATA on a fresh connection's idle stream id
+        # is a connection error that would kill every relayed stream.
+        actions: Dict[int, Tuple[str, Optional[H2ClientConnection]]] = {}
+        up_holder: Dict[str, H2ClientConnection] = {}
+        # forward-mode access logs are deferred until the upstream's
+        # response status is known (the h1 path logs the real upstream
+        # code; this keeps the h2 path's observability equivalent)
+        pending_logs: Dict[int, dict] = {}
+        plock = threading.Lock()
+
+        def emit_log(sid: int, code: Optional[int]) -> None:
+            with plock:
+                rec = pending_logs.pop(sid, None)
+            if rec is not None:
+                if code is not None:
+                    rec["http"]["code"] = code
+                self._log_record(rec)
+
+        def upstream_conn(h2) -> Optional[H2ClientConnection]:
+            up = up_holder.get("c")
+            if up is not None and not up.closed:
+                return up
+            # first use, or the previous upstream connection died
+            # (GOAWAY / restart) — dial a fresh one
+            try:
+                s = socket.create_connection(self.upstream, timeout=5.0)
+            except OSError:
+                return None
+            # the 5s connect timeout must not become the read timeout:
+            # a quiet upstream (slow gRPC handler, idle gaps between
+            # responses) would otherwise kill every in-flight stream
+            s.settimeout(self.IDLE_TIMEOUT_S)
+
+            def resp_headers(sid, headers, trailers, end):
+                if headers is not None:
+                    try:
+                        code = int(dict(headers).get(b":status", b"0"))
+                    except ValueError:
+                        code = 0
+                    if not 100 <= code < 200:  # interim ≠ final status
+                        emit_log(sid, code)
+                try:
+                    if trailers is not None:
+                        h2.send_headers(sid, trailers, True)
+                    else:
+                        h2.send_headers(sid, headers, end)
+                except OSError:
+                    pass
+
+            def resp_data(sid, chunk, end):
+                try:
+                    h2.send_data(sid, chunk, end_stream=end)
+                except OSError:
+                    pass
+
+            def resp_reset(sid):
+                emit_log(sid, 502)
+                try:
+                    h2.reset(sid)
+                except OSError:
+                    pass
+
+            up = H2ClientConnection(s)
+            up.on_response_headers = resp_headers
+            up.on_response_data = resp_data
+            up.on_response_reset = resp_reset
+            up.handshake()
+            threading.Thread(target=up.serve, daemon=True).start()
+            up_holder["c"] = up
+            return up
+
+        def on_request(h2, st) -> None:
+            # fresh policy per stream: an NPDS push mid-connection must
+            # apply to the NEXT stream (same rule as the h1 path)
+            p = self._policy(port)
+            if p is None:
+                h2.reset(st.id)
+                actions[st.id] = ("deny", None)
+                return
+            req = HTTPRequest(
+                method=st.method, path=st.path, host=st.authority,
+                headers=tuple(st.plain_headers()),
+                src_identity=src_identity,
+            )
+            allowed = p.http is None or bool(p.http.check(req))
+            code = 200 if allowed else 403
+            record = {
+                "type": "Request",
+                "verdict": "Forwarded" if allowed else "Denied",
+                "timestamp": time.time(),
+                "src_identity": src_identity,
+                "dst_port": pol.port,
+                "proto": "http",
+                "codec": "h2",
+                "http": {
+                    "method": st.method, "path": st.path,
+                    "host": st.authority, "code": code,
+                },
+            }
+            deferred = False
+            if not allowed:
+                actions[st.id] = ("deny", None)
+                if st.is_grpc:
+                    record["http"]["code"] = 200  # denial rides grpc-status
+                    h2.respond_grpc_status(
+                        st.id, GRPC_PERMISSION_DENIED, "access denied"
+                    )
+                else:
+                    h2.respond(st.id, 403, body=b"Access denied\r\n")
+            elif self.upstream is None:
+                if st.closed_remote:
+                    h2.respond(st.id, 200, body=b"OK\n")
+                    actions.pop(st.id, None)
+                else:
+                    actions[st.id] = ("terminate", None)
+            else:
+                up = upstream_conn(h2)
+                if up is None:
+                    actions[st.id] = ("deny", None)
+                    record["http"]["code"] = 502
+                    h2.respond(st.id, 502, body=b"")
+                else:
+                    fields = [
+                        (b":method", st.method.encode("latin1")),
+                        (b":scheme", b"http"),
+                        (b":path", st.path.encode("latin1")),
+                    ]
+                    if st.authority:
+                        fields.append(
+                            (b":authority", st.authority.encode("latin1"))
+                        )
+                    fields += [
+                        (k, v) for k, v in st.headers
+                        if not k.startswith(b":")
+                    ]
+                    try:
+                        up.request_headers(
+                            st.id, fields, end_stream=st.closed_remote
+                        )
+                        actions[st.id] = ("forward", up)
+                        # log when the upstream's status is known
+                        with plock:
+                            pending_logs[st.id] = record
+                        deferred = True
+                    except OSError:
+                        actions[st.id] = ("deny", None)
+                        record["http"]["code"] = 502
+                        h2.respond(st.id, 502, body=b"")
+            if not deferred:
+                self._log_record(record)
+
+        def on_data(h2, st, chunk, end) -> None:
+            action, up = actions.get(st.id, (None, None))
+            if action == "forward":
+                if up is not None and (chunk or end):
+                    try:
+                        up.send_data(st.id, chunk, end_stream=end)
+                    except OSError:
+                        pass
+                if end:
+                    actions.pop(st.id, None)
+            elif action == "terminate":
+                # body bytes are not used by the synthesized response —
+                # drop them rather than buffer (a long stream would
+                # otherwise grow memory without bound)
+                if end:
+                    h2.respond(st.id, 200, body=b"OK\n")
+                    actions.pop(st.id, None)
+            elif action == "deny" and end:
+                actions.pop(st.id, None)
+            # deny: drop the lane's bytes (client may still be sending
+            # against the window we granted before the verdict)
+
+        def on_reset(h2, st) -> None:
+            # downstream cancelled (gRPC cancellation): cancel the
+            # pinned upstream stream, log the request as cancelled
+            action, up = actions.pop(st.id, (None, None))
+            if action == "forward" and up is not None:
+                up.responses.pop(st.id, None)  # stop relaying its frames
+                try:
+                    up.send_frame(
+                        0x3, 0, st.id, struct.pack(">I", 0x8)  # CANCEL
+                    )
+                except OSError:
+                    pass
+            emit_log(st.id, 499)  # client closed request (nginx idiom)
+
+        from .http2 import PREFACE
+
+        server = H2ServerConnection(
+            conn, on_request, on_data=on_data, on_reset=on_reset
+        )
+        if not server.handshake(consumed=PREFACE):  # sniffer read it all
+            return
+        try:
+            server.serve()
+        finally:
+            up = up_holder.get("c")
+            if up is not None:
+                try:
+                    up.sock.close()
+                except OSError:
+                    pass
+            # forwarded streams whose response never arrived: log them
+            # as 502 so no request vanishes from the access log
+            with plock:
+                leftover = list(pending_logs.values())
+                pending_logs.clear()
+            for rec in leftover:
+                rec["http"]["code"] = 502
+                self._log_record(rec)
+
     @staticmethod
     def _drain(conn: socket.socket, n: int) -> bool:
         """Consume n body bytes still on the socket; False on EOF."""
@@ -260,6 +511,128 @@ class StandaloneProxy:
                 return False
             n -= len(chunk)
         return True
+
+    def _tunnel_raw(
+        self, a: socket.socket, b: socket.socket, b_carry: bytes = b""
+    ) -> None:
+        """Bidirectional byte tunnel (post-101 upgraded connections —
+        WebSocket etc. — leave HTTP framing entirely). Returns when
+        either side closes."""
+        if b_carry:
+            a.sendall(b_carry)
+
+        def pump(src, dst):
+            try:
+                src.settimeout(self.IDLE_TIMEOUT_S)
+                while True:
+                    chunk = src.recv(65536)
+                    if not chunk:
+                        break
+                    dst.sendall(chunk)
+            except OSError:
+                pass
+            finally:
+                for s in (src, dst):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        t = threading.Thread(target=pump, args=(b, a), daemon=True)
+        t.start()
+        pump(a, b)
+        t.join(timeout=5)
+
+    # chunked REQUEST bodies larger than this are rejected — they must
+    # be buffered whole to preserve the policy/pipelining guarantees.
+    # Responses are never capped: they stream through _pump_chunked.
+    CHUNKED_BODY_LIMIT = 1 << 22
+
+    @staticmethod
+    def _chunked_final(te: str) -> bool:
+        """True when the FINAL transfer coding is chunked (RFC 7230
+        §3.3.3 — only then is the body chunk-framed)."""
+        codings = [t.strip().lower() for t in te.split(",") if t.strip()]
+        return bool(codings) and codings[-1] == "chunked"
+
+    @staticmethod
+    def _pump_chunked(src: socket.socket, buf: bytes, sink, limit=None):
+        """Incrementally parse one RFC 7230 §4.1 chunked body from
+        carry+socket, passing each VALIDATED wire byte run to ``sink``
+        (the bytes re-forward as-is: size lines, data, CRLFs, trailer
+        section). → (ok, leftover). ``limit`` caps total DATA bytes
+        (None = stream unbounded — the response relay path)."""
+        total = 0
+
+        def read_line():
+            nonlocal buf
+            while True:
+                idx = buf.find(b"\r\n")
+                if idx >= 0:
+                    line, buf = buf[:idx], buf[idx + 2:]
+                    return line, True
+                if len(buf) > 16384:
+                    return None, False
+                chunk = src.recv(65536)
+                if not chunk:
+                    return None, False
+                buf += chunk
+
+        while True:
+            line, ok = read_line()
+            if not ok:
+                return False, b""
+            try:
+                size = int(line.split(b";")[0].strip(), 16)
+            except ValueError:
+                return False, b""
+            if size < 0:
+                return False, b""
+            sink(line + b"\r\n")
+            if size == 0:
+                # trailer section: header lines until the blank one
+                while True:
+                    t, ok = read_line()
+                    if not ok:
+                        return False, b""
+                    sink(t + b"\r\n")
+                    if t == b"":
+                        return True, buf
+            total += size
+            if limit is not None and total > limit:
+                return False, b""
+            remaining = size
+            while remaining > 0:
+                if not buf:
+                    buf = src.recv(min(65536, remaining))
+                    if not buf:
+                        return False, b""
+                take = min(len(buf), remaining)
+                sink(buf[:take])
+                buf = buf[take:]
+                remaining -= take
+            while len(buf) < 2:
+                chunk = src.recv(2 - len(buf))
+                if not chunk:
+                    return False, b""
+                buf += chunk
+            if buf[:2] != b"\r\n":
+                return False, b""
+            sink(b"\r\n")
+            buf = buf[2:]
+
+    @classmethod
+    def _read_chunked(cls, conn: socket.socket, buf: bytes, limit=None):
+        """Buffering wrapper over _pump_chunked (request path). →
+        (raw, leftover) or (None, None) on error/EOF/cap."""
+        parts: List[bytes] = []
+        ok, leftover = cls._pump_chunked(
+            conn, buf, parts.append,
+            limit=cls.CHUNKED_BODY_LIMIT if limit is None else limit,
+        )
+        if not ok:
+            return None, None
+        return b"".join(parts), leftover
 
     def _serve_one_http(
         self, conn: socket.socket, pol: _PortPolicy, src_identity: int,
@@ -291,11 +664,8 @@ class StandaloneProxy:
             headers=tuple(headers), src_identity=src_identity,
         )
         hdr_map = {k.lower(): v for k, v in headers}
-        if "chunked" in hdr_map.get("transfer-encoding", "").lower():
-            conn.sendall(
-                b"HTTP/1.1 501 Not Implemented\r\ncontent-length: 0\r\n\r\n"
-            )
-            return None  # unknown body framing: cannot find next request
+        te = hdr_map.get("transfer-encoding", "").strip().lower()
+        chunked = self._chunked_final(te) if te else False
         # RFC 7230: repeated Content-Length with differing values, a
         # non-numeric value, or a negative one is a framing attack
         # (CL.CL smuggling / parser desync) — reject and close, never
@@ -303,6 +673,17 @@ class StandaloneProxy:
         cl_values = {
             v.strip() for k, v in headers if k.lower() == "content-length"
         }
+        if te and not chunked:
+            # unknown final transfer coding: body framing is undefined
+            conn.sendall(
+                b"HTTP/1.1 501 Not Implemented\r\ncontent-length: 0\r\n\r\n"
+            )
+            return None
+        if chunked and cl_values:
+            # TE.CL conflict is the classic smuggling vector — RFC 7230
+            # §3.3.3 requires treating it as an error here
+            conn.sendall(b"HTTP/1.1 400 Bad Request\r\ncontent-length: 0\r\n\r\n")
+            return None
         if len(cl_values) > 1:
             conn.sendall(b"HTTP/1.1 400 Bad Request\r\ncontent-length: 0\r\n\r\n")
             return None
@@ -316,8 +697,26 @@ class StandaloneProxy:
             return None
         # split what we over-read into this request's body vs the next
         # request's head (pipelining); drain any body still in flight
-        body_pending = max(0, content_length - len(body_rest))
-        leftover = body_rest[content_length:] if content_length < len(body_rest) else b""
+        if chunked:
+            # buffer the whole chunked body up front: its extent is
+            # only knowable by parsing, and both the deny path and the
+            # pipelining guarantee need the exact boundary
+            raw_body, leftover = self._read_chunked(conn, body_rest)
+            if raw_body is None:
+                conn.sendall(
+                    b"HTTP/1.1 400 Bad Request\r\ncontent-length: 0\r\n\r\n"
+                )
+                return None
+            body_pending = 0
+            this_body = raw_body
+        else:
+            body_pending = max(0, content_length - len(body_rest))
+            leftover = (
+                body_rest[content_length:]
+                if content_length < len(body_rest)
+                else b""
+            )
+            this_body = body_rest[:content_length]
         wants_close = "close" in hdr_map.get("connection", "").lower()
         allowed = pol.http is None or bool(pol.http.check(req))
         code = 200 if allowed else 403
@@ -326,15 +725,14 @@ class StandaloneProxy:
                 # forward ONLY this request's bytes: the over-read tail
                 # may hold a pipelined next request that must be
                 # policy-checked here, never smuggled upstream
-                this_request = (
-                    head_text + b"\r\n\r\n" + body_rest[:content_length]
+                this_request = head_text + b"\r\n\r\n" + this_body
+                code, reusable = self._forward_http(
+                    conn, this_request, body_pending, method,
                 )
-                code = self._forward_http(
-                    conn, this_request, body_pending, pol
-                )
-                leftover = None  # upstream response framing is opaque:
-                # we stream it until close, so the connection cannot be
-                # reused afterwards (pipelined tail is dropped unserved)
+                if not reusable:
+                    leftover = None
+                else:
+                    conn.settimeout(self.IDLE_TIMEOUT_S)
             else:
                 if not self._drain(conn, body_pending):
                     return None
@@ -363,30 +761,87 @@ class StandaloneProxy:
         return None if wants_close else leftover
 
     def _forward_http(
-        self, conn: socket.socket, head: bytes, body_pending: int,
-        pol: _PortPolicy,
-    ) -> int:
+        self, conn: socket.socket, request_bytes: bytes, body_pending: int,
+        method: str,
+    ) -> Tuple[int, bool]:
         """Relay the buffered request (plus any request body still in
-        flight from the client) to the upstream, stream the reply
-        back. Returns the upstream status code (best effort)."""
+        flight from the client) to the upstream, then relay the reply
+        honoring ITS OWN framing (Content-Length / chunked / 204/304 /
+        until-close). → (status code, downstream_reusable): parsing the
+        response's extent is what lets the keep-alive connection — and
+        any pipelined tail — survive a forwarded request."""
         assert self.upstream is not None
-        code = 502
         try:
             up = socket.create_connection(self.upstream, timeout=5.0)
         except OSError:
             conn.sendall(b"HTTP/1.1 502 Bad Gateway\r\ncontent-length: 0\r\n\r\n")
-            return code
+            # body_pending request bytes are still inbound — drain them
+            # or the next head-parse reads body as a "request" (desync)
+            reusable = self._drain(conn, body_pending)
+            return 502, reusable
         try:
-            up.sendall(head)
+            up.sendall(request_bytes)
             conn.settimeout(5.0)
             while body_pending > 0:
                 chunk = conn.recv(min(65536, body_pending))
                 if not chunk:
-                    break
+                    return 502, False
                 up.sendall(chunk)
                 body_pending -= len(chunk)
-            up.settimeout(5.0)
-            first = True
+            up.settimeout(self.IDLE_TIMEOUT_S)
+            carry = b""
+            while True:  # 1xx interim responses precede the final one
+                rhead = _read_http_head(up, carry)
+                if rhead is None:
+                    conn.sendall(
+                        b"HTTP/1.1 502 Bad Gateway\r\ncontent-length: 0\r\n\r\n"
+                    )
+                    return 502, True
+                rtext, _, rbody = rhead.partition(b"\r\n\r\n")
+                rlines = rtext.decode("latin1").split("\r\n")
+                try:
+                    code = int(rlines[0].split(" ", 2)[1])
+                except (ValueError, IndexError):
+                    code = 502
+                conn.sendall(rtext + b"\r\n\r\n")  # interim heads relay too
+                if code == 101:
+                    # Switching Protocols: the connection leaves HTTP —
+                    # tunnel raw bytes both ways until either side closes
+                    self._tunnel_raw(conn, up, rbody)
+                    return 101, False
+                if not 100 <= code < 200:
+                    break
+                carry = rbody  # next head may already be buffered
+            rmap: Dict[str, str] = {}
+            for ln in rlines[1:]:
+                name, _, value = ln.partition(":")
+                rmap[name.strip().lower()] = value.strip()
+            reusable = "close" not in rmap.get("connection", "").lower()
+            if method == "HEAD" or code in (204, 304):
+                return code, reusable
+            rte = rmap.get("transfer-encoding", "").strip().lower()
+            if self._chunked_final(rte):
+                # stream chunk-by-chunk (no size cap on responses)
+                ok, _left = self._pump_chunked(up, rbody, conn.sendall)
+                if not ok:
+                    return code, False  # upstream framing broke mid-body
+                return code, reusable
+            if "content-length" in rmap:
+                try:
+                    cl = int(rmap["content-length"])
+                except ValueError:
+                    return code, False
+                conn.sendall(rbody[:cl])
+                remaining = cl - len(rbody)
+                while remaining > 0:
+                    chunk = up.recv(min(65536, remaining))
+                    if not chunk:
+                        return code, False
+                    conn.sendall(chunk)
+                    remaining -= len(chunk)
+                return code, reusable
+            # no framing header: body extends to upstream close
+            conn.sendall(rbody)
             while True:
                 try:
                     chunk = up.recv(65536)
@@ -394,16 +849,10 @@ class StandaloneProxy:
                     break
                 if not chunk:
                     break
-                if first:
-                    try:
-                        code = int(chunk.split(b" ", 2)[1])
-                    except (ValueError, IndexError):
-                        pass
-                    first = False
                 conn.sendall(chunk)
+            return code, False
         finally:
             up.close()
-        return code
 
     def _serve_kafka(
         self, conn: socket.socket, pol: _PortPolicy, src_identity: int

@@ -138,10 +138,16 @@ class TestExternalProcess:
         )
         try:
             assert proc.stdout.readline().strip() == "READY"
+            # Each request's record is awaited before the next request:
+            # the assertion below is about per-request content, and
+            # records from concurrent connections have no defined order
+            # (each connection thread logs after its response is sent).
             # allowed: client identity + allowed path
             assert _http_get(proxy_port, "/public/index") == 200
+            assert _wait_for(lambda: len(sink.recent()) >= 1, timeout=30)
             # denied path → 403 from the OTHER process
             assert _http_get(proxy_port, "/secret") == 403
+            assert _wait_for(lambda: len(sink.recent()) >= 2, timeout=30)
             # denied identity (unmapped 127.0.0.2 → world) → 403
             assert _http_get(proxy_port, "/public/index", source="127.0.0.2") == 403
             # access logs crossed the process boundary
