@@ -517,10 +517,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.k8s_token_file:
                 with open(args.k8s_token_file) as f:
                     token = f.read().strip()
-            informer = Informer(
-                APIServerClient(args.k8s_api, token=token),
-                K8sWatcher(daemon),
-            ).start()
+            api = APIServerClient(args.k8s_api, token=token)
+            watcher = K8sWatcher(daemon)
+            # writeback wiring: CNP status acks, Ingress LB status,
+            # node CIDR annotations (pkg/k8s/client.go AnnotateNode)
+            watcher.status_client = api
+            watcher.node_name = args.node_name or ""
+            if args.node_ip:
+                daemon.services.host_ip = args.node_ip  # Ingress frontends
+            try:
+                # register the CNP CRD before watching it
+                # (pkg/k8s/apis/cilium.io/v2/register.go)
+                api.ensure_cnp_crd()
+            except Exception as e:
+                print(f"WARNING: CNP CRD registration failed: {e}")
+            informer = Informer(api, watcher).start()
             # the reference blocks on cache sync before serving
             # (daemon/main.go:843-856); an unsynced start is loudly
             # flagged rather than silently serving empty k8s state

@@ -35,7 +35,7 @@ from ..utils.logging import get_logger
 
 log = get_logger("k8s-client")
 
-# resource kind → (API path prefix, namespaced)
+# resource kind → collection path (all-namespaces LIST/WATCH form)
 RESOURCES: Dict[str, str] = {
     "NetworkPolicy": "apis/networking.k8s.io/v1/networkpolicies",
     "CiliumNetworkPolicy": "apis/cilium.io/v2/ciliumnetworkpolicies",
@@ -43,6 +43,38 @@ RESOURCES: Dict[str, str] = {
     "Endpoints": "api/v1/endpoints",
     "Pod": "api/v1/pods",
     "Namespace": "api/v1/namespaces",
+    "Ingress": "apis/extensions/v1beta1/ingresses",
+    "Node": "api/v1/nodes",
+}
+
+# kind → (group-version path, plural, namespaced) for per-OBJECT writes
+# (status subresources, annotation patches — collection paths above
+# cannot address a single namespaced object)
+_OBJECT_PATHS: Dict[str, Tuple[str, str, bool]] = {
+    "CiliumNetworkPolicy": ("apis/cilium.io/v2", "ciliumnetworkpolicies", True),
+    "Ingress": ("apis/extensions/v1beta1", "ingresses", True),
+    "Node": ("api/v1", "nodes", False),
+    "Pod": ("api/v1", "pods", True),
+}
+
+# The CNP CustomResourceDefinition the reference registers at startup
+# (pkg/k8s/apis/cilium.io/v2/register.go createCustomResourceDefinitions)
+CNP_CRD: Dict = {
+    "apiVersion": "apiextensions.k8s.io/v1beta1",
+    "kind": "CustomResourceDefinition",
+    "metadata": {"name": "ciliumnetworkpolicies.cilium.io"},
+    "spec": {
+        "group": "cilium.io",
+        "version": "v2",
+        "scope": "Namespaced",
+        "names": {
+            "plural": "ciliumnetworkpolicies",
+            "singular": "ciliumnetworkpolicy",
+            "kind": "CiliumNetworkPolicy",
+            "shortNames": ["cnp", "ciliumnp"],
+        },
+        "subresources": {"status": {}},
+    },
 }
 
 
@@ -81,6 +113,68 @@ class APIServerClient:
             if stream
             else self.timeout,
         )
+
+    # -- writes ---------------------------------------------------------
+    def _object_path(self, kind: str, namespace: str, name: str) -> str:
+        gv, plural, namespaced = _OBJECT_PATHS[kind]
+        if namespaced:
+            return f"{gv}/namespaces/{namespace or 'default'}/{plural}/{name}"
+        return f"{gv}/{plural}/{name}"
+
+    def _request(
+        self, method: str, path: str, body: Optional[Dict] = None,
+        content_type: str = "application/json",
+    ) -> Dict:
+        url = f"{self.base_url}/{path}"
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(url, data=data, method=method)
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            raw = resp.read()
+        return json.loads(raw.decode()) if raw else {}
+
+    def update_status(
+        self, kind: str, namespace: str, name: str, obj: Dict
+    ) -> Dict:
+        """PUT the object's /status subresource (the CNP per-node
+        status ack and Ingress loadBalancer status writeback paths —
+        daemon/k8s_watcher.go:1240 UpdateStatus)."""
+        path = self._object_path(kind, namespace, name) + "/status"
+        return self._request("PUT", path, obj)
+
+    def patch_annotations(
+        self, kind: str, namespace: str, name: str, annotations: Dict[str, str]
+    ) -> Dict:
+        """Merge-patch metadata.annotations (pkg/k8s/client.go
+        AnnotateNode — CIDR/health-IP writeback)."""
+        path = self._object_path(kind, namespace, name)
+        return self._request(
+            "PATCH", path,
+            {"metadata": {"annotations": dict(annotations)}},
+            content_type="application/merge-patch+json",
+        )
+
+    def ensure_cnp_crd(self) -> bool:
+        """Register the CNP CRD if the apiserver doesn't have it yet
+        (pkg/k8s/apis/cilium.io/v2/register.go). → True if it exists
+        or was created."""
+        base = "apis/apiextensions.k8s.io/v1beta1/customresourcedefinitions"
+        try:
+            self._request("GET", f"{base}/{CNP_CRD['metadata']['name']}")
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+        try:
+            self._request("POST", base, CNP_CRD)
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 409:  # someone else registered it concurrently
+                return True
+            raise
 
     def list(self, kind: str) -> Tuple[List[Dict], str]:
         """LIST one kind → (objects with kind injected, resourceVersion)."""

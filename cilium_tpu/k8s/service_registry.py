@@ -62,6 +62,17 @@ class ServiceEndpoint:
     ports: Dict[str, ServicePort] = dataclasses.field(default_factory=dict)
 
 
+@dataclasses.dataclass
+class IngressInfo:
+    """Single-service Ingress backend (daemon/k8s_watcher.go:1181
+    addIngressV1beta1 — the reference supports exactly this shape:
+    spec.backend.{serviceName, servicePort})."""
+
+    service_name: str
+    service_port: int  # the frontend port number (IntValue of the spec)
+    port_name: str = ""  # named servicePort, "" when numeric
+
+
 class ServiceRegistry:
     """Thread-safe cache of Service + Endpoints objects, with observers
     so policy translation and LB programming react to churn."""
@@ -70,6 +81,8 @@ class ServiceRegistry:
         self._lock = threading.RLock()
         self.services: Dict[ServiceID, ServiceInfo] = {}
         self.endpoints: Dict[ServiceID, ServiceEndpoint] = {}
+        # keyed by the INGRESS object's own (namespace, name)
+        self.ingresses: Dict[ServiceID, IngressInfo] = {}
         self._observers: List = []  # callables (event, ServiceID)
 
     # -- mutation ------------------------------------------------------
@@ -142,6 +155,42 @@ class ServiceRegistry:
             sid, ServiceEndpoint(backend_ips=tuple(dict.fromkeys(ips)), ports=ports)
         )
         return sid
+
+    def apply_ingress_object(self, obj: dict) -> Optional[ServiceID]:
+        """Decode a v1beta1 Ingress dict. Only the single-service shape
+        (spec.backend) is supported — same restriction as the reference
+        (k8s_watcher.go:1188 'Single Service Ingress'). → the ingress's
+        own id, or None when the shape is unsupported."""
+        meta = obj.get("metadata") or {}
+        spec = obj.get("spec") or {}
+        backend = spec.get("backend")
+        if not backend or not backend.get("serviceName"):
+            return None
+        iid = ServiceID(meta.get("namespace") or "default", meta.get("name", ""))
+        raw_port = backend.get("servicePort", 0)
+        try:
+            port_int = int(raw_port)
+            port_name = ""
+        except (TypeError, ValueError):
+            port_int = 0
+            port_name = str(raw_port)
+        with self._lock:
+            self.ingresses[iid] = IngressInfo(
+                service_name=backend["serviceName"],
+                service_port=port_int,
+                port_name=port_name,
+            )
+        self._notify("ingress-upsert", iid)
+        return iid
+
+    def delete_ingress(self, iid: ServiceID) -> None:
+        with self._lock:
+            self.ingresses.pop(iid, None)
+        self._notify("ingress-delete", iid)
+
+    def known_ingress_ids(self) -> List[ServiceID]:
+        with self._lock:
+            return sorted(self.ingresses, key=lambda s: (s.namespace, s.name))
 
     # -- queries -------------------------------------------------------
     def get(self, sid: ServiceID) -> Tuple[Optional[ServiceInfo], Optional[ServiceEndpoint]]:

@@ -143,12 +143,15 @@ class ServiceManager:
     frontend's value key); standalone it is a local counter.
     """
 
-    def __init__(self, kvstore=None) -> None:
+    def __init__(self, kvstore=None, host_ip: str = "") -> None:
         self._lock = threading.RLock()
         self._services: Dict[L3n4Addr, LBService] = {}
         self._next_id = 1
         self._kv = kvstore
         self.version = 0
+        # node host address — the Ingress frontend IP (the reference
+        # uses Config.HostV4Addr, k8s_watcher.go:1209)
+        self.host_ip = host_ip
         self._synced_frontends: set = set()  # frontends owned by k8s sync
         # (frontend, remote_cluster) → backends merged in via
         # clustermesh (the global-service merge; remote_cluster.go)
@@ -275,12 +278,16 @@ class ServiceManager:
         """Full resync from a k8s ServiceRegistry: every ClusterIP
         service port becomes a frontend; backends come from the
         Endpoints object's matching port name (daemon/k8s_watcher.go
-        addK8sSVCs). Frontends previously created by sync but gone from
+        addK8sSVCs). Ingress objects add a frontend on the node's host
+        address pointing at the named service's backends
+        (k8s_watcher.go:1181 addIngressV1beta1 — requires ``host_ip``
+        to be set). Frontends previously created by sync but gone from
         the registry are deleted. Returns the live frontend count."""
         desired: Dict[L3n4Addr, List[Backend]] = {}
         with registry._lock:
             services = dict(registry.services)
             endpoints = dict(registry.endpoints)
+            ingresses = dict(getattr(registry, "ingresses", {}))
         for sid, info in services.items():
             if not info.cluster_ip or info.is_headless:
                 continue
@@ -293,6 +300,25 @@ class ServiceManager:
                     if tgt is not None:
                         backs = [Backend(ip, tgt.port) for ip in ep.backend_ips]
                 desired[fe] = backs
+        if self.host_ip:
+            for iid, ing in ingresses.items():
+                svc_id = type(iid)(iid.namespace, ing.service_name)
+                ep = endpoints.get(svc_id)
+                backs = []
+                fe_port = ing.service_port
+                if ep is not None:
+                    tgt = (
+                        ep.ports.get(ing.port_name)
+                        or ep.ports.get(str(ing.service_port))
+                    )
+                    if tgt is None and len(ep.ports) == 1:
+                        tgt = next(iter(ep.ports.values()))
+                    if tgt is not None:
+                        backs = [Backend(ip, tgt.port) for ip in ep.backend_ips]
+                        if not fe_port:  # named servicePort: number from
+                            fe_port = tgt.port  # the endpoints mapping
+                if fe_port:
+                    desired[L3n4Addr(self.host_ip, fe_port, "TCP")] = backs
         with self._lock:
             for fe in self._synced_frontends - set(desired):
                 self.delete(fe)

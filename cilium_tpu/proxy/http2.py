@@ -84,8 +84,17 @@ def pack_frame(ftype: int, flags: int, stream_id: int, payload: bytes = b"") -> 
     )
 
 
+# what we advertise (and therefore must enforce): RFC 7540 §4.2 — a
+# frame above SETTINGS_MAX_FRAME_SIZE is a FRAME_SIZE_ERROR, and
+# accepting 16MB frames from an unauthenticated peer is a memory DoS
+MAX_FRAME_SIZE = 16384
+# header blocks (HEADERS + CONTINUATIONs) are capped too — the 2024
+# CONTINUATION-flood pattern grows the block forever otherwise
+MAX_HEADER_BLOCK = 1 << 17
+
+
 def read_frame(
-    sock: socket.socket, max_frame: int = 1 << 24
+    sock: socket.socket, max_frame: int = MAX_FRAME_SIZE
 ) -> Optional[Tuple[int, int, int, bytes]]:
     """→ (type, flags, stream_id, payload) or None on EOF."""
     hdr = recv_exact(sock, 9)
@@ -96,11 +105,19 @@ def read_frame(
     (stream_id,) = struct.unpack(">I", hdr[5:9])
     stream_id &= 0x7FFFFFFF
     if length > max_frame:
-        raise H2Error("frame exceeds max size")
+        raise H2Error("frame exceeds max size", code=0x6)  # FRAME_SIZE
     payload = b"" if length == 0 else recv_exact(sock, length)
     if length and payload is None:
         return None
     return ftype, flags, stream_id, payload
+
+
+def _expect_len(payload: bytes, n: int) -> None:
+    """Fixed-size frame payloads (PING/RST_STREAM/WINDOW_UPDATE) must
+    be exactly n bytes — RFC 7540 FRAME_SIZE_ERROR otherwise (and a
+    malformed length must never surface as struct.error)."""
+    if len(payload) != n:
+        raise H2Error("bad frame length", code=0x6)
 
 
 def _strip_padding(flags: int, payload: bytes) -> bytes:
@@ -209,7 +226,17 @@ class _ConnBase:
             self._local_end(sid)
 
     def _local_end(self, sid: int) -> None:
-        """Hook: we sent END_STREAM on sid (stream pruning)."""
+        """Hook: we sent END_STREAM on sid. Subclasses prune their
+        stream maps on top; the base drops the send-window entry (we
+        will never send on this stream again)."""
+        with self._window_cv:
+            self.stream_send_windows.pop(sid, None)
+
+    def _stream_known(self, sid: int) -> bool:
+        """Whether sid is a live stream — credits for unknown ids are
+        dropped so a peer can't grow stream_send_windows unboundedly
+        with WINDOW_UPDATEs for streams that never existed."""
+        return True
 
     # -- flow-controlled DATA send -------------------------------------
     def send_data(self, sid: int, data: bytes, end_stream: bool) -> None:
@@ -244,7 +271,7 @@ class _ConnBase:
         with self._window_cv:
             if sid == 0:
                 self.send_window += amount
-            else:
+            elif self._stream_known(sid):
                 self.stream_send_windows[sid] = (
                     self.stream_send_windows.get(sid, self.peer_initial_window)
                     + amount
@@ -310,6 +337,7 @@ class H2ServerConnection(_ConnBase):
         self._local_done: set = set()
 
     def _local_end(self, sid: int) -> None:
+        super()._local_end(sid)
         st = self.streams.get(sid)
         if st is not None and st.closed_remote:
             self.streams.pop(sid, None)
@@ -320,6 +348,9 @@ class H2ServerConnection(_ConnBase):
         if sid in self._local_done:
             self._local_done.discard(sid)
             self.streams.pop(sid, None)
+
+    def _stream_known(self, sid: int) -> bool:
+        return sid in self.streams or sid in self._local_done
 
     # -- handshake ------------------------------------------------------
     def handshake(self, consumed: bytes = b"") -> bool:
@@ -409,7 +440,7 @@ class H2ServerConnection(_ConnBase):
                 self.goaway(e.code)
             except OSError:
                 pass
-        except OSError:
+        except (OSError, struct.error):
             pass
         finally:
             self.close()
@@ -449,12 +480,14 @@ class H2ServerConnection(_ConnBase):
             self.send_frame(FRAME_SETTINGS, FLAG_ACK, 0)
             return True
         if ftype == FRAME_PING:
+            _expect_len(payload, 8)
             if not flags & FLAG_ACK:
                 self.send_frame(FRAME_PING, FLAG_ACK, 0, payload)
             return True
         if ftype == FRAME_GOAWAY:
             return False
         if ftype == FRAME_WINDOW_UPDATE:
+            _expect_len(payload, 4)
             (inc,) = struct.unpack(">I", payload)
             self._credit(sid, inc & 0x7FFFFFFF)
             return True
@@ -488,6 +521,9 @@ class H2ServerConnection(_ConnBase):
             if sid != self._headers_sid:
                 raise H2Error("CONTINUATION on wrong stream")
             self._headers_buf += payload
+            if len(self._headers_buf) > MAX_HEADER_BLOCK:
+                # CONTINUATION flood: the block must not grow forever
+                raise H2Error("header block too large", code=0xB)
             if flags & FLAG_END_HEADERS:
                 self._headers_complete(sid, self._headers_end_stream)
             return True
@@ -531,6 +567,7 @@ class H2ServerConnection(_ConnBase):
                 self._remote_end(sid)
             return True
         if ftype == FRAME_RST_STREAM:
+            _expect_len(payload, 4)
             st = self.streams.pop(sid, None)
             self._local_done.discard(sid)
             if st is not None:
@@ -563,6 +600,7 @@ class H2ClientConnection(_ConnBase):
         self._local_done: set = set()
 
     def _local_end(self, sid: int) -> None:
+        super()._local_end(sid)
         st = self.responses.get(sid)
         if st is not None and st.closed_remote:
             self.responses.pop(sid, None)
@@ -573,6 +611,9 @@ class H2ClientConnection(_ConnBase):
         if sid in self._local_done:
             self._local_done.discard(sid)
             self.responses.pop(sid, None)
+
+    def _stream_known(self, sid: int) -> bool:
+        return sid in self.responses or sid in self._local_done
 
     def handshake(self) -> None:
         self.send(
@@ -611,7 +652,7 @@ class H2ClientConnection(_ConnBase):
                     return
                 if not self._handle(fr):
                     return
-        except (H2Error, HpackError, OSError):
+        except (H2Error, HpackError, OSError, struct.error):
             pass
         finally:
             self.close()
@@ -657,12 +698,14 @@ class H2ClientConnection(_ConnBase):
                 self.send_frame(FRAME_SETTINGS, FLAG_ACK, 0)
             return True
         if ftype == FRAME_PING:
+            _expect_len(payload, 8)
             if not flags & FLAG_ACK:
                 self.send_frame(FRAME_PING, FLAG_ACK, 0, payload)
             return True
         if ftype == FRAME_GOAWAY:
             return False
         if ftype == FRAME_WINDOW_UPDATE:
+            _expect_len(payload, 4)
             (inc,) = struct.unpack(">I", payload)
             self._credit(sid, inc & 0x7FFFFFFF)
             return True
@@ -681,6 +724,8 @@ class H2ClientConnection(_ConnBase):
             return True
         if ftype == FRAME_CONTINUATION:
             self._headers_buf += payload
+            if len(self._headers_buf) > MAX_HEADER_BLOCK:
+                raise H2Error("header block too large", code=0xB)
             if flags & FLAG_END_HEADERS:
                 self._headers_complete(sid, self._headers_end_stream)
             return True
@@ -708,6 +753,7 @@ class H2ClientConnection(_ConnBase):
                     self._remote_end(sid)
             return True
         if ftype == FRAME_RST_STREAM:
+            _expect_len(payload, 4)
             st = self.responses.pop(sid, None)
             self._local_done.discard(sid)
             if st is not None:

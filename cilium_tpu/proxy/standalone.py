@@ -366,8 +366,7 @@ class StandaloneProxy:
             # apply to the NEXT stream (same rule as the h1 path)
             p = self._policy(port)
             if p is None:
-                h2.reset(st.id)
-                actions[st.id] = ("deny", None)
+                h2.reset(st.id)  # prunes the stream; late DATA is dropped
                 return
             req = HTTPRequest(
                 method=st.method, path=st.path, host=st.authority,
@@ -391,7 +390,8 @@ class StandaloneProxy:
             }
             deferred = False
             if not allowed:
-                actions[st.id] = ("deny", None)
+                if not st.closed_remote:  # DATA may still arrive: drop it
+                    actions[st.id] = ("deny", None)
                 if st.is_grpc:
                     record["http"]["code"] = 200  # denial rides grpc-status
                     h2.respond_grpc_status(
@@ -408,7 +408,8 @@ class StandaloneProxy:
             else:
                 up = upstream_conn(h2)
                 if up is None:
-                    actions[st.id] = ("deny", None)
+                    if not st.closed_remote:
+                        actions[st.id] = ("deny", None)
                     record["http"]["code"] = 502
                     h2.respond(st.id, 502, body=b"")
                 else:
@@ -429,13 +430,14 @@ class StandaloneProxy:
                         up.request_headers(
                             st.id, fields, end_stream=st.closed_remote
                         )
-                        actions[st.id] = ("forward", up)
+                        if not st.closed_remote:  # body still to relay
+                            actions[st.id] = ("forward", up)
                         # log when the upstream's status is known
                         with plock:
                             pending_logs[st.id] = record
                         deferred = True
                     except OSError:
-                        actions[st.id] = ("deny", None)
+                        actions.pop(st.id, None)
                         record["http"]["code"] = 502
                         h2.respond(st.id, 502, body=b"")
             if not deferred:
@@ -560,9 +562,24 @@ class StandaloneProxy:
         """Incrementally parse one RFC 7230 §4.1 chunked body from
         carry+socket, passing each VALIDATED wire byte run to ``sink``
         (the bytes re-forward as-is: size lines, data, CRLFs, trailer
-        section). → (ok, leftover). ``limit`` caps total DATA bytes
-        (None = stream unbounded — the response relay path)."""
+        section). → (ok, leftover). ``limit`` caps total WIRE bytes —
+        data, chunk-extension lines, AND trailers all count, so neither
+        oversized extensions nor an endless trailer section can grow
+        memory past the cap (None = stream unbounded — the response
+        relay path, which forwards instead of buffering)."""
         total = 0
+
+        class _Overflow(Exception):
+            pass
+
+        raw_sink = sink
+
+        def sink(b):  # noqa: F811 - deliberate wrap
+            nonlocal total
+            total += len(b)
+            if limit is not None and total > limit:
+                raise _Overflow
+            raw_sink(b)
 
         def read_line():
             nonlocal buf
@@ -578,48 +595,48 @@ class StandaloneProxy:
                     return None, False
                 buf += chunk
 
-        while True:
-            line, ok = read_line()
-            if not ok:
-                return False, b""
-            try:
-                size = int(line.split(b";")[0].strip(), 16)
-            except ValueError:
-                return False, b""
-            if size < 0:
-                return False, b""
-            sink(line + b"\r\n")
-            if size == 0:
-                # trailer section: header lines until the blank one
-                while True:
-                    t, ok = read_line()
-                    if not ok:
-                        return False, b""
-                    sink(t + b"\r\n")
-                    if t == b"":
-                        return True, buf
-            total += size
-            if limit is not None and total > limit:
-                return False, b""
-            remaining = size
-            while remaining > 0:
-                if not buf:
-                    buf = src.recv(min(65536, remaining))
-                    if not buf:
-                        return False, b""
-                take = min(len(buf), remaining)
-                sink(buf[:take])
-                buf = buf[take:]
-                remaining -= take
-            while len(buf) < 2:
-                chunk = src.recv(2 - len(buf))
-                if not chunk:
+        try:
+            while True:
+                line, ok = read_line()
+                if not ok:
                     return False, b""
-                buf += chunk
-            if buf[:2] != b"\r\n":
-                return False, b""
-            sink(b"\r\n")
-            buf = buf[2:]
+                try:
+                    size = int(line.split(b";")[0].strip(), 16)
+                except ValueError:
+                    return False, b""
+                if size < 0:
+                    return False, b""
+                sink(line + b"\r\n")
+                if size == 0:
+                    # trailer section: header lines until the blank one
+                    while True:
+                        t, ok = read_line()
+                        if not ok:
+                            return False, b""
+                        sink(t + b"\r\n")
+                        if t == b"":
+                            return True, buf
+                remaining = size
+                while remaining > 0:
+                    if not buf:
+                        buf = src.recv(min(65536, remaining))
+                        if not buf:
+                            return False, b""
+                    take = min(len(buf), remaining)
+                    sink(buf[:take])
+                    buf = buf[take:]
+                    remaining -= take
+                while len(buf) < 2:
+                    chunk = src.recv(2 - len(buf))
+                    if not chunk:
+                        return False, b""
+                    buf += chunk
+                if buf[:2] != b"\r\n":
+                    return False, b""
+                sink(b"\r\n")
+                buf = buf[2:]
+        except _Overflow:
+            return False, b""
 
     @classmethod
     def _read_chunked(cls, conn: socket.socket, buf: bytes, limit=None):

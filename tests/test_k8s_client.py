@@ -41,6 +41,12 @@ class FakeAPIServer:
         self.abort_next: set = set()  # kinds whose NEXT watch dies mid-frame
         self.list_count = 0  # how many LIST requests ever served
 
+        # write-side capture (status subresources, annotation patches,
+        # CRD registrations) for the writeback-path tests
+        self.status_writes = []  # (kind, ns, name, object)
+        self.annotation_patches = []  # (kind, name, annotations)
+        self.crds = {}  # name → object
+
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -51,10 +57,20 @@ class FakeAPIServer:
                 from urllib.parse import parse_qs, urlsplit
 
                 parts = urlsplit(self.path)
+                path = parts.path.lstrip("/")
                 q = parse_qs(parts.query)
+                if path.startswith(
+                    "apis/apiextensions.k8s.io/v1beta1/customresourcedefinitions/"
+                ):
+                    name = path.rsplit("/", 1)[1]
+                    if name in outer.crds:
+                        outer._json(self, 200, outer.crds[name])
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                    return
                 kind = next(
-                    (k for k, p in RESOURCES.items()
-                     if parts.path.lstrip("/") == p),
+                    (k for k, p in RESOURCES.items() if path == p),
                     None,
                 )
                 if kind is None:
@@ -66,6 +82,49 @@ class FakeAPIServer:
                 else:
                     outer._serve_list(self, kind)
 
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                return json.loads(self.rfile.read(n).decode()) if n else {}
+
+            def do_POST(self):
+                path = self.path.lstrip("/")
+                if path == "apis/apiextensions.k8s.io/v1beta1/customresourcedefinitions":
+                    obj = self._body()
+                    outer.crds[obj["metadata"]["name"]] = obj
+                    outer._json(self, 201, obj)
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+            def do_PUT(self):
+                # .../namespaces/{ns}/{plural}/{name}/status or
+                # .../{plural}/{name}/status (cluster-scoped)
+                parts = self.path.lstrip("/").split("/")
+                if parts[-1] != "status":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                obj = self._body()
+                name = parts[-2]
+                ns = ""
+                if "namespaces" in parts:
+                    ns = parts[parts.index("namespaces") + 1]
+                outer.status_writes.append(
+                    (obj.get("kind", parts[-3]), ns, name, obj)
+                )
+                outer._json(self, 200, obj)
+
+            def do_PATCH(self):
+                parts = self.path.lstrip("/").split("/")
+                body = self._body()
+                annotations = (body.get("metadata") or {}).get(
+                    "annotations"
+                ) or {}
+                outer.annotation_patches.append(
+                    (parts[-2], parts[-1], annotations)
+                )
+                outer._json(self, 200, body)
+
         self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]
@@ -76,6 +135,15 @@ class FakeAPIServer:
         return f"http://127.0.0.1:{self.port}"
 
     # -- protocol -------------------------------------------------------
+    @staticmethod
+    def _json(h, code, obj):
+        body = json.dumps(obj).encode()
+        h.send_response(code)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+
     def _serve_list(self, h, kind):
         with self.lock:
             self.list_count += 1

@@ -1,0 +1,216 @@
+"""k8s parity surfaces added in r5: Ingress→LB translation, the Node
+watcher, CNP status acks, node CIDR annotations, and CNP CRD
+registration — each driven end-to-end through the wire-protocol fake
+apiserver of test_k8s_client.py.
+
+Reference anchors: daemon/k8s_watcher.go:1181 (addIngressV1beta1),
+daemon/k8s_watcher.go node informer + pkg/k8s/client.go AnnotateNode,
+pkg/k8s/apis/cilium.io/v2/register.go (CRD + CNP status).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.k8s import K8sWatcher
+from cilium_tpu.k8s.client import APIServerClient, Informer
+from cilium_tpu.lb.service import L3n4Addr
+
+from test_k8s_client import FakeAPIServer, _cnp, _wait
+
+HOST_IP = "192.168.40.1"
+
+
+def _ingress(name, svc, port, ns="shop"):
+    return {
+        "kind": "Ingress",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"backend": {"serviceName": svc, "servicePort": port}},
+    }
+
+
+def _service(name, cluster_ip, port, ns="shop"):
+    return {
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "clusterIP": cluster_ip,
+            "selector": {"app": name},
+            "ports": [{"name": "web", "port": port, "protocol": "TCP"}],
+        },
+    }
+
+
+def _endpoints(name, ips, port, ns="shop"):
+    return {
+        "kind": "Endpoints",
+        "metadata": {"name": name, "namespace": ns},
+        "subsets": [{
+            "addresses": [{"ip": ip} for ip in ips],
+            "ports": [{"name": "web", "port": port, "protocol": "TCP"}],
+        }],
+    }
+
+
+def _node(name, pod_cidr, internal_ip):
+    return {
+        "kind": "Node",
+        "metadata": {"name": name},
+        "spec": {"podCIDR": pod_cidr},
+        "status": {
+            "addresses": [{"type": "InternalIP", "address": internal_ip}]
+        },
+    }
+
+
+@pytest.fixture
+def world(tmp_path):
+    api = FakeAPIServer()
+    d = Daemon(state_dir=str(tmp_path / "state"))
+    d.services.host_ip = HOST_IP
+    w = K8sWatcher(d)
+    w.status_client = APIServerClient(api.url)
+    w.node_name = "node-1"
+    yield api, d, w
+    api.drop_watches.set()
+    api.stop()
+
+
+class TestIngressToLB:
+    def test_ingress_creates_host_frontend(self, world):
+        """Ingress + Service + Endpoints → an LB frontend on the node
+        host IP whose backends are the service's endpoints
+        (k8s_watcher.go:1181 addIngressV1beta1 → syncExternalLB)."""
+        api, d, w = world
+        api.put("Service", _service("web", "10.96.0.10", 80))
+        api.put("Endpoints", _endpoints("web", ["10.1.0.5", "10.1.0.6"], 8080))
+        api.put("Ingress", _ingress("web-ing", "web", 80))
+        inf = Informer(APIServerClient(api.url), w).start()
+        try:
+            assert inf.wait_synced()
+            fe = L3n4Addr(HOST_IP, 80, "TCP")
+            assert _wait(lambda: d.services.get(fe) is not None)
+            svc = d.services.get(fe)
+            assert sorted(b.ip for b in svc.backends) == [
+                "10.1.0.5", "10.1.0.6"
+            ]
+            # the ClusterIP frontend exists too (plain service path)
+            assert d.services.get(L3n4Addr("10.96.0.10", 80, "TCP")) is not None
+            # ingress status writeback carries the host address
+            assert _wait(lambda: any(
+                k == "Ingress" and o["status"]["loadBalancer"]["ingress"][0]["ip"] == HOST_IP
+                for k, _ns, _n, o in api.status_writes
+            ))
+        finally:
+            inf.stop()
+
+    def test_ingress_delete_removes_frontend(self, world):
+        api, d, w = world
+        api.put("Service", _service("web", "10.96.0.10", 80))
+        api.put("Endpoints", _endpoints("web", ["10.1.0.5"], 8080))
+        api.put("Ingress", _ingress("web-ing", "web", 80))
+        inf = Informer(APIServerClient(api.url), w).start()
+        try:
+            assert inf.wait_synced()
+            fe = L3n4Addr(HOST_IP, 80, "TCP")
+            assert _wait(lambda: d.services.get(fe) is not None)
+            api.remove("Ingress", "shop", "web-ing")
+            assert _wait(lambda: d.services.get(fe) is None)
+            # the ClusterIP frontend survives the ingress deletion
+            assert d.services.get(L3n4Addr("10.96.0.10", 80, "TCP")) is not None
+        finally:
+            inf.stop()
+
+
+class TestNodeWatcher:
+    def test_node_objects_tracked_and_annotated(self, world):
+        """Node events land in watcher.nodes (podCIDR + InternalIP);
+        OUR node gets its allocation CIDR written back as the
+        io.cilium.network.ipv4-pod-cidr annotation."""
+        api, d, w = world
+        api.put("Node", _node("node-1", "10.200.0.0/16", "192.168.40.1"))
+        api.put("Node", _node("node-2", "10.201.0.0/16", "192.168.40.2"))
+        inf = Informer(APIServerClient(api.url), w).start()
+        try:
+            assert inf.wait_synced()
+            assert _wait(lambda: len(w.nodes) == 2)
+            assert w.nodes["node-2"]["pod_cidr"] == "10.201.0.0/16"
+            assert w.nodes["node-2"]["internal_ip"] == "192.168.40.2"
+            # annotation writeback for our own node only
+            assert _wait(lambda: any(
+                name == "node-1"
+                and ann.get("io.cilium.network.ipv4-pod-cidr")
+                == str(d.ipam.net)
+                for _plural, name, ann in api.annotation_patches
+            ))
+            assert not any(
+                name == "node-2" for _p, name, _a in api.annotation_patches
+            )
+            # node deletion is reflected
+            api.remove("Node", "default", "node-2")
+            assert _wait(lambda: "node-2" not in w.nodes)
+        finally:
+            inf.stop()
+
+
+class TestCNPStatus:
+    def test_cnp_import_acks_status(self, world):
+        """A successfully imported CNP gets a per-node status entry
+        with the local policy revision (CiliumNetworkPolicyNodeStatus)."""
+        api, d, w = world
+        api.put("CiliumNetworkPolicy", _cnp("guard", "db", "web"))
+        inf = Informer(APIServerClient(api.url), w).start()
+        try:
+            assert inf.wait_synced()
+            assert _wait(lambda: any(
+                k == "CiliumNetworkPolicy" and n == "guard"
+                for k, _ns, n, _o in api.status_writes
+            ))
+            _k, ns, _n, obj = next(
+                t for t in api.status_writes
+                if t[0] == "CiliumNetworkPolicy" and t[2] == "guard"
+            )
+            assert ns == "shop"
+            entry = obj["status"]["nodes"]["node-1"]
+            assert entry["ok"] is True and entry["enforcing"] is True
+            assert entry["localPolicyRevision"] >= 1
+        finally:
+            inf.stop()
+
+    def test_malformed_cnp_acks_error(self, world):
+        api, d, w = world
+        bad = {
+            "kind": "CiliumNetworkPolicy",
+            "metadata": {"name": "broken", "namespace": "shop"},
+            "spec": {"endpointSelector": {"matchLabels": {"app": "x"}},
+                     "ingress": [{"toPorts": [{"ports": [
+                         {"port": "not-a-port", "protocol": "TCP"}
+                     ]}]}]},
+        }
+        api.put("CiliumNetworkPolicy", bad)
+        inf = Informer(APIServerClient(api.url), w).start()
+        try:
+            inf.wait_synced()
+            assert _wait(lambda: any(
+                k == "CiliumNetworkPolicy" and n == "broken"
+                and o["status"]["nodes"]["node-1"]["ok"] is False
+                for k, _ns, n, o in api.status_writes
+            ))
+        finally:
+            inf.stop()
+
+
+def test_crd_registration(world):
+    """ensure_cnp_crd registers the CRD once and is idempotent
+    (register.go createCustomResourceDefinitions)."""
+    api, _d, _w = world
+    client = APIServerClient(api.url)
+    assert client.ensure_cnp_crd() is True
+    assert "ciliumnetworkpolicies.cilium.io" in api.crds
+    crd = api.crds["ciliumnetworkpolicies.cilium.io"]
+    assert crd["spec"]["names"]["kind"] == "CiliumNetworkPolicy"
+    # second call: already present, no duplicate POST needed
+    assert client.ensure_cnp_crd() is True
