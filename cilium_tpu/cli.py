@@ -250,6 +250,13 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--launch-proxy", action="store_true",
                    help="spawn + supervise the external L7 proxy "
                         "process (python -m cilium_tpu.proxy)")
+    d.add_argument("--launch-health", action="store_true",
+                   help="spawn + supervise the per-node health endpoint "
+                        "process (python -m cilium_tpu.health, the "
+                        "cilium-health sidecar)")
+    d.add_argument("--health-port", type=int, default=0,
+                   help="health responder port (0 = ephemeral; the "
+                        "reference's fixed port is 4240)")
     d.add_argument("--k8s-api", default=None, metavar="URL",
                    help="apiserver base URL: LIST + WATCH NetworkPolicy/"
                         "CNP/Service/Endpoints/Pod/Namespace and apply "
@@ -508,6 +515,44 @@ def main(argv: Optional[List[str]] = None) -> int:
             proxy_launcher = ProxyLauncher(
                 args.socket + ".xds", args.socket + ".accesslog"
             ).start()
+        health_launcher = None
+        if args.launch_health:
+            # per-node health endpoint as its own supervised process
+            # (the cilium-health sidecar, daemon/main.go:927-945)
+            from .health.standalone import HealthAPIClient
+            from .proxy.launcher import HealthLauncher
+
+            health_api = args.socket + ".health"
+            health_launcher = HealthLauncher(
+                args.socket, health_api,
+                listen_ip=args.node_ip or "127.0.0.1",
+                port=args.health_port,
+                interval=max(1.0, args.sync_interval),
+            ).start()
+
+            if cluster_node is not None:
+                # port advertisement only matters with peers to tell;
+                # a standalone daemon would poll for nothing
+                def _health_advertise():
+                    """Once the sidecar reports its responder port,
+                    advertise it in the node announcement so peers
+                    probe the right socket."""
+                    st = HealthAPIClient(health_api, timeout=3.0).status()
+                    port = int(st.get("port") or 0)
+                    if port:
+                        import dataclasses as _dc
+
+                        local = cluster_node.nodes.local
+                        if local.health_port != port:
+                            cluster_node.nodes.announce_local(_dc.replace(
+                                local, health_ip=args.node_ip,
+                                health_port=port,
+                            ))
+
+                daemon.controllers.update_controller(
+                    "health-advertise", _health_advertise,
+                    run_interval=max(1.0, args.sync_interval),
+                )
         informer = None
         if args.k8s_api:
             from .k8s import K8sWatcher
@@ -556,6 +601,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 informer.stop()
             if proxy_launcher is not None:
                 proxy_launcher.stop()
+            if health_launcher is not None:
+                health_launcher.stop()
             if accesslog_rx is not None:
                 accesslog_rx.stop()
             xds.stop()

@@ -541,6 +541,8 @@ class Daemon:
                 "ipv4": n.ipv4,
                 "ipv4_alloc_cidr": n.ipv4_alloc_cidr,
                 "cluster": getattr(n, "cluster", "default"),
+                "health_ip": getattr(n, "health_ip", None),
+                "health_port": getattr(n, "health_port", None),
             })
         return out
 

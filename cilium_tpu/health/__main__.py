@@ -1,0 +1,9 @@
+"""``python -m cilium_tpu.health`` — the standalone per-node health
+endpoint process (cilium-health/main.go entry point)."""
+
+import sys
+
+from .standalone import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,8 +104,11 @@ class HealthProber:
                     st.error = "no address"
                     st.failures = prev_failures + 1
                 else:
+                    # nodes may advertise their responder's port (one
+                    # host running several test nodes); default 4240
+                    port = getattr(n, "health_port", None) or self.port
                     try:
-                        st.latency_s = self.probe(addr, self.port)
+                        st.latency_s = self.probe(addr, port)
                         st.reachable = True
                     except OSError as e:
                         st.failures = prev_failures + 1

@@ -30,6 +30,9 @@ class Node:
     ipv4: Optional[str] = None
     ipv6: Optional[str] = None
     health_ip: Optional[str] = None
+    # port of the node's cilium-health responder; None = the default
+    # 4240 (single-host test clusters need per-node ports)
+    health_port: Optional[int] = None
     ipv4_alloc_cidr: Optional[str] = None
     ipv6_alloc_cidr: Optional[str] = None
 
@@ -110,6 +113,13 @@ class NodeRegistry:
     def get(self, cluster: str, name: str) -> Optional[Node]:
         with self._lock:
             return self.nodes.get(f"{cluster}/{name}")
+
+    def announce_local(self, node: Node) -> None:
+        """Replace this node's cluster announcement (store.go
+        registerNode re-announce — e.g. once the health sidecar's port
+        is known)."""
+        self.local = node
+        self.store.update_local_key_sync(node.key_name, node.to_dict())
 
     def unregister(self) -> None:
         self.store.delete_local_key(self.local.key_name)

@@ -1,9 +1,9 @@
-"""Subprocess supervision for the external proxy.
+"""Subprocess supervision for the agent's sidecar processes.
 
 Reference: pkg/launcher (the generic restarting subprocess supervisor
-the agent uses for cilium-node-monitor and cilium-envoy) and
-pkg/envoy/envoy.go:121-143 (the restart loop: if the child exits while
-the agent is running, relaunch it after a pause)."""
+the agent uses for cilium-node-monitor, cilium-health and cilium-envoy)
+and pkg/envoy/envoy.go:121-143 (the restart loop: if the child exits
+while the agent is running, relaunch it after a pause)."""
 
 from __future__ import annotations
 
@@ -18,21 +18,18 @@ from ..utils.logging import get_logger
 log = get_logger("launcher")
 
 
-class ProxyLauncher:
-    """Spawn ``python -m cilium_tpu.proxy`` and keep it alive."""
+class ChildLauncher:
+    """Spawn an argv and keep it alive (pkg/launcher role)."""
+
+    name = "child"
 
     def __init__(
         self,
-        xds_socket: str,
-        accesslog_socket: Optional[str] = None,
-        extra_args: Optional[List[str]] = None,
+        argv: List[str],
         restart_backoff_s: float = 0.5,
         max_backoff_s: float = 30.0,
     ) -> None:
-        self.argv = [sys.executable, "-m", "cilium_tpu.proxy", "--xds", xds_socket]
-        if accesslog_socket:
-            self.argv += ["--accesslog", accesslog_socket]
-        self.argv += list(extra_args or ())
+        self.argv = list(argv)
         self.restart_backoff_s = restart_backoff_s
         self.max_backoff_s = max_backoff_s
         self._stop = threading.Event()
@@ -41,16 +38,26 @@ class ProxyLauncher:
         self._thread: Optional[threading.Thread] = None
         self.restarts = 0
 
-    def start(self) -> "ProxyLauncher":
+    def start(self) -> "ChildLauncher":
         self._thread = threading.Thread(target=self._supervise, daemon=True)
         self._thread.start()
         return self
 
     def _spawn(self) -> subprocess.Popen:
+        # NOTE: no preexec_fn — it forces the fork() slow path, which
+        # deadlocks under JAX's threads. The children pin themselves to
+        # the agent's lifetime instead (utils.procutil.die_with_parent
+        # in their mains), so a SIGKILLed agent never leaks sidecars;
+        # the env var closes the fork→prctl race for them.
+        import os
+
+        env = dict(os.environ)
+        env["CILIUM_TPU_PARENT_PID"] = str(os.getpid())
         return subprocess.Popen(
             self.argv,
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
+            env=env,
         )
 
     def _supervise(self) -> None:
@@ -83,7 +90,7 @@ class ProxyLauncher:
                 return
             rc = proc.returncode
             log.warning(
-                "external proxy exited; restarting",
+                f"{self.name} exited; restarting",
                 fields={"rc": rc, "backoff_s": backoff},
             )
             # interruptible sleep: a stop during backoff must not spawn
@@ -108,3 +115,49 @@ class ProxyLauncher:
                 proc.wait(timeout=timeout)
         if self._thread is not None:
             self._thread.join(timeout=timeout)
+
+
+class ProxyLauncher(ChildLauncher):
+    """Supervised ``python -m cilium_tpu.proxy``."""
+
+    name = "external proxy"
+
+    def __init__(
+        self,
+        xds_socket: str,
+        accesslog_socket: Optional[str] = None,
+        extra_args: Optional[List[str]] = None,
+        **kw,
+    ) -> None:
+        argv = [sys.executable, "-m", "cilium_tpu.proxy", "--xds", xds_socket]
+        if accesslog_socket:
+            argv += ["--accesslog", accesslog_socket]
+        argv += list(extra_args or ())
+        super().__init__(argv, **kw)
+
+
+class HealthLauncher(ChildLauncher):
+    """Supervised ``python -m cilium_tpu.health`` (the cilium-health
+    sidecar the reference's agent launches at boot,
+    daemon/main.go:927-945)."""
+
+    name = "health endpoint"
+
+    def __init__(
+        self,
+        agent_socket: str,
+        api_socket: str,
+        listen_ip: str = "127.0.0.1",
+        port: int = 0,
+        interval: float = 60.0,
+        **kw,
+    ) -> None:
+        super().__init__(
+            [
+                sys.executable, "-m", "cilium_tpu.health",
+                "--agent", agent_socket, "--api", api_socket,
+                "--listen-ip", listen_ip, "--port", str(port),
+                "--interval", str(interval),
+            ],
+            **kw,
+        )

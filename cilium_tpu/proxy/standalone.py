@@ -1040,6 +1040,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--listen-host", default="127.0.0.1")
     ap.add_argument("--upstream", default=None, help="host:port to forward allowed traffic to")
     args = ap.parse_args(argv)
+    from ..utils.procutil import die_with_parent
+
+    die_with_parent()  # a SIGKILLed agent must not leak this sidecar
     upstream = None
     if args.upstream:
         host, _, port = args.upstream.rpartition(":")

@@ -183,3 +183,105 @@ class TestClusterConvergence:
         _pump_all(ca, cb)
         backs = {b.ip for b in db.services.effective_backends(fe)}
         assert backs == {"10.2.0.30", "10.1.0.30"}
+
+
+class TestStandaloneHealthProcess:
+    def test_cross_node_probes_over_real_sockets(self, tmp_path):
+        """The cilium-health shape as REAL processes: one kvstore
+        server + two agents, each supervising its own health-endpoint
+        sidecar (python -m cilium_tpu.health). Each sidecar's responder
+        answers the OTHER node's TCP probe; results are read from the
+        sidecar's own unix-socket API (prober.go:40,262 +
+        cilium-health/main.go)."""
+        import subprocess
+        import sys
+        import time
+
+        from cilium_tpu.health.standalone import HealthAPIClient
+
+        srv = subprocess.Popen(
+            [sys.executable, "-m", "cilium_tpu.cli", "kvstore", "serve",
+             "--listen", "127.0.0.1:0", "--lease-ttl", "5"],
+            stdout=subprocess.PIPE, text=True,
+        )
+        daemons = []
+        try:
+            url = srv.stdout.readline().split()[-1]
+            for name, ip, cidr in (
+                ("node-a", "127.0.0.1", "10.8.0.0/16"),
+                ("node-b", "127.0.0.1", "10.9.0.0/16"),
+            ):
+                sock = str(tmp_path / f"{name}.sock")
+                daemons.append((name, sock, subprocess.Popen(
+                    [sys.executable, "-m", "cilium_tpu.cli",
+                     "--socket", sock, "--state", str(tmp_path / name),
+                     "daemon", "--join", url, "--node-name", name,
+                     "--node-ip", ip, "--pod-cidr", cidr,
+                     "--sync-interval", "0.2", "--launch-health"],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                )))
+            import os
+
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not all(
+                os.path.exists(s + ".health") for _n, s, _p in daemons
+            ):
+                time.sleep(0.3)
+
+            def probe_sees_peer(sock, peer):
+                try:
+                    c = HealthAPIClient(sock + ".health", timeout=5.0)
+                    c.probe()  # force a sweep (POST /probe)
+                    rep = c.status()
+                except Exception:
+                    return None
+                for n in rep.get("nodes", ()):
+                    if n["name"] == peer and n["reachable"]:
+                        return n
+                return None
+
+            # node A's sidecar reaches node B's responder and vice versa
+            deadline = time.monotonic() + 60
+            got_a = got_b = None
+            while time.monotonic() < deadline and not (got_a and got_b):
+                got_a = probe_sees_peer(daemons[0][1], "node-b")
+                got_b = probe_sees_peer(daemons[1][1], "node-a")
+                if not (got_a and got_b):
+                    time.sleep(0.5)
+            assert got_a, "node-a's sidecar never reached node-b"
+            assert got_b, "node-b's sidecar never reached node-a"
+            assert got_a["latency_s"] > 0  # a real connect RTT
+            # the responder side actually answered (telemetry counts)
+            rep = HealthAPIClient(daemons[0][1] + ".health").status()
+            assert rep["probes_answered"] >= 1
+            # killing node B's agent (and with it the supervised
+            # sidecar's topology source) → B's responder process is
+            # orphaned but B's node announcement dies with its lease →
+            # A eventually stops listing it
+            daemons[1][2].terminate()
+            daemons[1][2].wait(timeout=10)
+            deadline = time.monotonic() + 30
+            gone = False
+            while time.monotonic() < deadline and not gone:
+                try:
+                    c = HealthAPIClient(daemons[0][1] + ".health", timeout=5.0)
+                    c.probe()
+                    rep = c.status()
+                    gone = all(
+                        n["name"] != "node-b" for n in rep.get("nodes", ())
+                    )
+                except Exception:
+                    pass
+                if not gone:
+                    time.sleep(0.5)
+            assert gone, "dead node-b still probed"
+        finally:
+            for _n, _s, p in daemons:
+                p.terminate()
+            for _n, _s, p in daemons:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            srv.terminate()
+            srv.wait(timeout=5)
