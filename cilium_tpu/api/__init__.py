@@ -1,4 +1,17 @@
-from .server import APIServer
-from .client import APIClient, APIError
+"""REST surface package. Lazy exports: sidecar processes import
+api.client / api.unixhttp without dragging in the daemon (and with it
+JAX) through APIServer."""
 
 __all__ = ["APIServer", "APIClient", "APIError"]
+
+
+def __getattr__(name):
+    if name == "APIServer":
+        from .server import APIServer
+
+        return APIServer
+    if name in ("APIClient", "APIError"):
+        from . import client
+
+        return getattr(client, name)
+    raise AttributeError(name)

@@ -17,52 +17,16 @@ import re
 import socket
 import threading
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..daemon import Daemon
+from .unixhttp import UnixHandler, UnixHTTPServer
+
+_UnixHTTPServer = UnixHTTPServer  # serving scaffold shared with sidecars
 
 
-class _UnixHTTPServer(ThreadingHTTPServer):
-    address_family = socket.AF_UNIX
-    daemon_threads = True
-    allow_reuse_address = False
-
-    def server_bind(self):
-        path = self.server_address
-        if isinstance(path, str) and os.path.exists(path):
-            os.unlink(path)
-        self.socket.bind(path)
-
-    def server_activate(self):
-        self.socket.listen(64)
-
-
-class _Handler(BaseHTTPRequestHandler):
-    # BaseHTTPRequestHandler assumes AF_INET client addresses
-    def address_string(self) -> str:
-        return "unix"
-
-    def log_message(self, fmt, *args):  # quiet by default
-        pass
-
+class _Handler(UnixHandler):
     # -- helpers --------------------------------------------------------
-    def _json(self, code: int, payload) -> None:
-        body = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _text(self, code: int, text: str) -> None:
-        body = text.encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "text/plain; version=0.0.4")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
     def _body(self):
         n = int(self.headers.get("Content-Length") or 0)
         raw = self.rfile.read(n) if n else b""

@@ -264,6 +264,13 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--k8s-token-file", default=None,
                    help="bearer-token file for --k8s-api (the in-cluster "
                         "ServiceAccount pattern)")
+    d.add_argument("--cri", default=None, metavar="TARGET",
+                   help="CRI runtime endpoint to watch for containers "
+                        "(containerd/cri-o socket, e.g. "
+                        "unix:///run/containerd/containerd.sock); starts "
+                        "the PLEG event loop (pkg/workloads role)")
+    d.add_argument("--cri-interval", type=float, default=5.0,
+                   help="CRI poll interval in seconds")
 
     # status / metrics
     st = sub.add_parser("status", help="agent status")
@@ -584,6 +591,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print("WARNING: k8s cache not synced after 30s — "
                       "serving with partial state; the informer keeps "
                       "retrying in the background")
+        pleg = None
+        if args.cri:
+            # container runtime watcher over the CRI socket
+            # (pkg/workloads docker.go role for containerd/cri-o)
+            from .runtimes import CRIRuntime, PLEGPoller
+            from .workloads import WorkloadWatcher
+
+            cri = CRIRuntime(args.cri)
+            pleg = PLEGPoller(
+                WorkloadWatcher(daemon, cri), cri,
+                interval=args.cri_interval,
+            ).start()
         daemon.fqdn_start()  # ToFQDNs DNS poll loop (daemon/main.go:808)
         if daemon.health.nodes is not None:
             # node prober (daemon/main.go:927-945) — only meaningful
@@ -599,6 +618,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         except KeyboardInterrupt:
             if informer is not None:
                 informer.stop()
+            if pleg is not None:
+                pleg.stop()
             if proxy_launcher is not None:
                 proxy_launcher.stop()
             if health_launcher is not None:

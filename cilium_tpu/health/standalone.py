@@ -20,14 +20,12 @@ proxy (pkg/launcher). Run as::
 
 from __future__ import annotations
 
-import json
-import os
 import socket
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
+from ..api.unixhttp import UnixHandler, UnixHTTPServer
 from ..utils.logging import get_logger
 from .prober import DEFAULT_HEALTH_PORT, HealthProber, tcp_probe
 
@@ -115,21 +113,6 @@ class _AgentNodeView:
         return [self._Node(d) for d in self._cached]
 
 
-class _UnixHTTPServer(ThreadingHTTPServer):
-    address_family = socket.AF_UNIX
-    daemon_threads = True
-    allow_reuse_address = False
-
-    def server_bind(self):
-        path = self.server_address
-        if isinstance(path, str) and os.path.exists(path):
-            os.unlink(path)
-        self.socket.bind(path)
-
-    def server_activate(self):
-        self.socket.listen(16)
-
-
 class HealthEndpoint:
     """The in-process assembly (responder + prober + REST); main()
     wraps it as the standalone process."""
@@ -156,21 +139,7 @@ class HealthEndpoint:
         self.started = time.time()
         endpoint = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def address_string(self):
-                return "unix"
-
-            def log_message(self, fmt, *args):
-                pass
-
-            def _json(self, code, payload):
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
+        class Handler(UnixHandler):
             def do_GET(self):
                 if self.path == "/status":
                     rep = endpoint.prober.report()
@@ -190,7 +159,7 @@ class HealthEndpoint:
                 else:
                     self._json(404, {"error": "not found"})
 
-        self._api = _UnixHTTPServer(api_socket, Handler)
+        self._api = UnixHTTPServer(api_socket, Handler)
 
     def start(self) -> "HealthEndpoint":
         self.responder.start()

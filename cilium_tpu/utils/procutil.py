@@ -15,7 +15,14 @@ def die_with_parent(sig: int = signal.SIGTERM) -> None:
 
     Called from the child's own main (not a preexec_fn — that forces
     the fork() slow path, which deadlocks under JAX's threads).
-    Best-effort: a non-Linux platform is a no-op."""
+    Best-effort: a non-Linux platform is a no-op.
+
+    Only arms when the launcher marked the process as supervised
+    (CILIUM_TPU_PARENT_PID in the env): a manually launched
+    ``python -m cilium_tpu.proxy ... &`` must NOT die with the shell
+    that started it."""
+    if "CILIUM_TPU_PARENT_PID" not in os.environ:
+        return
     try:
         import ctypes
 
