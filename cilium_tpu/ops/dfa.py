@@ -15,13 +15,15 @@ dispatch instead of per-request Envoy regex calls
 from __future__ import annotations
 
 import functools
-from typing import List, Sequence, Tuple
+from typing import TYPE_CHECKING, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..l7.regex_compile import MultiDFA
+if TYPE_CHECKING:  # annotation-only: a runtime import would cycle
+    # (l7/__init__ imports http_policy, which imports this module)
+    from ..l7.regex_compile import MultiDFA
 
 
 def strings_to_batch(strings: Sequence[bytes], max_len: int) -> Tuple[np.ndarray, np.ndarray]:
