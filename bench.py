@@ -646,11 +646,15 @@ def _bench_stretch() -> dict:
     jax.block_until_ready(engine.device_policy.sel_match)
     compile_s = time.time() - t0
 
+    from cilium_tpu.ops.materialize import (
+        TRAFFIC_INGRESS as _TI,
+        materialize_endpoints_state as _mes,
+    )
+
     ep_ids = [idents[i].id for i in range(N_ENDPOINTS)]
     t0 = time.time()
-    tables, _snaps = materialize_endpoints(
-        compiled, engine.device_policy, ep_ids, ingress=True
-    )
+    mat_state = _mes(compiled, engine.device_policy, ep_ids, ingress=True)
+    tables = mat_state.tables
     jax.block_until_ready(tables.id_bits)
     materialize_s = time.time() - t0
 
@@ -671,6 +675,36 @@ def _bench_stretch() -> dict:
         dec, _red = lookup_batch(tables, ep_idx, src, dport, proto)
     jax.block_until_ready(dec)
     vps = iters * b / (time.time() - t0)
+
+    # ── the restart path (pinned-map persistence analog): save the
+    # compiled arrays + materialized policymap, restore into a FRESH
+    # engine, and measure time-to-first-verdict — what a daemon restart
+    # pays instead of the compile_s + materialize_s above.
+    import os as _os
+    import tempfile as _tempfile
+
+    snap_dir = _tempfile.mkdtemp(prefix="bench-snap-")
+    snap_path = _os.path.join(snap_dir, "compiled.npz")
+    t0 = time.time()
+    engine.save_snapshot(snap_path, {_TI: mat_state})
+    save_s = time.time() - t0
+    engine2 = _PE(repo, reg)
+    t0 = time.time()
+    # same-process restore: repo/reg ARE the snapshotted objects, so
+    # counter equality is content equality (trust_counters contract)
+    restored = engine2.restore_snapshot(snap_path, trust_counters=True)
+    dec2, _ = lookup_batch(
+        restored[_TI].tables, ep_idx[:1024], src[:1024], dport[:1024],
+        proto[:1024],
+    )
+    jax.block_until_ready(dec2)
+    restore_s = time.time() - t0
+    try:
+        _os.unlink(snap_path)
+        _os.rmdir(snap_dir)
+    except OSError:
+        pass
+
     return {
         "identities": len(idents),
         "local_identities": sum(1 for x in idents if x.is_local),
@@ -679,6 +713,10 @@ def _bench_stretch() -> dict:
         "verdicts_per_s": round(vps),
         "compile_s": round(compile_s, 1),
         "materialize_s": round(materialize_s, 1),
+        "snapshot_save_s": round(save_s, 1),
+        # time from restore() to the first enforced verdict batch —
+        # the restart-to-enforcement number (target: < 5s)
+        "restore_to_verdict_s": round(restore_s, 2),
         "selectors": compiled.num_selectors,
         "rows": int(compiled.id_bits.shape[0]),
         "allow_fraction": round(float((np.asarray(dec) == 1).mean()), 4),

@@ -18,6 +18,7 @@ import json
 import os
 import tempfile
 import threading
+import time
 from dataclasses import asdict as dataclasses_asdict
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -168,6 +169,8 @@ class Daemon:
         # serializes snapshot writers: API threads AND the background
         # DNS poller both reach save_state
         self._save_lock = threading.Lock()
+        self._compiled_saved_basis = None  # (rev, id_ver, vocab_ver)
+        self._compiled_saved_at = float("-inf")
         # identity allocation is pluggable: clustered deployments
         # (cluster.py ClusterNode) swap in the kvstore CAS allocator
         # so the whole cluster numbers identities identically
@@ -999,6 +1002,47 @@ class Daemon:
                 except OSError:
                     pass
                 raise
+        # compiled-state snapshot beside the JSON (pinned-map
+        # persistence analog): a restart serves these tables while the
+        # re-imported rules drive the recompile. Debounced — save_state
+        # runs on every mutation, but the npz is heavy at scale, so it
+        # is rewritten only when the compiled basis moved and at most
+        # every few seconds (shutdown() forces the tail write).
+        # Materialized policymaps are NOT included: across a restart
+        # identity numbering may differ, so the daemon path could not
+        # soundly adopt them (the engine-level API still takes them for
+        # same-process restores, e.g. the bench restart measurement).
+        self._save_compiled_snapshot()
+
+    COMPILED_SNAPSHOT_MIN_INTERVAL_S = 5.0
+
+    def _save_compiled_snapshot(self, force: bool = False) -> None:
+        if not self.state_dir:
+            return
+        c = self.engine._compiled
+        if c is None:
+            return
+        basis = (c.revision, c.identity_version, c.vocab_version)
+        now = time.monotonic()
+        with self._save_lock:
+            if not force:
+                if basis == self._compiled_saved_basis:
+                    return
+                if (
+                    now - self._compiled_saved_at
+                    < self.COMPILED_SNAPSHOT_MIN_INTERVAL_S
+                ):
+                    return
+            try:
+                self.engine.save_snapshot(
+                    os.path.join(self.state_dir, "compiled.npz")
+                )
+                self._compiled_saved_basis = basis
+                self._compiled_saved_at = now
+            except Exception as e:
+                log.warning("compiled snapshot save failed", fields={
+                    "err": f"{type(e).__name__}: {e}",
+                })
 
     def restore_state(self) -> int:
         """Parse the snapshot and rebuild live state (restoreOldEndpoints
@@ -1006,6 +1050,18 @@ class Daemon:
         path = os.path.join(self.state_dir or "", "state.json")
         if not self.state_dir or not os.path.exists(path):
             return 0
+        # Enforcement continuity (the pinned-map property): load the
+        # compiled device tables from the last save FIRST, so verdicts
+        # serve last-known-good state while the re-imported rules and
+        # endpoints below drive the (slow) recompile when they differ.
+        cpath = os.path.join(self.state_dir, "compiled.npz")
+        if os.path.exists(cpath):
+            try:
+                self.engine.restore_snapshot(cpath)
+            except Exception as e:
+                log.warning("compiled snapshot restore failed", fields={
+                    "err": f"{type(e).__name__}: {e}",
+                })
         with open(path) as f:
             snap = json.load(f)
         # upgrade older snapshots in memory (cilium-map-migrate role)
@@ -1049,3 +1105,6 @@ class Daemon:
         self.health.stop()
         self.fqdn.stop()
         self.endpoint_manager.shutdown()
+        # tail write: the debounce above may have skipped the last
+        # compiled basis — a restart should restore the final state
+        self._save_compiled_snapshot(force=True)

@@ -146,6 +146,10 @@ class PolicyEngine:
                     op not in ("add", "delete") for _, op, _ in rule_ops
                 ):
                     return self._full_refresh()
+            if rule_ops and self._state is None:
+                # snapshot-restored engines carry no incremental
+                # CompileState: any rule movement means a full rebuild
+                return self._full_refresh()
 
             if not self._apply_identity_delta():
                 return self._full_refresh()
@@ -414,6 +418,76 @@ class PolicyEngine:
         c.revision = revision
         self._log_delta("rules", ())
         return True
+
+    # -- compiled-state snapshots (pinned-map persistence analog) -------
+    def save_snapshot(self, path: str, mats=None) -> None:
+        """Persist the compiled arrays (+ optional materialized
+        policymaps, {direction: MaterializedState}) so a restart can
+        re-load instead of re-deriving (daemon/state.go:53,135 role —
+        the kernel's pinned maps keep serving across agent restarts)."""
+        from .compiler.snapshot import save_compiled_state
+
+        with self._lock:
+            if self._compiled is None or self._sel_match_host is None:
+                raise RuntimeError("nothing compiled to snapshot")
+            save_compiled_state(
+                path, self._compiled, self._sel_match_host, mats
+            )
+
+    def restore_snapshot(self, path: str, *, trust_counters: bool = False):
+        """Load a snapshot and bring the device tables up on it.
+        → {direction: MaterializedState} (empty if none were saved), or
+        None when the file is absent/unreadable.
+
+        Mirrors the reference's restore semantics: the LOADED state
+        serves immediately (last-known-good continuity); the normal
+        ``refresh()`` gate re-derives when the inputs move.
+
+        ``trust_counters`` may ONLY be True when the live repo/registry
+        are the very objects the snapshot was taken from (same
+        process): then matching revision counters mean matching
+        content and refresh() stays a no-op. Across a restart the
+        counters come from a DEAD process — a fresh repository restarts
+        its numbering, so an equal revision is a coincidence, not
+        equality; the default re-stamps them to a sentinel that forces
+        the first refresh() to recompile (serving the restored tables
+        until it lands)."""
+        from .compiler.snapshot import load_compiled_state
+        from .ops.materialize import state_from_snapshot
+
+        loaded = load_compiled_state(path)
+        if loaded is None:
+            return None
+        compiled, sel_match_host, mat_fields = loaded
+        if not trust_counters:
+            compiled.revision = -1
+            compiled.identity_version = -1
+        with self._lock:
+            self._device = DevicePolicy(
+                id_bits=jnp.asarray(compiled.id_bits),
+                sel_match=jnp.asarray(sel_match_host),
+                ingress=DeviceTables.from_host(compiled.ingress),
+                egress=DeviceTables.from_host(compiled.egress),
+            )
+            self._sel_match_host = sel_match_host
+            low = np.full(MAX_USER_IDENTITY + 1, -1, np.int32)
+            high: dict = {}
+            for ident, row in compiled.id_to_row.items():
+                if ident < low.size:
+                    low[ident] = row
+                else:
+                    high[ident] = row
+            self._low_rows = low
+            self._high_rows = high
+            self._compiled = compiled
+            self._state = None  # no incremental state: rule ops rebuild
+            self._conj_unpacked = None
+            self._pending_idents.clear()
+            self._log_delta("full", ())
+        return {
+            d: state_from_snapshot(compiled.row_ids, f)
+            for d, f in mat_fields.items()
+        }
 
     def _set_row_index(self, ident_id: int, row: int) -> None:
         assert self._low_rows is not None

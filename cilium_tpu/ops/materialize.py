@@ -307,6 +307,61 @@ def materialize_endpoints_state(
     )
 
 
+def state_from_snapshot(row_ids: np.ndarray, fields: dict) -> MaterializedState:
+    """Rebuild a MaterializedState from compiler/snapshot.py fields —
+    the restore half of the pinned-map persistence analog. The column
+    bitmaps are authoritative; per-endpoint snapshots (policymap dump
+    surface) are re-derived from them, and the device tables re-packed
+    and uploaded. No policy sweep runs: this is a load, not a derive."""
+    allow_nc = np.asarray(fields["allow_nc"], bool)
+    red_nc = np.asarray(fields["red_nc"], bool)
+    col_ep = np.asarray(fields["col_ep"], np.int32)
+    col_port = np.asarray(fields["col_port"], np.int32)
+    col_proto = np.asarray(fields["col_proto"], np.int32)
+    col_is_l3 = np.asarray(fields["col_is_l3"], bool)
+    ep_slots = fields["ep_slots"]
+    ingress = bool(fields["ingress"])
+    direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
+
+    snapshots: List[EndpointPolicySnapshot] = []
+    col = 0
+    for e, slots in enumerate(ep_slots):
+        l3_allow = allow_nc[:, col]
+        col += 1
+        entries: Dict[PolicyKey, int] = {}
+        for r_idx in np.nonzero(l3_allow)[0]:
+            entries[PolicyKey(int(row_ids[r_idx]), 0, 0, direction)] = 0
+        for port, proto_n in slots:
+            allow = allow_nc[:, col]
+            redirect = red_nc[:, col]
+            col += 1
+            for r_idx in np.nonzero(allow & (~l3_allow | redirect))[0]:
+                key = PolicyKey(int(row_ids[r_idx]), port, proto_n, direction)
+                entries[key] = int(redirect[r_idx])
+        snapshots.append(EndpointPolicySnapshot(entries=entries, slots=slots))
+
+    tables = PolicymapTables(
+        col_ep=jnp.asarray(col_ep),
+        col_port=jnp.asarray(col_port),
+        col_proto=jnp.asarray(col_proto),
+        col_is_l3=jnp.asarray(col_is_l3),
+        id_bits=pack_bool_bits(
+            jnp.asarray(np.concatenate([allow_nc, red_nc], axis=1))
+        ),
+    )
+    return MaterializedState(
+        tables=tables,
+        snapshots=snapshots,
+        ingress=ingress,
+        endpoint_identity_ids=list(fields["endpoint_identity_ids"]),
+        ep_rows=np.asarray(fields["ep_rows"], np.int32),
+        ep_slots=ep_slots,
+        allow_nc=allow_nc,
+        red_nc=red_nc,
+        n_cols=int(fields["n_cols"]),
+    )
+
+
 @jax.jit
 def _patch_bitmap_rows(
     id_bits: jnp.ndarray,

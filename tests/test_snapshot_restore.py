@@ -1,0 +1,232 @@
+"""Compiled-state snapshot/restore — the pinned-map persistence analog
+(daemon/state.go:53,135): a restarting agent re-loads the compiler's
+output arrays + materialized policymaps instead of re-deriving them,
+so enforcement is live on last-known-good state immediately; the
+normal refresh gate recompiles only when inputs actually move."""
+
+from __future__ import annotations
+
+import json
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cilium_tpu.engine import PolicyEngine
+from cilium_tpu.identity import IdentityRegistry
+from cilium_tpu.labels import parse_label_array
+from cilium_tpu.ops.lookup import lookup_batch
+from cilium_tpu.ops.materialize import (
+    TRAFFIC_EGRESS,
+    TRAFFIC_INGRESS,
+    materialize_endpoints_state,
+)
+from cilium_tpu.ops.verdict import verdict_batch
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    rule,
+)
+from cilium_tpu.policy.repository import Repository
+
+
+def _world(n_rules=40, n_idents=24, seed=5):
+    rng = random.Random(seed)
+    repo = Repository()
+    rules = []
+    for i in range(n_rules):
+        subject = [f"k8s:app=a{rng.randrange(8)}"]
+        peer = EndpointSelector.make([f"k8s:app=a{rng.randrange(8)}"])
+        if i % 3 == 0:
+            ing = IngressRule(
+                from_endpoints=(peer,),
+                to_ports=(PortRule(ports=(PortProtocol(80, "TCP"),)),),
+            )
+        else:
+            ing = IngressRule(from_endpoints=(peer,))
+        rules.append(rule(subject, ingress=[ing]))
+    repo.add_list(rules)
+    reg = IdentityRegistry()
+    idents = [
+        reg.allocate(parse_label_array([f"k8s:app=a{rng.randrange(8)}"]))
+        for _ in range(n_idents)
+    ]
+    return repo, reg, idents
+
+
+def _flows(engine, idents, b=512, seed=9):
+    rows = engine.rows([i.id for i in idents])
+    rng = np.random.default_rng(seed)
+    subj = jnp.asarray(rng.choice(rows, b).astype(np.int32))
+    peer = jnp.asarray(rng.choice(rows, b).astype(np.int32))
+    dport = jnp.asarray(rng.choice(np.array([0, 80, 443], np.int32), b))
+    proto = jnp.asarray(np.full(b, 6, np.int32))
+    has_l4 = jnp.asarray(np.asarray(dport) != 0)
+    return subj, peer, dport, proto, has_l4
+
+
+class TestSnapshotRoundtrip:
+    def test_restore_serves_identical_verdicts(self, tmp_path):
+        repo, reg, idents = _world()
+        engine = PolicyEngine(repo, reg)
+        compiled = engine.refresh()
+        ep_ids = [idents[i].id for i in range(6)]
+        mats = {
+            TRAFFIC_INGRESS: materialize_endpoints_state(
+                compiled, engine.device_policy, ep_ids, ingress=True
+            ),
+            TRAFFIC_EGRESS: materialize_endpoints_state(
+                compiled, engine.device_policy, ep_ids, ingress=False
+            ),
+        }
+        path = str(tmp_path / "compiled.npz")
+        engine.save_snapshot(path, mats)
+
+        # "restart": fresh engine over the SAME repo/registry OBJECTS —
+        # the one case where trusting the snapshot's counters is sound
+        engine2 = PolicyEngine(repo, reg)
+        restored = engine2.restore_snapshot(path, trust_counters=True)
+        assert restored is not None and set(restored) == {
+            TRAFFIC_INGRESS, TRAFFIC_EGRESS
+        }
+        # device verdicts identical without any compile
+        args = _flows(engine, idents)
+        v1 = verdict_batch(engine.device_policy, *args)
+        v2 = verdict_batch(engine2.device_policy, *args)
+        np.testing.assert_array_equal(
+            np.asarray(v1.decision), np.asarray(v2.decision)
+        )
+        # restored engine is NOT stale: refresh() is a no-op, not a
+        # recompile (the whole point of the snapshot)
+        assert engine2.refresh() is engine2._compiled
+
+        # materialized policymaps identical: device lookup + snapshots
+        rng = np.random.default_rng(3)
+        b = 256
+        rows = engine.rows([i.id for i in idents])
+        ep_idx = jnp.asarray(rng.integers(0, 6, b, dtype=np.int32))
+        src = jnp.asarray(rng.choice(rows, b).astype(np.int32))
+        dport = jnp.asarray(rng.choice(np.array([0, 80, 443], np.int32), b))
+        proto = jnp.asarray(np.full(b, 6, np.int32))
+        for d in (TRAFFIC_INGRESS, TRAFFIC_EGRESS):
+            d1, r1 = lookup_batch(mats[d].tables, ep_idx, src, dport, proto)
+            d2, r2 = lookup_batch(
+                restored[d].tables, ep_idx, src, dport, proto
+            )
+            np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+            np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+            for s1, s2 in zip(mats[d].snapshots, restored[d].snapshots):
+                assert s1.entries == s2.entries
+                assert s1.slots == s2.slots
+
+    def test_restored_engine_recompiles_when_inputs_move(self, tmp_path):
+        """Continuity semantics: the snapshot serves as-is, and a rule
+        import AFTER restore triggers a full recompile whose verdicts
+        match a from-scratch engine."""
+        repo, reg, idents = _world()
+        engine = PolicyEngine(repo, reg)
+        engine.refresh()
+        path = str(tmp_path / "compiled.npz")
+        engine.save_snapshot(path)
+
+        engine2 = PolicyEngine(repo, reg)
+        assert engine2.restore_snapshot(path) is not None
+        # move the inputs: one more rule + one more identity
+        repo.add_list([rule(
+            ["k8s:app=a0"],
+            ingress=[IngressRule(from_endpoints=(
+                EndpointSelector.make(["k8s:app=a7"]),
+            ))],
+        )])
+        idents.append(reg.allocate(parse_label_array(["k8s:app=a7"])))
+        c2 = engine2.refresh()  # full rebuild (no incremental state)
+        fresh = PolicyEngine(repo, reg)
+        fresh.refresh()
+        args = _flows(engine2, idents)
+        va = verdict_batch(engine2.device_policy, *args)
+        vb = verdict_batch(fresh.device_policy, *args)
+        np.testing.assert_array_equal(
+            np.asarray(va.decision), np.asarray(vb.decision)
+        )
+        assert c2.revision == repo.revision
+
+    def test_missing_or_corrupt_snapshot(self, tmp_path):
+        repo, reg, _ = _world(n_rules=4, n_idents=4)
+        engine = PolicyEngine(repo, reg)
+        assert engine.restore_snapshot(str(tmp_path / "absent.npz")) is None
+        bad = tmp_path / "bad.npz"
+        bad.write_bytes(b"not an npz")
+        assert engine.restore_snapshot(str(bad)) is None
+        # a TRUNCATED real snapshot (crash mid-write without the atomic
+        # rename) raises zipfile.BadZipFile inside np.load — must also
+        # degrade to None, never a crash
+        engine.refresh()
+        good = tmp_path / "good.npz"
+        engine.save_snapshot(str(good))
+        data = good.read_bytes()
+        (tmp_path / "trunc.npz").write_bytes(data[: len(data) // 2])
+        assert engine.restore_snapshot(str(tmp_path / "trunc.npz")) is None
+        # engine still functional: a normal refresh works
+        engine.refresh(force=True)
+        assert engine.device_policy is not None
+
+
+def test_restart_with_coincidental_revision_recompiles(tmp_path):
+    """The daemon-restart trap (review r05): a FRESH repository restarts
+    its revision numbering, so a new rule imported after restore can
+    land on a revision number ≤ the dead process's counter. The default
+    (untrusted) restore must re-stamp the counters so the recompile
+    happens anyway — otherwise the new rule (even a deny) would never
+    reach the device."""
+    repo, reg, idents = _world()
+    engine = PolicyEngine(repo, reg)
+    # push the old process's revision counter up
+    for i in range(3):
+        repo.add_list([rule(
+            [f"k8s:app=a{i}"],
+            ingress=[IngressRule(from_endpoints=(
+                EndpointSelector.make([f"k8s:app=a{(i + 1) % 8}"]),
+            ))],
+            labels=[f"k8s:policy=extra-{i}"],
+        )])
+    engine.refresh()
+    path = str(tmp_path / "compiled.npz")
+    engine.save_snapshot(path)
+    old_revision = engine._compiled.revision
+
+    # "restart": fresh repo re-imports the SAME rules in ONE add_list —
+    # its revision counter is now far below the old process's
+    import copy
+
+    with repo._lock:
+        all_rules = [copy.deepcopy(r) for r in repo.rules]
+    repo2 = Repository()
+    repo2.add_list(all_rules)
+    reg2 = IdentityRegistry()
+    idents2 = [reg2.allocate(i.labels) for i in idents]
+    engine2 = PolicyEngine(repo2, reg2)
+    assert engine2.restore_snapshot(path) is not None  # untrusted default
+    assert repo2.revision < old_revision
+    # a NEW deny-relevant rule whose revision stays under the stale
+    # counter: the restored engine must still recompile and enforce it
+    repo2.add_list([rule(
+        ["k8s:app=a5"],
+        ingress=[IngressRule(from_endpoints=(
+            EndpointSelector.make(["k8s:app=a6"]),
+        ))],
+        labels=["k8s:policy=post-restart"],
+    )])
+    assert repo2.revision <= old_revision
+    c = engine2.refresh()
+    assert c.revision == repo2.revision
+    fresh = PolicyEngine(repo2, reg2)
+    fresh.refresh()
+    args = _flows(engine2, idents2)
+    va = verdict_batch(engine2.device_policy, *args)
+    vb = verdict_batch(fresh.device_policy, *args)
+    np.testing.assert_array_equal(
+        np.asarray(va.decision), np.asarray(vb.decision)
+    )
