@@ -723,6 +723,51 @@ def _bench_stretch() -> dict:
     }
 
 
+def _host_envelope() -> dict:
+    """The bench host's compute envelope (VERDICT r04 #2): host-side
+    numbers (kafka_acl_rps, native_vps) track the machine as much as
+    the code, and a ±50% swing is uninterpretable without knowing
+    whether the machine changed. Reports CPU count/model plus a FIXED
+    single-core calibration op — a pure-Python token loop and a pinned
+    64MB sha256 — so rounds can be compared per unit of host compute
+    (rate ÷ calib) instead of raw."""
+    import hashlib
+    import platform
+
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        model = platform.processor()
+
+    # pure-Python single-core loop (interpreter + scalar ALU proxy —
+    # what the Kafka ACL host path is made of)
+    t0 = time.perf_counter()
+    acc = 0
+    for i in range(2_000_000):
+        acc += i & 7
+    py_loops = 2_000_000 / (time.perf_counter() - t0)
+
+    # pinned-size sha256 (memory-streaming + vector proxy — closer to
+    # the native C++ front-end's profile)
+    blob = b"\x5a" * (1 << 26)
+    t0 = time.perf_counter()
+    hashlib.sha256(blob).digest()
+    sha_mbps = (1 << 26) / (time.perf_counter() - t0) / 1e6
+
+    return {
+        "host_cpus": os.cpu_count(),
+        "cpu_model": model,
+        "calib_py_loops_per_s": round(py_loops),
+        "calib_sha256_mb_per_s": round(sha_mbps, 1),
+        "py_version": platform.python_version(),
+    }
+
+
 def _bench_dispatch_rtt() -> float:
     """Median blocking round trip for a trivial pre-compiled dispatch —
     the environment's latency floor for ANY blocking device update
@@ -875,6 +920,14 @@ def main() -> None:
         "rebuild_warm_s": round(rebuild_warm_s, 2),
         "stretch_100k": stretch,
     }
+    envelope = _host_envelope()
+    # per-unit-of-host-compute normalizations: compare THESE across
+    # rounds for the host-side paths — a machine change moves the raw
+    # rate and the calibration together, leaving the ratio stable
+    calib = max(1.0, envelope["calib_py_loops_per_s"])
+    result["kafka_acl_per_py_loop"] = round(kafka_acl / calib, 4)
+    sha = max(1.0, envelope["calib_sha256_mb_per_s"])
+    result["native_vps_per_sha_mb"] = round(native_vps / sha / 1000, 2)
     print(json.dumps(result))
     print(
         json.dumps(
@@ -890,7 +943,7 @@ def main() -> None:
                     "endpoints": N_ENDPOINTS,
                     "batch": BATCH,
                     "dispatch_rtt_ms": round(dispatch_rtt_ms, 1),
-                    "host_cpus": os.cpu_count(),
+                    **envelope,
                 }
             }
         ),

@@ -119,14 +119,11 @@ def load_compiled_state(path: str):
 
     _bad = (OSError, ValueError, KeyError, zipfile.BadZipFile)
     try:
-        z = np.load(path)
-        meta = json.loads(bytes(z["meta"]).decode())
-    except _bad:
-        return None
-    if meta.get("schema") != SNAPSHOT_SCHEMA:
-        return None
-    try:
-        return _decode(z, meta)
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            if meta.get("schema") != SNAPSHOT_SCHEMA:
+                return None
+            return _decode(z, meta)
     except _bad:
         return None
 

@@ -1022,6 +1022,11 @@ class Daemon:
         c = self.engine._compiled
         if c is None:
             return
+        if c.revision < 0:
+            # snapshot-restored state with re-stamped counters: writing
+            # it back would overwrite the on-disk snapshot with the
+            # same arrays under sentinel metadata — pure cost
+            return
         basis = (c.revision, c.identity_version, c.vocab_version)
         now = time.monotonic()
         with self._save_lock:

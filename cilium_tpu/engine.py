@@ -99,6 +99,7 @@ class PolicyEngine:
         )
         self.delta_seq = 0
         self._delta_log: List[Tuple[int, str, tuple]] = []
+        self._bg_refresh: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
     def _log_delta(self, kind: str, payload: tuple) -> None:
@@ -131,10 +132,24 @@ class PolicyEngine:
 
     def refresh(self, force: bool = False) -> CompiledPolicy:
         """Recompile (or incrementally patch) if repository or identity
-        state moved (the revision gate of pkg/endpoint/policy.go:506)."""
+        state moved (the revision gate of pkg/endpoint/policy.go:506).
+
+        A snapshot-RESTORED engine (untrusted counters, revision < 0)
+        refreshes in the BACKGROUND instead: the restored tables keep
+        serving verdicts while the O(identities × rules) recompile runs
+        — the pinned-map continuity the reference gets from maps that
+        outlive the agent (daemon/state.go:53,135). Every other path is
+        synchronous as before."""
         with self._lock:
             if not force and not self._stale():
                 return self._compiled  # type: ignore[return-value]
+            if (
+                not force
+                and self._compiled is not None
+                and self._compiled.revision < 0
+            ):
+                self._kick_background_refresh()
+                return self._compiled
             if force or self._compiled is None:
                 return self._full_refresh()
 
@@ -170,8 +185,12 @@ class PolicyEngine:
                         return self._full_refresh()
             return c
 
-    def _full_refresh(self) -> CompiledPolicy:
-        compiled, state = compile_policy_state(self.repo, self.registry)
+    @staticmethod
+    def _compute_full(repo, registry):
+        """The expensive half of a full refresh (host compile + device
+        upload), lock-free so the background-continuity path can run it
+        while restored tables keep serving."""
+        compiled, state = compile_policy_state(repo, registry)
         sel_match = compute_selector_matches(
             jnp.asarray(compiled.id_bits),
             jnp.asarray(compiled.conj_req),
@@ -179,12 +198,17 @@ class PolicyEngine:
             jnp.asarray(compiled.conj_valid),
             jnp.asarray(compiled.req_count),
         )
-        self._device = DevicePolicy(
+        device = DevicePolicy(
             id_bits=jnp.asarray(compiled.id_bits),
             sel_match=sel_match,
             ingress=DeviceTables.from_host(compiled.ingress),
             egress=DeviceTables.from_host(compiled.egress),
         )
+        return compiled, state, sel_match, device
+
+    def _install_compiled(self, compiled, state, sel_match, device) -> None:
+        """Swap a computed full-refresh result in (lock held)."""
+        self._device = device
         # np.array (copy): asarray on a device buffer is read-only and
         # the incremental paths mutate this in place.
         self._sel_match_host = np.array(sel_match)
@@ -202,6 +226,12 @@ class PolicyEngine:
         self._conj_unpacked = None
         self._pending_idents.clear()
         self._log_delta("full", ())
+
+    def _full_refresh(self) -> CompiledPolicy:
+        compiled, state, sel_match, device = self._compute_full(
+            self.repo, self.registry
+        )
+        self._install_compiled(compiled, state, sel_match, device)
         return compiled
 
     # -- incremental paths ---------------------------------------------
@@ -419,20 +449,88 @@ class PolicyEngine:
         self._log_delta("rules", ())
         return True
 
+    def _kick_background_refresh(self) -> None:
+        """Start (at most one) background full refresh (lock held)."""
+        if self._bg_refresh is not None and self._bg_refresh.is_alive():
+            return
+
+        def run():
+            try:
+                result = self._compute_full(self.repo, self.registry)
+                with self._lock:
+                    self._install_compiled(*result)
+            except Exception as e:
+                # a failed background compile leaves the restored
+                # tables serving; the next refresh() retries
+                from .utils.logging import get_logger
+
+                get_logger("engine").warning(
+                    "background refresh failed",
+                    fields={"err": f"{type(e).__name__}: {e}"},
+                )
+
+        t = threading.Thread(target=run, daemon=True)
+        self._bg_refresh = t
+        t.start()
+
+    def wait_refreshed(self, timeout: Optional[float] = None) -> bool:
+        """Block until a pending background refresh (if any) lands —
+        tests and shutdown paths use this; serving paths never do."""
+        t = self._bg_refresh
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
+
     # -- compiled-state snapshots (pinned-map persistence analog) -------
     def save_snapshot(self, path: str, mats=None) -> None:
         """Persist the compiled arrays (+ optional materialized
         policymaps, {direction: MaterializedState}) so a restart can
         re-load instead of re-deriving (daemon/state.go:53,135 role —
-        the kernel's pinned maps keep serving across agent restarts)."""
+        the kernel's pinned maps keep serving across agent restarts).
+
+        Array COPIES are taken under the engine lock (the incremental
+        paths mutate them in place); the serialize + fsync — the slow
+        part at scale — runs outside it so verdict serving never stalls
+        behind a disk write."""
+        import copy as _copy
+        import dataclasses as _dc
+
         from .compiler.snapshot import save_compiled_state
 
         with self._lock:
             if self._compiled is None or self._sel_match_host is None:
                 raise RuntimeError("nothing compiled to snapshot")
-            save_compiled_state(
-                path, self._compiled, self._sel_match_host, mats
-            )
+            c = self._compiled
+
+            def copy_arrays(obj):
+                return _dc.replace(obj, **{
+                    f.name: getattr(obj, f.name).copy()
+                    for f in _dc.fields(obj)
+                    if isinstance(getattr(obj, f.name), np.ndarray)
+                })
+
+            compiled = copy_arrays(c)
+            compiled.id_to_row = dict(c.id_to_row)
+            compiled.ingress = copy_arrays(c.ingress)
+            compiled.egress = copy_arrays(c.egress)
+            sel_match = self._sel_match_host.copy()
+            mats_copy = None
+            if mats:
+                mats_copy = {
+                    d: _dc.replace(
+                        st,
+                        allow_nc=st.allow_nc.copy(),
+                        red_nc=st.red_nc.copy(),
+                        ep_rows=st.ep_rows.copy(),
+                        ep_slots=_copy.deepcopy(st.ep_slots),
+                        endpoint_identity_ids=list(
+                            st.endpoint_identity_ids
+                        ),
+                    )
+                    for d, st in mats.items()
+                }
+        save_compiled_state(path, compiled, sel_match, mats_copy)
 
     def restore_snapshot(self, path: str, *, trust_counters: bool = False):
         """Load a snapshot and bring the device tables up on it.

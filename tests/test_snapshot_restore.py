@@ -142,7 +142,12 @@ class TestSnapshotRoundtrip:
             ))],
         )])
         idents.append(reg.allocate(parse_label_array(["k8s:app=a7"])))
-        c2 = engine2.refresh()  # full rebuild (no incremental state)
+        # untrusted restore → the first refresh returns the restored
+        # (still-serving) tables and recompiles in the background
+        stale = engine2.refresh()
+        assert stale.revision < 0  # continuity: restored state served
+        assert engine2.wait_refreshed(60)
+        c2 = engine2.refresh()  # landed: now the real compile
         fresh = PolicyEngine(repo, reg)
         fresh.refresh()
         args = _flows(engine2, idents)
@@ -220,6 +225,8 @@ def test_restart_with_coincidental_revision_recompiles(tmp_path):
         labels=["k8s:policy=post-restart"],
     )])
     assert repo2.revision <= old_revision
+    engine2.refresh()  # kicks the background recompile
+    assert engine2.wait_refreshed(60)
     c = engine2.refresh()
     assert c.revision == repo2.revision
     fresh = PolicyEngine(repo2, reg2)

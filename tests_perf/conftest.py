@@ -1,0 +1,34 @@
+"""Perf-floor test harness config.
+
+Lives OUTSIDE tests/ on purpose: tests/conftest.py pins JAX to the
+virtual CPU mesh, while these floors must run in the BENCH environment
+(the real chip over the axon tunnel) — run them there with
+
+    python -m pytest tests_perf -q
+
+Floors are order-of-magnitude backstops (VERDICT r04 #7): BENCH_r*
+numbers swung ±50% between rounds with nothing failing; these fail
+in-round when a path regresses past ~10x, instead of at judging."""
+
+import os
+
+import jax
+import pytest
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "perf: order-of-magnitude perf floor (bench env)"
+    )
+
+
+@pytest.fixture(scope="session")
+def on_accelerator() -> bool:
+    return jax.devices()[0].platform != "cpu"
