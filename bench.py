@@ -374,8 +374,10 @@ def _bench_native(snaps, idents, nrng: np.random.Generator):
 
 def _bench_pipeline_e2e(
     repo, reg, idents, nrng: np.random.Generator
-) -> Tuple[float, float]:
-    """Device-resident FULL datapath chain (deny-LPM skip on empty
+) -> Tuple[float, float, float]:
+    """→ (v4_rate, v6_rate, fused_prefilter_rate).
+
+    Device-resident FULL datapath chain (deny-LPM skip on empty
     prefilter → identity LPM → policymap lookup → counters) on one
     pre-staged batch — the cold-flow batch path a host front-end feeds.
     Host→device transfer is excluded: over the axon tunnel the PCIe
@@ -429,6 +431,37 @@ def _bench_pipeline_e2e(
     jax.block_until_ready(v)
     v4_rate = iters * b / (time.time() - t0)
 
+    # ── ACTIVE prefilter: the fused deny+identity flat walk (ops/lpm
+    # merge_flat_tries) — one 2-gather pass answers both the XDP deny
+    # check and the identity derivation. Reported separately so the
+    # fusion's effect is visible against the deny-stage-skipped number
+    # above.
+    pf2 = PreFilter()
+    pf2.insert(pf2.revision, [
+        "192.0.2.0/24", "198.51.100.0/24", "10.3.0.0/16", "10.250.7.0/28",
+    ])
+    pipe_pf = DatapathPipeline(eng, cache, pf2, conntrack=None)
+    pipe_pf.set_endpoints([idents[j].id for j in range(N_ENDPOINTS)])
+    pipe_pf.process(ips[:1024], eps[:1024], dports[:1024], protos[:1024])
+    t_pf = pipe_pf._tables[(TRAFFIC_INGRESS, 4)]
+    fused = t_pf.merged_sub_info.shape[-1] == 65536
+
+    def run_pf():
+        v, _red, _c = process_flows_wide(
+            t_pf, *d, ep_count=N_ENDPOINTS, prefilter=True,
+            row_override=None,
+        )
+        return v
+
+    jax.block_until_ready(run_pf())
+    t0 = time.time()
+    for _ in range(iters):
+        v = run_pf()
+    jax.block_until_ready(v)
+    pf_rate = iters * b / (time.time() - t0)
+    if not fused:
+        pf_rate = -pf_rate  # flag: fusion unexpectedly not built
+
     # IPv6: same chain over the elided stride-8 tries (shared-prefix
     # bytes compared, not walked)
     from cilium_tpu.datapath.pipeline import process_flows
@@ -466,7 +499,7 @@ def _bench_pipeline_e2e(
     for _ in range(iters):
         v = run6()
     jax.block_until_ready(v)
-    return v4_rate, iters * b6 / (time.time() - t0)
+    return v4_rate, iters * b6 / (time.time() - t0), pf_rate
 
 
 def _bench_native_e2e(snaps, idents, nrng: np.random.Generator):
@@ -871,9 +904,9 @@ def main() -> None:
         _bench_native_e2e(_snaps, idents, np.random.default_rng(9))
         if extra else (0.0, 0.0)
     )
-    pipeline_e2e_vps, pipeline_e2e_v6_vps = (
+    pipeline_e2e_vps, pipeline_e2e_v6_vps, pipeline_e2e_fused_pf_vps = (
         _bench_pipeline_e2e(repo, reg, idents, np.random.default_rng(13))
-        if extra else (0.0, 0.0)
+        if extra else (0.0, 0.0, 0.0)
     )
     t0 = time.time()
     tables2, _ = materialize_endpoints(
@@ -917,6 +950,9 @@ def main() -> None:
         "native_e2e_est_vps": round(native_e2e_est_vps),
         "pipeline_e2e_vps": round(pipeline_e2e_vps),
         "pipeline_e2e_v6_vps": round(pipeline_e2e_v6_vps),
+        # deny stage ACTIVE via the fused one-walk table (negative =
+        # fusion unexpectedly absent)
+        "pipeline_e2e_fused_pf_vps": round(pipeline_e2e_fused_pf_vps),
         "rebuild_warm_s": round(rebuild_warm_s, 2),
         "stretch_100k": stretch,
     }

@@ -37,11 +37,14 @@ from ..ipcache.ipcache import IPCache
 from ..ipcache.prefilter import PreFilter
 from ..ops.lookup import PolicymapTables, lookup_batch
 from ..ops.lpm import (
+    DENY_BIT,
+    MERGED_VALUE_MASK,
     build_trie_elided,
     build_wide_trie,
     ipv4_to_bytes,
     lpm_lookup,
     lpm_lookup_wide,
+    merge_flat_tries,
 )
 from ..ops.materialize import (
     EndpointPolicySnapshot,
@@ -81,7 +84,14 @@ class DatapathTables:
 class WideDatapathTables:
     """IPv4 device state using the dense-16-bit-first-stride tries
     (ops/lpm.py WideTrieBuilder) — 3 gathers per LPM instead of 4,
-    measured ~1.8× on the identity-derivation stage."""
+    measured ~1.8× on the identity-derivation stage.
+
+    ``merged_*`` carry the FUSED deny+identity flat trie when both
+    sides use the dense layout (ops/lpm.py merge_flat_tries): one
+    2-gather walk yields the identity row AND the prefilter verdict,
+    halving the chain's gather count. A [1,1] merged_sub_info marks
+    "no merged table" (shape is static at trace time, so the jit
+    routes without a flag)."""
 
     pf_root_info: jnp.ndarray  # [65536] int32
     pf_root_child: jnp.ndarray
@@ -91,6 +101,10 @@ class WideDatapathTables:
     ip_root_child: jnp.ndarray
     ip_sub_child: jnp.ndarray
     ip_sub_info: jnp.ndarray
+    merged_root_info: jnp.ndarray  # [65536] int32 (packed) or [1]
+    merged_root_child: jnp.ndarray
+    merged_sub_child: jnp.ndarray
+    merged_sub_info: jnp.ndarray  # [M, 65536] or [1, 1]
     world_row: jnp.ndarray  # [] int32
     policymap: PolicymapTables
 
@@ -111,6 +125,37 @@ def _elided_lpm(
         ok = jnp.all(addr_bytes[:, :k] == common[None, :], axis=1)
         hit = jnp.where(ok, hit, 0)
     return hit
+
+
+def _v4_lpm_stage(t, peer_u32, prefilter: bool):
+    """→ (denied_pf [B] bool, identity hit [B] int32 value+1).
+
+    Routes on the (static) merged-table shape: with the fused
+    deny+identity flat trie present and the prefilter stage active, ONE
+    walk answers both questions (bpf_xdp.c check_filters + the ipcache
+    secctx derivation in a single pass); otherwise the two classic
+    walks run (and the deny walk only when the stage is active)."""
+    fused = t.merged_sub_info.shape[-1] == 65536
+    if prefilter and fused:
+        packed = lpm_lookup_wide(
+            t.merged_root_info, t.merged_root_child, t.merged_sub_child,
+            t.merged_sub_info, peer_u32,
+        )
+        denied_pf = (packed & jnp.int32(DENY_BIT)) != 0
+        hit = packed & jnp.int32(MERGED_VALUE_MASK)
+        return denied_pf, hit
+    if prefilter:
+        denied_pf = lpm_lookup_wide(
+            t.pf_root_info, t.pf_root_child, t.pf_sub_child, t.pf_sub_info,
+            peer_u32,
+        ) > 0
+    else:
+        denied_pf = jnp.zeros(peer_u32.shape[0], jnp.bool_)
+    hit = lpm_lookup_wide(
+        t.ip_root_info, t.ip_root_child, t.ip_sub_child, t.ip_sub_info,
+        peer_u32,
+    )
+    return denied_pf, hit
 
 
 def _verdict_tail(
@@ -211,17 +256,7 @@ def process_flows_wide(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """IPv4 fast path over the wide tries — semantics identical to
     process_flows(levels=4), including the overlay row_override."""
-    if prefilter:
-        denied_pf = lpm_lookup_wide(
-            t.pf_root_info, t.pf_root_child, t.pf_sub_child, t.pf_sub_info,
-            peer_u32,
-        ) > 0
-    else:
-        denied_pf = jnp.zeros(peer_u32.shape[0], jnp.bool_)
-    hit = lpm_lookup_wide(
-        t.ip_root_info, t.ip_root_child, t.ip_sub_child, t.ip_sub_info,
-        peer_u32,
-    )
+    denied_pf, hit = _v4_lpm_stage(t, peer_u32, prefilter)
     peer_row = jnp.where(hit > 0, hit - 1, t.world_row)
     if row_override is not None:
         trusted = row_override >= 0
@@ -267,18 +302,7 @@ def process_flows_ct(
     from .device_ct import _ct_step_impl, pack_kc_words
 
     if family == 4:
-        denied_pf = (
-            lpm_lookup_wide(
-                t.pf_root_info, t.pf_root_child, t.pf_sub_child,
-                t.pf_sub_info, peer,
-            ) > 0
-            if prefilter
-            else jnp.zeros(peer.shape[0], jnp.bool_)
-        )
-        hit = lpm_lookup_wide(
-            t.ip_root_info, t.ip_root_child, t.ip_sub_child, t.ip_sub_info,
-            peer,
-        )
+        denied_pf, hit = _v4_lpm_stage(t, peer, prefilter)
         z = jnp.zeros_like(peer)
         ka_w, kb_w = (z, z), (z, peer)
     else:
@@ -559,11 +583,27 @@ class DatapathPipeline:
                     if ":" not in cidr
                     and (row := compiled.id_to_row.get(e.identity)) is not None
                 )
+                # fused deny+identity walk: only worth building when
+                # the deny stage is live and both layouts are flat
+                merged = (
+                    merge_flat_tries(ip_wide, pf_wide)
+                    if not self._pf_empty[0]
+                    else None
+                )
+                if merged is None:
+                    merged = (
+                        np.zeros(1, np.int32),
+                        np.zeros(1, np.int32),
+                        np.zeros((1, 1), np.int32),
+                        np.zeros((1, 1), np.int32),
+                    )
                 world_row = compiled.id_to_row.get(ID_WORLD)
                 if world_row is None:
                     raise RuntimeError("reserved:world identity has no device row")
                 self._tries = (
-                    tuple(jnp.asarray(a) for a in (*pf_wide, *ip_wide)),
+                    tuple(
+                        jnp.asarray(a) for a in (*pf_wide, *ip_wide, *merged)
+                    ),
                     tuple(jnp.asarray(a) for a in (*pf6, *ip6)),
                     jnp.asarray(np.int32(world_row)),
                 )
@@ -615,6 +655,10 @@ class DatapathPipeline:
                     ip_root_child=v4[5],
                     ip_sub_child=v4[6],
                     ip_sub_info=v4[7],
+                    merged_root_info=v4[8],
+                    merged_root_child=v4[9],
+                    merged_sub_child=v4[10],
+                    merged_sub_info=v4[11],
                     world_row=world,
                     policymap=mat.tables,
                 )

@@ -100,6 +100,7 @@ class PolicyEngine:
         self.delta_seq = 0
         self._delta_log: List[Tuple[int, str, tuple]] = []
         self._bg_refresh: Optional[threading.Thread] = None
+        self._install_gen = 0  # bumps on every _install_compiled
 
     # ------------------------------------------------------------------
     def _log_delta(self, kind: str, payload: tuple) -> None:
@@ -208,6 +209,7 @@ class PolicyEngine:
 
     def _install_compiled(self, compiled, state, sel_match, device) -> None:
         """Swap a computed full-refresh result in (lock held)."""
+        self._install_gen += 1
         self._device = device
         # np.array (copy): asarray on a device buffer is read-only and
         # the incremental paths mutate this in place.
@@ -453,11 +455,18 @@ class PolicyEngine:
         """Start (at most one) background full refresh (lock held)."""
         if self._bg_refresh is not None and self._bg_refresh.is_alive():
             return
+        gen = self._install_gen  # what the bg result would replace
 
         def run():
             try:
                 result = self._compute_full(self.repo, self.registry)
                 with self._lock:
+                    if self._install_gen != gen:
+                        # someone installed a NEWER compile while this
+                        # one ran (e.g. refresh(force=True)) — dropping
+                        # ours is the only safe move: installing would
+                        # roll enforcement back to an older rule set
+                        return
                     self._install_compiled(*result)
             except Exception as e:
                 # a failed background compile leaves the restored

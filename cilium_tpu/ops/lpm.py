@@ -374,6 +374,69 @@ def lpm_lookup_wide(
     return best
 
 
+# -- fused deny+identity walk (flat 16+16 layouts only) ---------------------
+#
+# The datapath's two v4 LPM walks — XDP deny trie and ipcache identity
+# trie — consume the same address bytes (bpf_xdp.c:97-156 then
+# bpf_netdev.c secctx). When BOTH tries use the dense flat layout their
+# tables merge ELEMENT-WISE into one packed table: identity row+1 in
+# the low bits, the deny verdict in one high bit — one 2-gather walk
+# returns both results, halving the pipeline's gather count.
+
+DENY_BIT = np.int32(1 << 30)
+MERGED_VALUE_MASK = np.int32((1 << 30) - 1)
+
+
+def _flat_value_grid(root_info, root_child, sub_info, his):
+    """For each hi16 in ``his`` → [len(his), 65536] resolved LPM values
+    (node entry where present, else the root's value — the flat
+    layout's exact lookup semantics, vectorized)."""
+    nodes = root_child[his]  # [H] node ids (0 = none)
+    grid = sub_info[nodes]  # [H, 65536] (row 0 is all-zero)
+    root_vals = root_info[his][:, None]  # [H, 1]
+    return np.where(grid > 0, grid, root_vals)
+
+
+def merge_flat_tries(ip_arrays, deny_arrays):
+    """(ip flat-trie arrays, deny flat-trie arrays) → merged flat
+    arrays, or None when either side uses the 16-8-8 pointer layout
+    (merging needs the dense form). Identity values must stay below
+    DENY_BIT."""
+    ip_ri, ip_rc, ip_sc, ip_si = ip_arrays
+    d_ri, d_rc, d_sc, d_si = deny_arrays
+    if ip_si.shape[-1] != 65536 or d_si.shape[-1] != 65536:
+        return None
+    if int(ip_si.max(initial=0)) >= int(DENY_BIT) or int(
+        ip_ri.max(initial=0)
+    ) >= int(DENY_BIT):
+        return None
+
+    # hi16 buckets where either side holds longer-than-/16 prefixes
+    his = np.union1d(np.nonzero(ip_rc)[0], np.nonzero(d_rc)[0]).astype(
+        np.int64
+    )
+    m = len(his) + 1
+    root_info = ip_ri.astype(np.int32).copy()
+    root_info |= np.where(d_ri > 0, DENY_BIT, 0).astype(np.int32)
+    root_child = np.zeros(65536, np.int32)
+    sub_info = np.zeros((m, 65536), np.int32)
+    if len(his):
+        root_child[his] = np.arange(1, m, dtype=np.int32)
+        ip_grid = _flat_value_grid(ip_ri, ip_rc, ip_si, his)
+        d_grid = _flat_value_grid(d_ri, d_rc, d_si, his)
+        sub_info[1:] = ip_grid | np.where(d_grid > 0, DENY_BIT, 0)
+        # a merged node must never fall back to the root (its grid is
+        # fully resolved); keep zero cells zero so "no match" stays 0 —
+        # they already are, because _flat_value_grid resolves them to
+        # the root value, which IS the correct fallback. But a cell
+        # whose resolved value is 0 (no identity, no deny) must not
+        # shadow the merged ROOT value either — it cannot, because the
+        # root fallback only applies when the node cell is 0, and the
+        # resolved grid equals that root fallback by construction.
+    sub_child = np.zeros((1, 65536), np.int32)  # flat-layout marker
+    return root_info, root_child, sub_child, sub_info
+
+
 def ipv4_to_bytes(addrs: np.ndarray) -> np.ndarray:
     """[B] uint32 host-order IPv4 → [B, 4] int32 big-endian bytes."""
     a = addrs.astype(np.uint32)

@@ -97,10 +97,13 @@ class TestDeviceFloors:
         if not on_accelerator:
             pytest.skip("device floor: accelerator regime only")
         repo, reg, idents, *_ = world
-        v4, _v6 = bench._bench_pipeline_e2e(
+        v4, _v6, pf = bench._bench_pipeline_e2e(
             repo, reg, idents, np.random.default_rng(13)
         )
         assert v4 >= 3e6, f"pipeline floor: {v4/1e6:.1f}M/s < 3M/s"
+        # the fused deny+identity walk must exist (pf > 0) and not be
+        # slower than half the deny-skipped chain
+        assert pf >= v4 / 2, f"fused-prefilter floor: {pf/1e6:.1f}M/s"
 
     def test_device_ct_floor(self, world, on_accelerator):
         """Fused device-CT datapath step ≥ 1M flows/s."""
