@@ -254,6 +254,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="spawn + supervise the per-node health endpoint "
                         "process (python -m cilium_tpu.health, the "
                         "cilium-health sidecar)")
+    d.add_argument("--launch-monitor", action="store_true",
+                   help="run the node monitor as its own supervised "
+                        "process (python -m cilium_tpu.monitor) so event "
+                        "streaming survives agent stalls "
+                        "(cilium-node-monitor role)")
     d.add_argument("--health-port", type=int, default=0,
                    help="health responder port (0 = ephemeral; the "
                         "reference's fixed port is 4240)")
@@ -502,8 +507,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                 run_interval=args.sync_interval,
             )
         server = APIServer(daemon, args.socket)
-        monitor = MonitorServer(daemon.monitor, args.socket + ".monitor")
-        monitor.start()
+        monitor = None
+        monitor_launcher = None
+        monitor_feeder = None
+        if args.launch_monitor:
+            # external monitor owns the client socket; the agent only
+            # FEEDS it — `cilium monitor` streams survive agent stalls
+            # (monitor/monitor.go:184 isolation)
+            from .monitor.standalone import MonitorFeeder
+            from .proxy.launcher import MonitorLauncher
+
+            monitor_launcher = MonitorLauncher(
+                args.socket + ".monitor", args.socket + ".monitor-feed"
+            ).start()
+            monitor_feeder = MonitorFeeder(
+                daemon.monitor, args.socket + ".monitor-feed"
+            ).start()
+        else:
+            monitor = MonitorServer(daemon.monitor, args.socket + ".monitor")
+            monitor.start()
         from .xds.server import XDSServer
 
         xds = XDSServer(daemon.xds_cache, args.socket + ".xds")
@@ -627,7 +649,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             if accesslog_rx is not None:
                 accesslog_rx.stop()
             xds.stop()
-            monitor.stop()
+            if monitor is not None:
+                monitor.stop()
+            if monitor_feeder is not None:
+                monitor_feeder.stop()
+            if monitor_launcher is not None:
+                monitor_launcher.stop()
             server.stop()
             if cluster_pump is not None:
                 cluster_pump.stop()  # BEFORE close: no pump mid-teardown

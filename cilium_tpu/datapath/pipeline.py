@@ -597,6 +597,17 @@ class DatapathPipeline:
                         np.zeros((1, 1), np.int32),
                         np.zeros((1, 1), np.int32),
                     )
+                else:
+                    # the fused table fully covers the deny stage, so
+                    # the standalone deny trie would never be read —
+                    # don't upload it (placeholders keep the pytree
+                    # shape-stable for the jit cache)
+                    pf_wide = (
+                        np.zeros(1, np.int32),
+                        np.zeros(1, np.int32),
+                        np.zeros((1, 1), np.int32),
+                        np.zeros((1, 1), np.int32),
+                    )
                 world_row = compiled.id_to_row.get(ID_WORLD)
                 if world_row is None:
                     raise RuntimeError("reserved:world identity has no device row")

@@ -26,6 +26,20 @@ class Subscription:
         self._cond = threading.Condition()
         self.lost = 0
         self.closed = False
+        self._passive = False
+
+    # a PASSIVE subscription still receives events but does not count
+    # toward hub.active — the standalone-monitor feeder sits here
+    # permanently and flips passive by downstream demand, so an
+    # unwatched datapath keeps skipping event construction. The flip
+    # routes through the hub so ``active`` stays an O(1) counter read.
+    @property
+    def passive(self) -> bool:
+        return self._passive
+
+    @passive.setter
+    def passive(self, value: bool) -> None:
+        self._hub._set_passive(self, value)
 
     def _push(self, ev) -> None:
         with self._cond:
@@ -60,24 +74,36 @@ class MonitorHub:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._subs: List[Subscription] = []
+        self._active_count = 0  # non-passive subscriptions
         self.published = 0
 
     @property
     def active(self) -> bool:
-        return bool(self._subs)
+        return self._active_count > 0  # O(1): read on the batch hot path
 
     def subscribe(self, capacity: int = 8192) -> Subscription:
         sub = Subscription(self, capacity)
         with self._lock:
             self._subs.append(sub)
+            self._active_count += 1
         return sub
+
+    def _set_passive(self, sub: Subscription, value: bool) -> None:
+        with self._lock:
+            if sub._passive == value or sub not in self._subs:
+                sub._passive = value
+                return
+            sub._passive = value
+            self._active_count += -1 if value else 1
 
     def _remove(self, sub: Subscription) -> None:
         with self._lock:
             try:
                 self._subs.remove(sub)
             except ValueError:
-                pass
+                return
+            if not sub._passive:
+                self._active_count -= 1
 
     def publish(self, ev) -> None:
         with self._lock:

@@ -27,6 +27,15 @@ class MonitorServer:
         self._sock: Optional[socket.socket] = None
         self._threads = []
         self._stop = threading.Event()
+        self.clients = 0
+        # serializes count updates AND their callbacks: two concurrent
+        # attach/detach threads must deliver count frames in the order
+        # the counts were computed, or the feeder's demand gate sticks
+        self._clients_lock = threading.Lock()
+        # fn(count) on every client attach/detach — the standalone
+        # monitor relays this to the agent so an unwatched datapath
+        # skips event construction
+        self.on_clients = None
 
     def start(self) -> None:
         if os.path.exists(self.socket_path):
@@ -54,19 +63,44 @@ class MonitorServer:
                 target=self._serve_client, args=(conn,), daemon=True
             ).start()
 
+    def _notify_clients(self, delta: int) -> None:
+        with self._clients_lock:
+            self.clients += delta
+            cb = self.on_clients
+            if cb is not None:
+                try:
+                    cb(self.clients)
+                except Exception:
+                    pass
+
     def _serve_client(self, conn: socket.socket) -> None:
         sub = self.hub.subscribe()
+        self._notify_clients(+1)
         try:
             while not self._stop.is_set():
                 ev = sub.next(timeout=0.2)
                 if ev is None:
+                    # idle: probe for disconnect — with no events to
+                    # send, a closed client would otherwise never be
+                    # noticed (the thread and its attach count leak;
+                    # clients send nothing, so any bytes are discarded)
+                    try:
+                        if conn.recv(64, socket.MSG_DONTWAIT) == b"":
+                            return
+                    except BlockingIOError:
+                        pass
                     continue
-                payload = encode(ev)
+                # the standalone monitor's feed publishes wire-encoded
+                # payloads straight through (no decode/re-encode)
+                payload = (
+                    ev if isinstance(ev, (bytes, bytearray)) else encode(ev)
+                )
                 conn.sendall(struct.pack("<I", len(payload)) + payload)
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
             sub.close()
+            self._notify_clients(-1)
             conn.close()
 
     def stop(self) -> None:

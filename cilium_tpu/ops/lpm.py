@@ -415,6 +415,11 @@ def merge_flat_tries(ip_arrays, deny_arrays):
     his = np.union1d(np.nonzero(ip_rc)[0], np.nonzero(d_rc)[0]).astype(
         np.int64
     )
+    if len(his) > FLAT_TRIE_MAX_NODES:
+        # the UNION can exceed the per-trie transfer budget even when
+        # each side fits — past it, the merged table costs more to
+        # rebuild/upload per churn than the second walk saves
+        return None
     m = len(his) + 1
     root_info = ip_ri.astype(np.int32).copy()
     root_info |= np.where(d_ri > 0, DENY_BIT, 0).astype(np.int32)

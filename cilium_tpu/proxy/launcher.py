@@ -136,6 +136,23 @@ class ProxyLauncher(ChildLauncher):
         super().__init__(argv, **kw)
 
 
+class MonitorLauncher(ChildLauncher):
+    """Supervised ``python -m cilium_tpu.monitor`` (the
+    cilium-node-monitor process the reference's agent launches,
+    monitor/monitor.go + pkg/launcher)."""
+
+    name = "node monitor"
+
+    def __init__(self, listen_socket: str, feed_socket: str, **kw) -> None:
+        super().__init__(
+            [
+                sys.executable, "-m", "cilium_tpu.monitor",
+                "--listen", listen_socket, "--feed", feed_socket,
+            ],
+            **kw,
+        )
+
+
 class HealthLauncher(ChildLauncher):
     """Supervised ``python -m cilium_tpu.health`` (the cilium-health
     sidecar the reference's agent launches at boot,

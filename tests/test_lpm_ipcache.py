@@ -456,14 +456,22 @@ class TestMergedDenyIdentityTrie:
         v_fused, r_fused, c_fused = process_flows_wide(
             t, peers, eps, dports, protos, ep_count=1, prefilter=True
         )
-        stripped = t.replace(
-            merged_root_info=jnp.zeros(1, jnp.int32),
-            merged_root_child=jnp.zeros(1, jnp.int32),
-            merged_sub_child=jnp.zeros((1, 1), jnp.int32),
-            merged_sub_info=jnp.zeros((1, 1), jnp.int32),
-        )
+        # a genuinely UNFUSED pipeline over the same world (fusion
+        # disabled → the classic two-walk tables get built/uploaded)
+        import cilium_tpu.datapath.pipeline as _pl
+
+        orig_merge = _pl.merge_flat_tries
+        _pl.merge_flat_tries = lambda *_a, **_k: None
+        try:
+            pipe_u = DatapathPipeline(engine, cache, pf, conntrack=None)
+            pipe_u.set_endpoints([idents[0].id])
+            pipe_u.rebuild()
+        finally:
+            _pl.merge_flat_tries = orig_merge
+        t_u = pipe_u._tables[(TRAFFIC_INGRESS, 4)]
+        assert t_u.merged_sub_info.shape[-1] == 1  # fusion absent
         v_base, r_base, c_base = process_flows_wide(
-            stripped, peers, eps, dports, protos, ep_count=1, prefilter=True
+            t_u, peers, eps, dports, protos, ep_count=1, prefilter=True
         )
         np.testing.assert_array_equal(np.asarray(v_fused), np.asarray(v_base))
         np.testing.assert_array_equal(np.asarray(r_fused), np.asarray(r_base))

@@ -329,3 +329,192 @@ class TestDissect:
         assert back.endpoint == 7 and back.data == frame
         assert back.orig_len == 1500
         assert "** capture ep 7 (1500 bytes): IP" in back.summary()
+
+
+class TestStandaloneMonitorProcess:
+    """The cilium-node-monitor split (monitor/monitor.go:184): the
+    monitor runs as its own process owning the client socket; the agent
+    only feeds events. Client streams must survive the agent dying."""
+
+    def test_events_flow_through_real_process(self, tmp_path):
+        import subprocess
+        import sys
+        import threading
+        import time as _time
+
+        from cilium_tpu.monitor import DropNotify
+        from cilium_tpu.monitor.hub import MonitorHub
+        from cilium_tpu.monitor.server import monitor_stream
+        from cilium_tpu.monitor.standalone import MonitorFeeder
+
+        def _drop(reason, ep):
+            return DropNotify(
+                reason=reason, endpoint=ep, src_identity=9,
+                family=4, peer_addr=b'\x08\x08\x08\x08', dport=80,
+                proto=6, ingress=True,
+            )
+
+        listen = str(tmp_path / "mon.sock")
+        feed = str(tmp_path / "mon.feed")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cilium_tpu.monitor",
+             "--listen", listen, "--feed", feed],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        try:
+            assert proc.stdout.readline().strip() == "READY"
+            got = []
+            done = threading.Event()
+
+            def client():
+                for ev in monitor_stream(listen, timeout=20.0):
+                    got.append(ev)
+                    if len(got) >= 3:
+                        done.set()
+                        return
+
+            t = threading.Thread(target=client, daemon=True)
+            t.start()
+            _time.sleep(0.3)  # client attached to the monitor process
+
+            # "agent" #1: hub + feeder
+            hub = MonitorHub()
+            feeder = MonitorFeeder(hub, feed, retry_s=0.1).start()
+            deadline = _time.monotonic() + 10
+            while feeder.reconnects == 0 and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            hub.publish(_drop(1, 7))
+            hub.publish(_drop(2, 7))
+
+            # agent "restart": the feeder dies, the CLIENT stays up
+            feeder.stop()
+            _time.sleep(0.2)
+            hub2 = MonitorHub()
+            feeder2 = MonitorFeeder(hub2, feed, retry_s=0.1).start()
+            deadline = _time.monotonic() + 10
+            while feeder2.reconnects == 0 and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            hub2.publish(_drop(3, 8))
+
+            assert done.wait(20), f"client saw only {len(got)} events"
+            reasons = [e.reason for e in got]
+            assert reasons == [1, 2, 3], reasons
+            assert got[2].endpoint == 8  # post-"restart" event arrived
+            feeder2.stop()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_daemon_launch_monitor_serves_cli_clients(self, tmp_path):
+        """Agent with --launch-monitor: `cilium monitor`-style clients
+        connect to the EXTERNAL process's socket and see datapath
+        events published by the agent."""
+        import os
+        import subprocess
+        import sys
+        import threading
+        import time as _time
+
+        from cilium_tpu.monitor.server import monitor_stream
+
+        sock = str(tmp_path / "agent.sock")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "cilium_tpu.cli", "--socket", sock,
+             "--state", str(tmp_path / "state"), "daemon",
+             "--launch-monitor"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        )
+        try:
+            deadline = _time.monotonic() + 60
+            while (
+                not os.path.exists(sock + ".monitor")
+                and _time.monotonic() < deadline
+            ):
+                _time.sleep(0.2)
+            got = []
+            seen = threading.Event()
+
+            def client():
+                for ev in monitor_stream(sock + ".monitor", timeout=30.0):
+                    got.append(ev)
+                    seen.set()
+                    return
+
+            t = threading.Thread(target=client, daemon=True)
+            t.start()
+            _time.sleep(0.5)
+
+            def cli(*args):
+                return subprocess.run(
+                    [sys.executable, "-m", "cilium_tpu.cli", "--socket",
+                     sock, *args],
+                    capture_output=True, text=True, timeout=60, env=env,
+                ).stdout
+
+            # endpoint lifecycle publishes AgentNotify events into
+            # the hub; the feeder relays them to the external monitor
+            import itertools
+
+            deadline = _time.monotonic() + 30
+            for i in itertools.count(7):
+                if seen.is_set() or _time.monotonic() > deadline:
+                    break
+                cli("endpoint", "add", str(i), "-l", "k8s:app=web",
+                    "--ipv4", f"10.200.0.{i}")
+                _time.sleep(0.3)
+            assert seen.is_set(), "no event reached the external monitor"
+        finally:
+            p.terminate()
+            p.wait(timeout=10)
+
+    def test_feeder_demand_gating(self, tmp_path):
+        """The feeder's permanent subscription must NOT open the
+        datapath's event-building gate: hub.active stays False until a
+        real monitor client attaches, goes True while one is watching,
+        and drops back after it leaves (client-count feedback over the
+        feed socket)."""
+        import subprocess
+        import sys
+        import time as _time
+
+        from cilium_tpu.monitor.hub import MonitorHub
+        from cilium_tpu.monitor.server import monitor_stream
+        from cilium_tpu.monitor.standalone import MonitorFeeder
+
+        listen = str(tmp_path / "mon.sock")
+        feed = str(tmp_path / "mon.feed")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cilium_tpu.monitor",
+             "--listen", listen, "--feed", feed],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        feeder = None
+        try:
+            assert proc.stdout.readline().strip() == "READY"
+            hub = MonitorHub()
+            feeder = MonitorFeeder(hub, feed, retry_s=0.1).start()
+            deadline = _time.monotonic() + 10
+            while feeder.reconnects == 0 and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            _time.sleep(0.3)
+            assert not hub.active, "feeder alone must not open the gate"
+
+            import socket as _socket
+
+            c = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            c.connect(listen)  # a watching client
+            deadline = _time.monotonic() + 10
+            while not hub.active and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            assert hub.active, "client attach never reached the agent"
+            c.close()
+            deadline = _time.monotonic() + 10
+            while hub.active and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            assert not hub.active, "client detach never reached the agent"
+        finally:
+            if feeder is not None:
+                feeder.stop()
+            proc.terminate()
+            proc.wait(timeout=10)
