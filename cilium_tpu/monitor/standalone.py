@@ -58,14 +58,27 @@ class StandaloneMonitor:
         self._feed_sock.settimeout(0.2)
 
     def _broadcast_clients(self, count: int) -> None:
+        """Push a demand frame to every feed. Runs under the server's
+        client lock (ordering guarantee), so sends must never block: a
+        stalled agent — the exact failure this process isolates — must
+        not wedge client attach/detach handling. An unwritable or
+        partially-written feed is closed; the feeder reconnects and
+        receives the then-current count."""
         frame = struct.pack("<I", count)
         with self._feed_lock:
             conns = list(self._feed_conns)
         for c in conns:
             try:
-                c.sendall(frame)
-            except OSError:
-                pass  # the pump's read side reaps dead feeds
+                n = c.send(frame, socket.MSG_DONTWAIT)
+            except (BlockingIOError, OSError):
+                n = -1
+            if n != len(frame):
+                # full buffer (dead agent) or torn frame (desync):
+                # drop the feed; its pump thread reaps it on read
+                try:
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
 
     def start(self) -> "StandaloneMonitor":
         self.server.start()
@@ -81,12 +94,17 @@ class StandaloneMonitor:
             except OSError:
                 return
             self.feeds_accepted += 1
-            with self._feed_lock:
-                self._feed_conns.append(conn)
-            try:  # tell the fresh agent the CURRENT demand right away
-                conn.sendall(struct.pack("<I", self.server.clients))
-            except OSError:
-                pass
+            # register + send the initial demand under the SERVER's
+            # client lock: a concurrent attach/detach broadcast must
+            # order strictly after this frame, or the feeder could end
+            # up trusting a stale count forever
+            with self.server._clients_lock:
+                with self._feed_lock:
+                    self._feed_conns.append(conn)
+                try:
+                    conn.sendall(struct.pack("<I", self.server.clients))
+                except OSError:
+                    pass
             threading.Thread(
                 target=self._pump_feed, args=(conn,), daemon=True
             ).start()
@@ -150,6 +168,10 @@ class MonitorFeeder:
         self._thread: Optional[threading.Thread] = None
         self.reconnects = 0
         self._demand_gen = 0  # bumps per feed connection
+        # makes the gen-check + passivity flip atomic: a stale demand
+        # thread must not overwrite the new connection's state between
+        # its check and its set
+        self._demand_lock = threading.Lock()
 
     def start(self) -> "MonitorFeeder":
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -181,10 +203,12 @@ class MonitorFeeder:
                 # generation token: a STALE demand thread from the
                 # previous connection must never flip passivity after
                 # this connection took over
-                self._demand_gen += 1
+                with self._demand_lock:
+                    self._demand_gen += 1
+                    gen = self._demand_gen
                 threading.Thread(
                     target=self._read_demand,
-                    args=(conn, sub, self._demand_gen), daemon=True,
+                    args=(conn, sub, gen), daemon=True,
                 ).start()
                 try:
                     while not self._stop.is_set():
@@ -226,13 +250,15 @@ class MonitorFeeder:
                 if frame is None:
                     return
                 (count,) = struct.unpack("<I", frame)
-                if gen == self._demand_gen:
-                    sub.passive = count == 0
+                with self._demand_lock:  # atomic gen-check + flip
+                    if gen == self._demand_gen:
+                        sub.passive = count == 0
         except OSError:
             pass
         finally:
-            if gen == self._demand_gen:
-                sub.passive = True
+            with self._demand_lock:
+                if gen == self._demand_gen:
+                    sub.passive = True
 
     def stop(self) -> None:
         self._stop.set()
