@@ -45,6 +45,7 @@ from ..ops.lpm import (
     lpm_lookup,
     lpm_lookup_wide,
     merge_flat_tries,
+    merge_trie_entries,
 )
 from ..ops.materialize import (
     EndpointPolicySnapshot,
@@ -68,7 +69,11 @@ class DatapathTables:
     """Device state for one address family + one traffic direction.
     Trie arrays are shared between the two directions' instances.
     ``*_common`` carry each trie's elided shared prefix bytes ([K]
-    int32, [0] = no elision) — compared vectorized, not walked."""
+    int32, [0] = no elision) — compared vectorized, not walked.
+    ``merged_*`` carry the fused deny+identity trie (one walk, both
+    answers — ops/lpm.py merge_trie_entries); presence is signalled by
+    the caller's static ``fused`` flag, the placeholders only keep the
+    pytree shape stable."""
 
     pf_child: jnp.ndarray
     pf_info: jnp.ndarray
@@ -76,6 +81,9 @@ class DatapathTables:
     ip_child: jnp.ndarray
     ip_info: jnp.ndarray
     ip_common: jnp.ndarray
+    merged_child: jnp.ndarray
+    merged_info: jnp.ndarray
+    merged_common: jnp.ndarray
     world_row: jnp.ndarray  # [] int32
     policymap: PolicymapTables
 
@@ -187,8 +195,34 @@ def _verdict_tail(
     return verdict, redirect, counters
 
 
+def _v6_lpm_stage(t, peer_bytes, levels: int, prefilter: bool, fused: bool):
+    """→ (denied_pf, hit) — the v6 twin of _v4_lpm_stage: with the
+    fused trie present and the deny stage active, ONE elided stride-8
+    walk answers both questions; ``fused`` is a static flag because the
+    stride-8 shapes can't disambiguate presence the way the flat
+    layout's 65536 width can."""
+    if prefilter and fused:
+        raw = _elided_lpm(
+            t.merged_child, t.merged_info, t.merged_common, peer_bytes,
+            levels,
+        )
+        packed = jnp.where(raw > 0, raw - 1, 0)
+        denied_pf = (packed & jnp.int32(DENY_BIT)) != 0
+        hit = packed & jnp.int32(MERGED_VALUE_MASK)
+        return denied_pf, hit
+    if prefilter:
+        denied_pf = _elided_lpm(
+            t.pf_child, t.pf_info, t.pf_common, peer_bytes, levels
+        ) > 0
+    else:
+        denied_pf = jnp.zeros(peer_bytes.shape[0], jnp.bool_)
+    hit = _elided_lpm(t.ip_child, t.ip_info, t.ip_common, peer_bytes, levels)
+    return denied_pf, hit
+
+
 @functools.partial(
-    jax.jit, static_argnames=("ep_count", "block", "levels", "prefilter")
+    jax.jit,
+    static_argnames=("ep_count", "block", "levels", "prefilter", "fused"),
 )
 def process_flows(
     t: DatapathTables,
@@ -200,6 +234,7 @@ def process_flows(
     block: int = 16384,  # measured-fastest lookup block (ops/lookup.py)
     levels: int = 4,
     prefilter: bool = True,
+    fused: bool = False,
     row_override: Optional[jnp.ndarray] = None,  # [B] int32, -1 = LPM
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """→ (verdict[B] int8, redirect[B] bool, counters [EP, 3] int32).
@@ -219,13 +254,7 @@ def process_flows(
     metricsmap accumulation, computed with a one-hot matmul so the
     scatter stays on the MXU.
     """
-    if prefilter:
-        denied_pf = _elided_lpm(
-            t.pf_child, t.pf_info, t.pf_common, peer_bytes, levels
-        ) > 0
-    else:
-        denied_pf = jnp.zeros(peer_bytes.shape[0], jnp.bool_)
-    hit = _elided_lpm(t.ip_child, t.ip_info, t.ip_common, peer_bytes, levels)
+    denied_pf, hit = _v6_lpm_stage(t, peer_bytes, levels, prefilter, fused)
     peer_row = jnp.where(hit > 0, hit - 1, t.world_row)
     if row_override is not None:
         trusted = row_override >= 0
@@ -269,7 +298,9 @@ def process_flows_wide(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("ep_count", "block", "prefilter", "levels", "family"),
+    static_argnames=(
+        "ep_count", "block", "prefilter", "levels", "family", "fused"
+    ),
     donate_argnums=(1,),
 )
 def process_flows_ct(
@@ -288,6 +319,7 @@ def process_flows_ct(
     prefilter: bool = True,
     levels: int = 4,
     family: int = 4,
+    fused: bool = False,  # v6 merged-trie presence (v4 routes by shape)
 ):
     """The FUSED datapath step with device-resident conntrack: CT
     probe (fwd + reply) → deny LPM → identity LPM → policymap lookup →
@@ -306,12 +338,7 @@ def process_flows_ct(
         z = jnp.zeros_like(peer)
         ka_w, kb_w = (z, z), (z, peer)
     else:
-        denied_pf = (
-            _elided_lpm(t.pf_child, t.pf_info, t.pf_common, peer, levels) > 0
-            if prefilter
-            else jnp.zeros(peer.shape[0], jnp.bool_)
-        )
-        hit = _elided_lpm(t.ip_child, t.ip_info, t.ip_common, peer, levels)
+        denied_pf, hit = _v6_lpm_stage(t, peer, levels, prefilter, fused)
         b32 = peer.astype(jnp.uint32)
 
         def word(i):
@@ -458,6 +485,15 @@ class DatapathPipeline:
         # end-to-end pipeline), matching the reference's no-op empty
         # XDP maps. Updated together with self._tables.
         self._pf_empty: Tuple[bool, bool] = (True, True)
+        self._v6_fused = False  # v6 merged deny+identity trie present
+        # ATOMIC read snapshot for the lock-free dispatch paths:
+        # (tables, pf_empty, v6_fused) swap together — reading them as
+        # separate attributes could pair a new flag with old tables
+        # (e.g. fused=True against placeholder merged arrays, which
+        # would resolve every v6 flow to world with no denies)
+        self._dp_state: Tuple[Dict, Tuple[bool, bool], bool] = (
+            {}, (True, True), False
+        )
         self._tries: Optional[Tuple] = None  # ((pf4, ip4), (pf6, ip6), world_row)
         self.counters = np.zeros((0, 3), np.int64)
 
@@ -553,27 +589,48 @@ class DatapathPipeline:
                 or not self._tables
             ):
                 _, pf_cidrs = self.prefilter.dump()
-                # IPv6: stride-8 tries with the shared prefix elided
-                # (pod allocations live under one /48-/64 — compare
-                # those bytes once instead of walking them)
-                pf6 = build_trie_elided(
-                    ((c, 0) for c in pf_cidrs if ":" in c), ipv6=True
-                )
-                ip6 = build_trie_elided(
-                    (
-                        (cidr, row)
-                        for cidr, e in self.ipcache.items()
-                        if ":" in cidr
-                        and (row := compiled.id_to_row.get(e.identity))
-                        is not None
-                    ),
-                    ipv6=True,
-                )
-                # IPv4 rides the wide (dense-16-bit-first) tries
+                # empty-set flags first: both families' fusion gates
+                # read them (an empty deny set skips the walk entirely)
                 self._pf_empty = (
                     not any(":" not in c for c in pf_cidrs),
                     not any(":" in c for c in pf_cidrs),
                 )
+                # IPv6: stride-8 tries with the shared prefix elided
+                # (pod allocations live under one /48-/64 — compare
+                # those bytes once instead of walking them)
+                pf6_list = [(c, 0) for c in pf_cidrs if ":" in c]
+                ip6_list = [
+                    (cidr, row)
+                    for cidr, e in self.ipcache.items()
+                    if ":" in cidr
+                    and (row := compiled.id_to_row.get(e.identity))
+                    is not None
+                ]
+                ip6 = build_trie_elided(ip6_list, ipv6=True)
+                # fused deny+identity v6 walk (one elided pass, both
+                # answers) — built only while the deny stage is live
+                merged6_list = (
+                    merge_trie_entries(ip6_list, pf6_list, ipv6=True)
+                    if not self._pf_empty[1]
+                    else None
+                )
+                placeholder6 = (
+                    np.zeros((1, 256), np.int32),
+                    np.zeros((1, 256), np.int32),
+                    np.zeros(0, np.int32),
+                )
+                if merged6_list is not None:
+                    merged6 = build_trie_elided(merged6_list, ipv6=True)
+                    self._v6_fused = True
+                    # the fused trie fully covers the deny stage (same
+                    # reasoning as the v4 pf_wide elision below): don't
+                    # build/upload the standalone deny trie
+                    pf6 = placeholder6
+                else:
+                    pf6 = build_trie_elided(pf6_list, ipv6=True)
+                    merged6 = placeholder6
+                    self._v6_fused = False
+                # IPv4 rides the wide (dense-16-bit-first) tries
                 pf_wide = build_wide_trie(
                     (c, 0) for c in pf_cidrs if ":" not in c
                 )
@@ -615,7 +672,7 @@ class DatapathPipeline:
                     tuple(
                         jnp.asarray(a) for a in (*pf_wide, *ip_wide, *merged)
                     ),
-                    tuple(jnp.asarray(a) for a in (*pf6, *ip6)),
+                    tuple(jnp.asarray(a) for a in (*pf6, *ip6, *merged6)),
                     jnp.asarray(np.int32(world_row)),
                 )
                 self._trie_versions = trie_versions
@@ -680,10 +737,14 @@ class DatapathPipeline:
                     ip_child=v6[3],
                     ip_info=v6[4],
                     ip_common=v6[5],
+                    merged_child=v6[6],
+                    merged_info=v6[7],
+                    merged_common=v6[8],
                     world_row=world,
                     policymap=mat.tables,
                 )
             self._tables = tables
+            self._dp_state = (tables, self._pf_empty, self._v6_fused)
             if self.counters.shape[0] != len(self._endpoints):
                 self.counters = np.zeros((len(self._endpoints), 3), np.int64)
             return self._tables
@@ -826,7 +887,11 @@ class DatapathPipeline:
         row_override: Optional[np.ndarray] = None,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
-        t = self._tables[(direction, family)]
+        # ONE atomic snapshot read: tables + flags swap together in
+        # rebuild(), so fused-ness always matches the tables it
+        # describes (a separate-attribute read could pair them stale)
+        tables_map, pf_empty, v6_fused = self._dp_state
+        t = tables_map[(direction, family)]
         b = peer_bytes.shape[0]
         if pad_to is not None and pad_to > b:
             peer_bytes, ep_idx, dports, protos, row_override = _pad_flows(
@@ -837,7 +902,7 @@ class DatapathPipeline:
         # XDP prefilter guards traffic entering the node only, and an
         # empty deny set skips the walk entirely (it's one of the two
         # LPM walks that dominate the pipeline)
-        pf_stage = ingress and not self._pf_empty[0 if family == 4 else 1]
+        pf_stage = ingress and not pf_empty[0 if family == 4 else 1]
         if family == 4:
             peer_u32 = _pack_v4_u32(peer_bytes)
             v, red, counters = process_flows_wide(
@@ -860,6 +925,7 @@ class DatapathPipeline:
                 ep_count=max(1, len(self._endpoints)),
                 levels=16,
                 prefilter=pf_stage,
+                fused=v6_fused,
                 row_override=ro,
             )
         return (
@@ -1107,7 +1173,10 @@ class DatapathPipeline:
         from .device_ct import make_state
 
         direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
-        t = self._tables[(direction, family)]
+        # same atomic snapshot rule as _dispatch (fused flag must match
+        # the tables it was computed with)
+        tables_map, pf_empty, v6_fused = self._dp_state
+        t = tables_map[(direction, family)]
         b = peer_bytes.shape[0]
         pad = _bucket(b) - b
         valid = np.zeros(b + pad, bool)
@@ -1135,10 +1204,11 @@ class DatapathPipeline:
                 ep_count=max(1, len(self._endpoints)),
                 prefilter=(
                     ingress
-                    and not self._pf_empty[0 if family == 4 else 1]
+                    and not pf_empty[0 if family == 4 else 1]
                 ),
                 levels=16,
                 family=family,
+                fused=v6_fused if family == 6 else False,
             )
             self._device_ct = new_state
             counters = np.asarray(counters)

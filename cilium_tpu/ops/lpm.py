@@ -374,6 +374,84 @@ def lpm_lookup_wide(
     return best
 
 
+# -- fused deny+identity walk (v6 stride-8 elided tries) --------------------
+
+
+class _HostLPM:
+    """Host-side LPM oracle over one prefix set: per-plen exact-match
+    dicts, queried longest-first. O(#distinct plens) per query — the
+    merge below asks it once per union prefix."""
+
+    def __init__(self, entries) -> None:  # [(packed_bytes, plen, value)]
+        self._by_plen: Dict[int, Dict[bytes, int]] = {}
+        for packed, plen, value in entries:
+            masked = _mask_bytes(packed, plen)
+            self._by_plen.setdefault(plen, {})[masked] = value
+        self._plens = sorted(self._by_plen, reverse=True)
+
+    def lookup(self, packed: bytes, plen: int) -> int:
+        """Longest match covering prefix (packed/plen) → value+1, 0 =
+        none. Only prefixes of length ≤ plen can cover it."""
+        for p in self._plens:
+            if p > plen:
+                continue
+            hit = self._by_plen[p].get(_mask_bytes(packed, p))
+            if hit is not None:
+                return hit + 1
+        return 0
+
+
+def _mask_bytes(packed: bytes, plen: int) -> bytes:
+    full, rem = divmod(plen, 8)
+    out = bytearray(len(packed))
+    out[:full] = packed[:full]
+    if rem and full < len(packed):
+        out[full] = packed[full] & (0xFF << (8 - rem)) & 0xFF
+    return bytes(out)
+
+
+def merge_trie_entries(ip_prefixes, deny_prefixes, *, ipv6=True):
+    """[(cidr, value)] identity + [(cidr, _)] deny → ONE packed prefix
+    list [(cidr, packed_value)] whose LPM equals BOTH sides' LPMs at
+    every address: packed = (identity value+1) | DENY_BIT·denied.
+
+    Every union prefix carries the OTHER side's LPM answer at that
+    point, so a longer prefix from one side cannot shadow the other
+    side's match (the correctness trap of a naive set union). Feed the
+    result to build_trie_elided for the fused stride-8 walk."""
+    def parse(prefixes):
+        out = []
+        for cidr, value in prefixes:
+            net = ipaddress.ip_network(cidr, strict=False)
+            if (net.version == 6) != ipv6:
+                continue
+            out.append((net.network_address.packed, net.prefixlen, value))
+        return out
+
+    ip_entries = parse(ip_prefixes)
+    deny_entries = parse(deny_prefixes)
+    ip_lpm = _HostLPM(ip_entries)
+    deny_lpm = _HostLPM(deny_entries)
+    union: Dict[Tuple[bytes, int], int] = {}
+    for packed, plen, _v in ip_entries + deny_entries:
+        key = (_mask_bytes(packed, plen), plen)
+        if key in union:
+            continue
+        ip_v = ip_lpm.lookup(packed, plen)  # value+1, 0 = none
+        if ip_v >= int(DENY_BIT) - 1:
+            # packing range: the trie stores (ip_v | DENY_BIT) + 1,
+            # which must stay inside int32 — the -1 keeps the denied
+            # boundary case from overflowing
+            return None
+        denied = deny_lpm.lookup(packed, plen) > 0
+        union[key] = ip_v | (int(DENY_BIT) if denied else 0)
+    out = []
+    for (packed, plen), pv in union.items():
+        addr = ipaddress.ip_address(packed)
+        out.append((f"{addr}/{plen}", pv))
+    return out
+
+
 # -- fused deny+identity walk (flat 16+16 layouts only) ---------------------
 #
 # The datapath's two v4 LPM walks — XDP deny trie and ipcache identity

@@ -478,3 +478,153 @@ class TestMergedDenyIdentityTrie:
         np.testing.assert_array_equal(np.asarray(c_fused), np.asarray(c_base))
         # the batch exercises allow, policy-deny, AND prefilter-drop
         assert len(set(np.asarray(v_fused).tolist())) >= 3
+
+
+class TestMergedV6Trie:
+    """The fused v6 deny+identity elided walk (ops/lpm.py
+    merge_trie_entries → build_trie_elided): one stride-8 pass must
+    agree with the two classic walks on every address."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_merged_v6_parity_fuzz(self, seed):
+        from cilium_tpu.ops.lpm import (
+            DENY_BIT,
+            MERGED_VALUE_MASK,
+            build_trie_elided,
+            lpm_lookup,
+            merge_trie_entries,
+        )
+
+        rng = np.random.default_rng(seed)
+        ip_prefixes = []
+        for i in range(400):
+            a, b = int(rng.integers(0, 4)), int(rng.integers(0, 256))
+            ip_prefixes.append(
+                (f"fd00:{a:x}::{b:x}:{int(rng.integers(1, 255)):x}/128",
+                 i + 1)
+            )
+        ip_prefixes += [("fd00:9::/32", 9000), ("2001:db8::/32", 9001)]
+        deny = [
+            ("fd00:1::/32", 0),              # whole identity /32 denied
+            (f"fd00:2::{int(rng.integers(0, 256)):x}:0/112", 0),
+            ("2001:db8:dead::/48", 0),       # inside a broad identity
+            ("fc00::/7", 0),                 # covers everything fd00::
+        ]
+        if seed == 2:
+            deny = deny[:2]  # variant without the broad /7
+        ipa = build_trie_elided(ip_prefixes, ipv6=True)
+        dna = build_trie_elided(deny, ipv6=True)
+        merged_list = merge_trie_entries(ip_prefixes, deny, ipv6=True)
+        assert merged_list is not None
+        mrg = build_trie_elided(merged_list, ipv6=True)
+
+        def walk(arrays, q):
+            child, info, common = [jnp.asarray(a) for a in arrays]
+            k = common.shape[0]
+            hit = lpm_lookup(child, info, q[:, k:], levels=16 - k)
+            if k:
+                ok = jnp.all(q[:, :k] == common[None, :], axis=1)
+                hit = jnp.where(ok, hit, 0)
+            return np.asarray(hit)
+
+        b = 2048
+        pool = []
+        for cidr, _v in ip_prefixes + deny:
+            base = ipaddress.ip_network(cidr, strict=False).network_address
+            pool.append(base.packed)
+            pool.append((int(base) + 1).to_bytes(16, "big"))
+        qs = [pool[int(i)] for i in rng.integers(0, len(pool), b // 2)]
+        qs += [bytes(rng.integers(0, 256, 16, dtype=np.uint8).tolist())
+               for _ in range(b // 2)]
+        q = jnp.asarray(np.array([list(x) for x in qs], np.int32))
+
+        base_hit = walk(ipa, q)
+        base_deny = walk(dna, q) > 0
+        raw = walk(mrg, q)
+        packed = np.where(raw > 0, raw - 1, 0)
+        np.testing.assert_array_equal(packed & MERGED_VALUE_MASK, base_hit)
+        np.testing.assert_array_equal((packed & DENY_BIT) != 0, base_deny)
+        quads = {(bool(h), bool(d)) for h, d in zip(base_hit > 0, base_deny)}
+        assert len(quads) >= 3, quads
+
+    def test_pipeline_v6_fused_matches_unfused(self):
+        """process_flows with fused=True over the built merged tables
+        must equal fused=False over the classic tables, end to end."""
+        from cilium_tpu.datapath.pipeline import (
+            TRAFFIC_INGRESS,
+            DatapathPipeline,
+            process_flows,
+        )
+        from cilium_tpu.engine import PolicyEngine
+        from cilium_tpu.identity import IdentityRegistry
+        from cilium_tpu.ipcache.ipcache import IPCache
+        from cilium_tpu.ipcache.prefilter import PreFilter
+        from cilium_tpu.labels import parse_label_array
+        from cilium_tpu.policy.api import EndpointSelector, IngressRule, rule
+        from cilium_tpu.policy.repository import Repository
+
+        repo = Repository()
+        repo.add_list([rule(
+            ["k8s:app=web"],
+            ingress=[IngressRule(from_endpoints=(
+                EndpointSelector.make(["k8s:app=client"]),
+            ))],
+        )])
+        reg = IdentityRegistry()
+        idents = [
+            reg.allocate(parse_label_array([f"k8s:app={n}"]))
+            for n in ("web", "client", "other")
+        ]
+        engine = PolicyEngine(repo, reg)
+        cache = IPCache()
+        for i, ident in enumerate(idents):
+            cache.upsert(f"fd00::{i + 1}/128", ident.id, source="k8s")
+        pf = PreFilter()
+        pf.insert(pf.revision, ["fd00::3/128", "2001:db8::/32"])
+        pipe = DatapathPipeline(engine, cache, pf, conntrack=None)
+        pipe.set_endpoints([idents[0].id])
+        pipe.rebuild()
+        assert pipe._v6_fused, "v6 fusion not built"
+        t = pipe._tables[(TRAFFIC_INGRESS, 6)]
+
+        rng = np.random.default_rng(6)
+        b = 1024
+        pool = []
+        for tail in (1, 2, 3):
+            a = bytearray(16); a[0] = 0xFD; a[15] = tail
+            pool.append(bytes(a))
+        bad = bytearray(16); bad[0] = 0x20; bad[1] = 0x01
+        bad[2] = 0x0D; bad[3] = 0xB8; bad[15] = 9
+        pool.append(bytes(bad))
+        unk = bytearray(16); unk[0] = 0xFE; unk[15] = 7
+        pool.append(bytes(unk))
+        qs = [pool[int(i)] for i in rng.integers(0, len(pool), b)]
+        peers = jnp.asarray(np.array([list(x) for x in qs], np.int32))
+        eps = jnp.asarray(np.zeros(b, np.int32))
+        dports = jnp.asarray(np.full(b, 80, np.int32))
+        protos = jnp.asarray(np.full(b, 6, np.int32))
+        kw = dict(ep_count=1, levels=16, prefilter=True)
+        v_f, r_f, c_f = process_flows(
+            t, peers, eps, dports, protos, fused=True, **kw
+        )
+        # genuinely UNFUSED pipeline (fusion disabled → the classic
+        # deny trie gets built; the fused pipeline elides it)
+        import cilium_tpu.datapath.pipeline as _pl
+
+        orig = _pl.merge_trie_entries
+        _pl.merge_trie_entries = lambda *_a, **_k: None
+        try:
+            pipe_u = DatapathPipeline(engine, cache, pf, conntrack=None)
+            pipe_u.set_endpoints([idents[0].id])
+            pipe_u.rebuild()
+        finally:
+            _pl.merge_trie_entries = orig
+        assert not pipe_u._v6_fused
+        t_u = pipe_u._tables[(TRAFFIC_INGRESS, 6)]
+        v_b, r_b, c_b = process_flows(
+            t_u, peers, eps, dports, protos, fused=False, **kw
+        )
+        np.testing.assert_array_equal(np.asarray(v_f), np.asarray(v_b))
+        np.testing.assert_array_equal(np.asarray(r_f), np.asarray(r_b))
+        np.testing.assert_array_equal(np.asarray(c_f), np.asarray(c_b))
+        assert len(set(np.asarray(v_f).tolist())) >= 3
