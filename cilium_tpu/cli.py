@@ -215,6 +215,10 @@ def build_parser() -> argparse.ArgumentParser:
     hl = sub.add_parser("health", help="node connectivity status")
     hl.add_argument("--probe", action="store_true",
                     help="run an immediate probe sweep first")
+    hl.add_argument("--sidecar", action="store_true",
+                    help="query the standalone health-endpoint process "
+                         "(<socket>.health — the cilium-health CLI role) "
+                         "instead of the agent's in-process prober")
 
     bt = sub.add_parser("bugtool", help="archive daemon state for support")
     bt.add_argument("--output", default="",
@@ -948,7 +952,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             _print(s.policymap_get(args.endpoint, egress=args.egress))
     elif args.cmd == "health":
-        _print(s.health_probe() if args.probe else s.health())
+        if args.sidecar:
+            from .health.standalone import HealthAPIClient
+
+            hpath = args.socket + ".health"
+            if not os.path.exists(hpath):
+                print(f"no health socket at {hpath} (daemon running "
+                      "with --launch-health?)", file=sys.stderr)
+                return 1
+            hc = HealthAPIClient(hpath)
+            try:
+                if args.probe:
+                    hc.probe()
+                _print(hc.status())
+            except OSError as e:
+                print(f"health sidecar unreachable: {e}", file=sys.stderr)
+                return 1
+        else:
+            _print(s.health_probe() if args.probe else s.health())
     elif args.cmd == "bugtool":
         import time as _time
 

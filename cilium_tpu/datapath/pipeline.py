@@ -484,6 +484,10 @@ class DatapathPipeline:
         # otherwise cost as much as the identity walk — half the
         # end-to-end pipeline), matching the reference's no-op empty
         # XDP maps. Updated together with self._tables.
+        # REBUILD-INTERNAL: these two feed the _dp_state snapshot below
+        # and are only safe to read directly in single-threaded contexts
+        # (tests, bench setup). Dispatch paths MUST read _dp_state — a
+        # separate-attribute read can pair a fresh flag with old tables.
         self._pf_empty: Tuple[bool, bool] = (True, True)
         self._v6_fused = False  # v6 merged deny+identity trie present
         # ATOMIC read snapshot for the lock-free dispatch paths:

@@ -753,3 +753,126 @@ class TestReviewRegressions:
             c.close()
         finally:
             proxy.close()
+
+
+class TestUpgradeTunnel:
+    def test_101_switching_protocols_tunnels_raw_bytes(self, control_plane):
+        """An allowed Upgrade exchange: the upstream's 101 hands the
+        connection to a raw bidirectional tunnel (WebSocket shape) —
+        bytes flow both ways with no HTTP framing."""
+        cache, xds_path, al_path, sink = control_plane
+        proxy_port = _free_port()
+        _publish(cache, proxy_port, [
+            {"path": "/ws/.*", "remote_policies": [CLIENT_IDENTITY]}
+        ])
+        up_srv = socket.socket()
+        up_srv.bind(("127.0.0.1", 0))
+        up_srv.listen(1)
+        up_srv.settimeout(15)
+        served = []
+
+        def upstream():
+            try:
+                conn, _ = up_srv.accept()
+            except OSError:
+                return
+            conn.settimeout(10)
+            buf = b""
+            try:
+                while b"\r\n\r\n" not in buf:
+                    buf += conn.recv(4096)
+                served.append(buf)
+                conn.sendall(
+                    b"HTTP/1.1 101 Switching Protocols\r\n"
+                    b"Upgrade: websocket\r\nConnection: Upgrade\r\n\r\n"
+                )
+                # post-upgrade: echo frames with a marker, then push one
+                # unsolicited server->client message
+                data = conn.recv(4096)
+                conn.sendall(b"echo:" + data)
+                conn.sendall(b"server-push")
+                conn.recv(4096)  # wait for client close
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+        t = threading.Thread(target=upstream, daemon=True)
+        t.start()
+        proxy = StandaloneProxy(
+            xds_path, al_path, upstream=up_srv.getsockname()
+        )
+        try:
+            assert proxy.wait_ready()
+            c = socket.create_connection(("127.0.0.1", proxy_port), timeout=15)
+            c.settimeout(15)
+            c.sendall(
+                b"GET /ws/chat HTTP/1.1\r\nHost: h\r\n"
+                b"Upgrade: websocket\r\nConnection: Upgrade\r\n\r\n"
+            )
+            head = b""
+            while b"\r\n\r\n" not in head:
+                head += c.recv(4096)
+            assert b" 101 " in head
+            # raw bytes AFTER the upgrade: no HTTP parsing in the way
+            c.sendall(b"\x81\x05hello")  # arbitrary non-HTTP bytes
+            got = b""
+            while b"server-push" not in got:
+                chunk = c.recv(4096)
+                if not chunk:
+                    break
+                got += chunk
+            assert got.startswith(b"echo:\x81\x05hello"), got
+            assert b"server-push" in got
+            c.close()
+            assert served and b"/ws/chat" in served[0]
+        finally:
+            proxy.close()
+            up_srv.close()
+
+    def test_denied_upgrade_never_reaches_upstream(self, control_plane):
+        cache, xds_path, al_path, sink = control_plane
+        proxy_port = _free_port()
+        _publish(cache, proxy_port, [
+            {"path": "/ws/.*", "remote_policies": [CLIENT_IDENTITY]}
+        ])
+        reached = []
+        up_srv = socket.socket()
+        up_srv.bind(("127.0.0.1", 0))
+        up_srv.listen(1)
+        up_srv.settimeout(3)
+
+        def upstream():
+            try:
+                conn, _ = up_srv.accept()
+                reached.append(True)
+                conn.close()
+            except OSError:
+                pass
+
+        t = threading.Thread(target=upstream, daemon=True)
+        t.start()
+        proxy = StandaloneProxy(
+            xds_path, al_path, upstream=up_srv.getsockname()
+        )
+        try:
+            assert proxy.wait_ready()
+            c = socket.create_connection(("127.0.0.1", proxy_port), timeout=10)
+            c.settimeout(10)
+            c.sendall(
+                b"GET /admin/socket HTTP/1.1\r\nHost: h\r\n"
+                b"Upgrade: websocket\r\nConnection: Upgrade\r\n\r\n"
+            )
+            d = b""
+            while b"\r\n\r\n" not in d:
+                chunk = c.recv(4096)
+                if not chunk:
+                    break
+                d += chunk
+            assert b" 403 " in d
+            c.close()
+            time.sleep(0.5)
+            assert not reached, "denied upgrade reached the upstream"
+        finally:
+            proxy.close()
+            up_srv.close()
