@@ -960,12 +960,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"no health socket at {hpath} (daemon running "
                       "with --launch-health?)", file=sys.stderr)
                 return 1
+            from .api.client import APIError
+
             hc = HealthAPIClient(hpath)
             try:
                 if args.probe:
                     hc.probe()
                 _print(hc.status())
-            except OSError as e:
+            except (OSError, APIError, ValueError) as e:
                 print(f"health sidecar unreachable: {e}", file=sys.stderr)
                 return 1
         else:

@@ -2,10 +2,14 @@
 
 Reference: plugins/cilium-cni/cilium-cni.go — ADD creates the veth
 pair, asks the daemon for an IP (POST /ipam), then registers the
-endpoint (PUT /endpoint/{id}); DEL is symmetric. Here the "interface"
-is virtual (no kernel), but the command flow, result shape, and
-failure cleanup mirror the CNI contract so an orchestrator-side
-integration drives the same steps.
+endpoint (PUT /endpoint/{id}); DEL is symmetric.
+
+Interfaces are REAL when a target netns is given and the host allows
+it (plugins/netns.py: veth pair, container end as eth0 with the
+allocated address, default route via the host end — the cilium
+point-to-point LXC device model); without a netns (or capability) the
+flow stays virtual with the same command sequence, result shape, and
+failure cleanup.
 """
 
 from __future__ import annotations
@@ -37,12 +41,34 @@ def cni_add(
     *,
     labels: Optional[List[str]] = None,
     ifname: str = "eth0",
+    netns: Optional[str] = None,
 ) -> CNIResult:
     """CNI ADD: allocate an IP, register the endpoint, return the
-    result. On endpoint-registration failure the allocated IP is
-    released (the reference releases IPAM on error too)."""
+    result. With ``netns``, also create the REAL veth pair (host side
+    lxc<epid>, container side ``ifname`` inside the netns carrying the
+    address). On any failure, everything already created is rolled
+    back (the reference releases IPAM and deletes the link on error
+    too)."""
     ep_id = endpoint_id_for(container_id)
     ip = daemon.ipam.allocate_next(owner=container_id)
+    host_if = f"lxc{ep_id}"[:15]
+    gateway = str(daemon.ipam.net.network_address + 1)
+    if netns is not None:
+        from . import netns as nsmod
+
+        try:
+            # /32 on the container side: the cilium point-to-point LXC
+            # model — NO connected subnet route, so even same-pod-CIDR
+            # peers route via the gateway (the host veth), which is
+            # where enforcement sits (cilium-cni.go configures the
+            # endpoint address exactly this way)
+            nsmod.create_endpoint_veth(
+                host_if, netns, f"{ip}/32",
+                container_if=ifname, gateway=gateway,
+            )
+        except Exception as e:
+            daemon.ipam.release(ip)
+            raise CNIError(f"interface create failed: {e}") from e
     try:
         daemon.endpoint_add(
             ep_id,
@@ -51,20 +77,32 @@ def cni_add(
             pod_name=container_id,
         )
     except Exception as e:
+        if netns is not None:
+            from . import netns as nsmod
+
+            nsmod.delete_link(host_if)
         daemon.ipam.release(ip)
         raise CNIError(f"endpoint create failed: {e}") from e
     return CNIResult(
         endpoint_id=ep_id,
         ipv4=ip,
-        interface=f"lxc{ep_id}",
-        gateway=str(daemon.ipam.net.network_address + 1),
+        interface=host_if,
+        gateway=gateway,
     )
 
 
 def cni_del(daemon, container_id: str) -> bool:
-    """CNI DEL: tear down the endpoint and release its IP. Idempotent
-    (the CNI spec requires DEL to succeed for unknown containers)."""
+    """CNI DEL: tear down the endpoint, its host interface (if one was
+    plumbed — deleting the host end kills both sides of the veth), and
+    release its IP. Idempotent (the CNI spec requires DEL to succeed
+    for unknown containers)."""
     ep_id = endpoint_id_for(container_id)
+    from . import netns as nsmod
+
+    # unconditional: delete_link never raises (no-op on ip-less hosts),
+    # and gating on the capability probe could leak veths if the probe
+    # false-negatives after ADDs succeeded
+    nsmod.delete_link(f"lxc{ep_id}"[:15])
     # endpoint_delete releases the endpoint's IPAM address itself; a
     # second release here would race a concurrent ADD that was just
     # handed the freed address and release it out from under the new
