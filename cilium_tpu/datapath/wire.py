@@ -25,6 +25,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..monitor.dissect import l2_offsets
+
 ETH_P_ALL = 0x0003
 ETH_P_IP = 0x0800
 
@@ -36,21 +38,15 @@ def parse_ipv4_frame(frame: bytes) -> Optional[Tuple[int, int, int, int, int]]:
     for non-IPv4 / truncated frames. Ports are 0 for non-TCP/UDP and
     for non-first fragments (their payload bytes are NOT L4 headers).
 
-    This is the hot-loop tuple extractor; monitor/dissect.py is the
-    human-facing dissector (summaries, deep truncation tolerance) —
-    a fix to either's framing rules likely belongs in both."""
-    if len(frame) < 34:
+    L2 framing (ethertype / 802.1Q / truncation) comes from the shared
+    monitor.dissect.l2_offsets rules; this is just the hot-loop tuple
+    extraction on top."""
+    l2 = l2_offsets(frame)
+    if l2 is None:
         return None
-    off = 12
-    (ethertype,) = struct.unpack_from(">H", frame, off)
-    if ethertype == 0x8100:  # one 802.1Q tag
-        off += 4
-        if len(frame) < off + 22:
-            return None
-        (ethertype,) = struct.unpack_from(">H", frame, off)
-    if ethertype != ETH_P_IP:
+    ethertype, ip0, _vlan = l2
+    if ethertype != ETH_P_IP or len(frame) < ip0 + 20:
         return None
-    ip0 = off + 2
     ihl = (frame[ip0] & 0x0F) * 4
     if ihl < 20 or len(frame) < ip0 + ihl:
         return None

@@ -93,6 +93,27 @@ def _mac(b: bytes) -> str:
     return ":".join(f"{x:02x}" for x in b)
 
 
+def l2_offsets(data: bytes):
+    """Shared L2 framing rules: Ethernet frame → (ethertype, l3_offset,
+    vlan_id_or_None), or None when the frame is cut before the payload
+    ethertype is knowable. ONE definition of the ethertype/802.1Q/
+    truncation handling — both the human-facing dissector below and the
+    hot-path tuple extractor (datapath/wire.py) build on it, so a
+    framing fix lands in exactly one place."""
+    if len(data) < 14:
+        return None
+    (etype,) = struct.unpack(">H", data[12:14])
+    off = 14
+    vlan = None
+    if etype == ETH_P_8021Q:
+        if len(data) < 18:
+            return None  # cut inside the VLAN tag
+        (tci, etype) = struct.unpack(">HH", data[14:18])
+        vlan = tci & 0x0FFF
+        off = 18
+    return etype, off, vlan
+
+
 def dissect(data: bytes) -> Dissection:
     """Decode one Ethernet frame, best-effort: truncated packets keep
     whatever layers fit (the monitor must never crash on a capture)."""
@@ -102,17 +123,15 @@ def dissect(data: bytes) -> Dissection:
         return d
     d.dst_mac = _mac(data[0:6])
     d.src_mac = _mac(data[6:12])
-    (etype,) = struct.unpack(">H", data[12:14])
-    off = 14
-    if etype == ETH_P_8021Q:
-        if len(data) < 18:
-            # cut inside the VLAN tag: the payload ethertype is gone
-            d.ethertype = etype
-            d.truncated = True
-            return d
-        (tci, etype) = struct.unpack(">HH", data[14:18])
-        d.vlan = tci & 0x0FFF
-        off = 18
+    l2 = l2_offsets(data)
+    if l2 is None:
+        # cut inside the VLAN tag: the payload ethertype is gone
+        (d.ethertype,) = struct.unpack(">H", data[12:14])
+        d.truncated = True
+        return d
+    etype, off, vlan = l2
+    if vlan is not None:
+        d.vlan = vlan
     d.ethertype = etype
     if etype == ETH_P_ARP:
         return _arp(d, data[off:])

@@ -110,8 +110,10 @@ def create_endpoint_veth(
                  check=False)
             _run("-n", netns, "route", "add", gateway, "dev", container_if)
             _run("-n", netns, "route", "add", "default", "via", gateway)
-    except NetnsError:
-        _run("link", "del", host_if, check=False)
+    except (NetnsError, OSError, subprocess.TimeoutExpired):
+        # ANY mid-sequence failure must remove the host link — a
+        # leaked lxc* would make every ADD retry fail with EEXIST
+        delete_link(host_if)
         raise
 
 

@@ -1,0 +1,149 @@
+"""The CNI executable protocol against a REAL agent: invoked exactly
+as kubelet invokes it — CNI_* env, config on stdin, JSON on stdout —
+with real netns/veth plumbing (skipped on incapable hosts).
+
+Reference: plugins/cilium-cni/cilium-cni.go + the CNI spec's
+ADD/DEL/CHECK/VERSION contract."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+
+import pytest
+
+from cilium_tpu.plugins import netns as nsmod
+
+pytestmark = pytest.mark.skipif(
+    not nsmod.have_netns(), reason="no netns/veth capability"
+)
+
+
+def _invoke(command: str, sock: str, container_id: str, netns_path: str = "",
+            cni_args: str = ""):
+    env = dict(os.environ)
+    env.update({
+        "CNI_COMMAND": command,
+        "CNI_CONTAINERID": container_id,
+        "CNI_IFNAME": "eth0",
+        "CNI_PATH": "/opt/cni/bin",
+        "JAX_PLATFORMS": "cpu",
+    })
+    if netns_path:
+        env["CNI_NETNS"] = netns_path
+    if cni_args:
+        env["CNI_ARGS"] = cni_args
+    conf = json.dumps({
+        "cniVersion": "0.4.0", "name": "cilium-tpu", "type": "cilium-tpu",
+        "socket": sock,
+    })
+    return subprocess.run(
+        [sys.executable, "-m", "cilium_tpu.plugins.cni_exec"],
+        input=conf, capture_output=True, text=True, timeout=90, env=env,
+    )
+
+
+@pytest.fixture
+def agent(tmp_path):
+    sock = str(tmp_path / "agent.sock")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "cilium_tpu.cli", "--socket", sock,
+         "--state", str(tmp_path / "state"), "daemon",
+         "--pod-cidr", "10.79.0.0/24"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+    )
+    deadline = time.monotonic() + 60
+    while not os.path.exists(sock) and time.monotonic() < deadline:
+        time.sleep(0.2)
+    yield sock
+    p.terminate()
+    p.wait(timeout=10)
+
+
+def _cli(sock, *args):
+    return subprocess.run(
+        [sys.executable, "-m", "cilium_tpu.cli", "--socket", sock, *args],
+        capture_output=True, text=True, timeout=60,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    ).stdout
+
+
+class TestCNIExecutable:
+    def test_version(self, agent):
+        r = _invoke("VERSION", agent, "any")
+        assert r.returncode == 0
+        out = json.loads(r.stdout)
+        assert "0.4.0" in out["supportedVersions"]
+
+    def test_add_check_del_lifecycle(self, agent):
+        cid = f"kubelet-{uuid.uuid4().hex[:8]}"
+        ns = f"cniexec-{cid[:8]}"
+        nsmod.create_netns(ns)
+        try:
+            r = _invoke(
+                "ADD", agent, cid, netns_path=f"/var/run/netns/{ns}",
+                cni_args=(
+                    "IgnoreUnknown=1;K8S_POD_NAMESPACE=shop;"
+                    "K8S_POD_NAME=web-1"
+                ),
+            )
+            assert r.returncode == 0, r.stdout + r.stderr
+            result = json.loads(r.stdout)
+            ip = result["ips"][0]["address"].split("/")[0]
+            assert ip.startswith("10.79.0.")
+            assert result["ips"][0]["gateway"] == "10.79.0.1"
+            host_if = result["interfaces"][0]["name"]
+            # real interface exists, container side carries the address
+            assert nsmod._run("link", "show", host_if).returncode == 0
+            out = nsmod.netns_run(ns, ["ip", "-o", "addr", "show", "eth0"])
+            assert ip in out.stdout
+            # the agent registered the endpoint with the k8s pod labels
+            eps = json.loads(_cli(agent, "endpoint", "list"))
+            ep = next(e for e in eps if e.get("ipv4") == ip)
+            assert any("io.kubernetes.pod.namespace=shop" in str(l)
+                       for l in ep["labels"])
+            # CHECK passes while the endpoint exists
+            assert _invoke(
+                "CHECK", agent, cid, netns_path=f"/var/run/netns/{ns}"
+            ).returncode == 0
+            # DEL removes interface + endpoint, and is idempotent
+            assert _invoke("DEL", agent, cid).returncode == 0
+            assert nsmod._run(
+                "link", "show", host_if, check=False
+            ).returncode != 0
+            eps = json.loads(_cli(agent, "endpoint", "list"))
+            assert not any(e.get("ipv4") == ip for e in eps)
+            assert _invoke("DEL", agent, cid).returncode == 0
+            # CHECK now reports unknown container (structured error)
+            r = _invoke(
+                "CHECK", agent, cid, netns_path=f"/var/run/netns/{ns}"
+            )
+            assert r.returncode == 1
+            assert json.loads(r.stdout)["code"] == 3
+        finally:
+            nsmod.delete_netns(ns)
+
+    def test_structured_errors_never_tracebacks(self, agent):
+        # missing CNI_NETNS on ADD
+        r = _invoke("ADD", agent, "c1")
+        assert r.returncode == 1
+        err = json.loads(r.stdout)
+        assert err["code"] == 4 and "CNI_NETNS" in err["msg"]
+        # bad config JSON
+        env = dict(os.environ, CNI_COMMAND="ADD", CNI_CONTAINERID="c2",
+                   CNI_NETNS="/var/run/netns/none", JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "cilium_tpu.plugins.cni_exec"],
+            input="{not json", capture_output=True, text=True,
+            timeout=60, env=env,
+        )
+        assert r.returncode == 1 and json.loads(r.stdout)["code"] == 6
+        # agent down → TRY_AGAIN_LATER with the real socket missing
+        r = _invoke("ADD", agent + ".nope", "c3",
+                    netns_path="/var/run/netns/none")
+        assert r.returncode == 1 and json.loads(r.stdout)["code"] == 11
