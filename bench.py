@@ -817,7 +817,43 @@ def _bench_dispatch_rtt() -> float:
     return sorted(samples)[len(samples) // 2] * 1000
 
 
+def _attach_watchdog(timeout_s: float):
+    """The axon tunnel can wedge indefinitely at device attach (seen
+    in-round: >6h unresponsive). A silent hang records NOTHING for the
+    round — this watchdog emits an explanatory one-line JSON and exits
+    instead, so the failure is visible and bounded. Disarmed the
+    moment the first device op completes."""
+    import threading
+
+    attached = threading.Event()
+
+    def watch():
+        if attached.wait(timeout_s):
+            return
+        print(json.dumps({
+            "metric": f"policy verdicts/sec at {N_RULES} rules",
+            "value": 0,
+            "unit": "verdicts/s",
+            "vs_baseline": 0.0,
+            "error": (
+                f"TPU attach did not complete within {timeout_s:.0f}s "
+                "(axon tunnel wedged?) — no measurements taken"
+            ),
+        }), flush=True)
+        os._exit(3)
+
+    threading.Thread(target=watch, daemon=True).start()
+    return attached
+
+
 def main() -> None:
+    attached = _attach_watchdog(
+        float(os.environ.get("BENCH_ATTACH_TIMEOUT", 900))
+    )
+    # first device op: forces backend init through the tunnel
+    jax.block_until_ready(jnp.zeros(8) + 1)
+    attached.set()
+
     rng = random.Random(42)
     t0 = time.time()
     repo, reg, idents = build_world(rng)

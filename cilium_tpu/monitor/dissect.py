@@ -102,13 +102,13 @@ def l2_offsets(data: bytes):
     framing fix lands in exactly one place."""
     if len(data) < 14:
         return None
-    (etype,) = struct.unpack(">H", data[12:14])
-    off = 14
+    (etype,) = struct.unpack_from(">H", data, 12)  # _from: no slice
+    off = 14  # allocations on the wire front-end's per-packet path
     vlan = None
     if etype == ETH_P_8021Q:
         if len(data) < 18:
             return None  # cut inside the VLAN tag
-        (tci, etype) = struct.unpack(">HH", data[14:18])
+        (tci, etype) = struct.unpack_from(">HH", data, 14)
         vlan = tci & 0x0FFF
         off = 18
     return etype, off, vlan

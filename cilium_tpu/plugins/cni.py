@@ -51,8 +51,8 @@ def cni_add(
     too)."""
     ep_id = endpoint_id_for(container_id)
     ip = daemon.ipam.allocate_next(owner=container_id)
-    host_if = f"lxc{ep_id}"[:15]
-    gateway = str(daemon.ipam.net.network_address + 1)
+    host_if = host_ifname(ep_id)
+    gateway = gateway_for(daemon.ipam.net)
     if netns is not None:
         from . import netns as nsmod
 
@@ -102,12 +102,28 @@ def cni_del(daemon, container_id: str) -> bool:
     # unconditional: delete_link never raises (no-op on ip-less hosts),
     # and gating on the capability probe could leak veths if the probe
     # false-negatives after ADDs succeeded
-    nsmod.delete_link(f"lxc{ep_id}"[:15])
+    nsmod.delete_link(host_ifname(ep_id))
     # endpoint_delete releases the endpoint's IPAM address itself; a
     # second release here would race a concurrent ADD that was just
     # handed the freed address and release it out from under the new
     # endpoint.
     return daemon.endpoint_delete(ep_id)
+
+
+def host_ifname(ep_id: int) -> str:
+    """The host-side veth name for an endpoint — ONE definition so
+    ADD and DEL (in-process and the cni_exec binary) always agree
+    (a divergent name would leak the veth on DEL)."""
+    return f"lxc{ep_id}"[:15]  # IFNAMSIZ
+
+
+def gateway_for(net) -> str:
+    """The pod-CIDR gateway address (the host ends of every veth)."""
+    import ipaddress as _ipa
+
+    if not hasattr(net, "network_address"):
+        net = _ipa.ip_network(str(net))
+    return str(net.network_address + 1)
 
 
 def endpoint_id_for(container_id: str) -> int:

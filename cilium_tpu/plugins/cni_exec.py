@@ -131,10 +131,8 @@ def _agent(conf: Dict):
 
 
 def _cmd_add(env: Dict[str, str], conf: Dict) -> Dict:
-    import ipaddress
-
     from . import netns as nsmod
-    from .cni import endpoint_id_for
+    from .cni import endpoint_id_for, gateway_for, host_ifname
 
     container_id = env["CNI_CONTAINERID"]
     ifname = env.get("CNI_IFNAME", "eth0")
@@ -146,9 +144,8 @@ def _cmd_add(env: Dict[str, str], conf: Dict) -> Dict:
     except Exception as e:
         raise CNIFault(ERR_TRY_LATER, f"IPAM allocation failed: {e}")
     ip = alloc["ip"]
-    net = ipaddress.ip_network(alloc["cidr"])
-    gateway = str(net.network_address + 1)
-    host_if = f"lxc{ep_id}"[:15]
+    gateway = gateway_for(alloc["cidr"])
+    host_if = host_ifname(ep_id)
 
     def rollback(release_ip: bool, drop_link: bool) -> None:
         if drop_link:
@@ -196,11 +193,11 @@ def _cmd_add(env: Dict[str, str], conf: Dict) -> Dict:
 
 def _cmd_del(env: Dict[str, str], conf: Dict) -> Dict:
     from . import netns as nsmod
-    from .cni import endpoint_id_for
+    from .cni import endpoint_id_for, host_ifname
 
     container_id = env["CNI_CONTAINERID"]
     ep_id = endpoint_id_for(container_id)
-    nsmod.delete_link(f"lxc{ep_id}"[:15])
+    nsmod.delete_link(host_ifname(ep_id))
     if env.get("CNI_NETNS"):  # detach any attach-created alias mount
         _detach_alias(env["CNI_NETNS"])
     # DEL must succeed even when the agent never saw this container
@@ -232,6 +229,16 @@ def main(environ=None, stdin=None) -> int:
             raise CNIFault(
                 ERR_INVALID_ENV, f"unsupported CNI_COMMAND {command!r}"
             )
+        want = conf.get("cniVersion")
+        if want and want not in SUPPORTED:
+            # a later spec's result schema differs — returning a
+            # 0.4.0-shaped result stamped with their version would
+            # break libcni parsing; the spec mandates error code 1
+            raise CNIFault(
+                ERR_INCOMPATIBLE_VERSION,
+                f"cniVersion {want!r} not supported "
+                f"(supported: {', '.join(SUPPORTED)})",
+            )
         for key in ("CNI_CONTAINERID",) + (
             ("CNI_NETNS",) if command == "ADD" else ()
         ):
@@ -242,16 +249,25 @@ def main(environ=None, stdin=None) -> int:
         elif command == "DEL":
             _cmd_del(env, conf)
         else:  # CHECK: the endpoint must exist
+            from ..api.client import APIError
             from .cni import endpoint_id_for
 
             ep_id = endpoint_id_for(env["CNI_CONTAINERID"])
+            client = _agent(conf)  # CNIFault(TRY_LATER) when absent
             try:
-                _agent(conf).endpoint_get(ep_id)
-            except Exception:
-                raise CNIFault(
-                    ERR_UNKNOWN_CONTAINER,
-                    f"no endpoint for {env['CNI_CONTAINERID'][:12]}",
-                )
+                client.endpoint_get(ep_id)
+            except APIError as e:
+                if e.status == 404:
+                    raise CNIFault(
+                        ERR_UNKNOWN_CONTAINER,
+                        f"no endpoint for {env['CNI_CONTAINERID'][:12]}",
+                    )
+                raise CNIFault(ERR_TRY_LATER, f"agent error: {e}")
+            except OSError as e:
+                # agent restarting/unreachable is NOT "unknown
+                # container" — that answer would make the runtime tear
+                # down a healthy pod instead of retrying
+                raise CNIFault(ERR_TRY_LATER, f"agent unreachable: {e}")
         return 0
     except CNIFault as e:
         return _fail(e)
