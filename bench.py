@@ -846,7 +846,41 @@ def _attach_watchdog(timeout_s: float):
     return attached
 
 
+def _lint_preflight() -> None:
+    """``--lint``: refuse the round when the hot path carries NEW
+    policyd-lint findings — a fresh device sync or loop-dispatch would
+    make the numbers lie about the architecture. Same one-line-JSON
+    idiom as the attach watchdog so the refusal is visible in round
+    logs, and it runs BEFORE device attach (pure-AST, costs ~100ms)."""
+    from cilium_tpu.analysis import analyze_paths, default_target
+    from cilium_tpu.analysis.baseline import (
+        default_baseline_path, load_baseline, new_findings,
+    )
+
+    counts, _ = load_baseline(default_baseline_path())
+    fresh = new_findings(analyze_paths([default_target()]), counts)
+    hot = [f for f in fresh if f.rule.startswith("TPU")]
+    if not hot:
+        return
+    print(json.dumps({
+        "metric": f"policy verdicts/sec at {N_RULES} rules",
+        "value": 0,
+        "unit": "verdicts/s",
+        "vs_baseline": 0.0,
+        "error": (
+            f"lint pre-flight: {len(hot)} new hot-path finding(s) — "
+            + "; ".join(f.render() for f in hot[:3])
+            + (" ..." if len(hot) > 3 else "")
+            + " — fix or baseline (python -m cilium_tpu.analysis) "
+            "before benching"
+        ),
+    }), flush=True)
+    sys.exit(3)
+
+
 def main() -> None:
+    if "--lint" in sys.argv[1:]:
+        _lint_preflight()
     attached = _attach_watchdog(
         float(os.environ.get("BENCH_ATTACH_TIMEOUT", 900))
     )

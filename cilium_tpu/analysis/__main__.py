@@ -1,0 +1,121 @@
+"""policyd-lint runner CLI.
+
+Usage::
+
+    python -m cilium_tpu.analysis [paths...] [--format text|json]
+        [--baseline PATH | --no-baseline] [--write-baseline]
+        [--rules TPU001,LOCK002] [--all]
+
+Exit codes: 0 = clean against baseline; 1 = new findings; 2 = usage /
+internal error. With no paths, analyzes the cilium_tpu package.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import analyze_paths, default_target
+from .baseline import (
+    default_baseline_path,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cilium_tpu.analysis",
+        description="policyd-lint: hot-path & lock-discipline analyzer",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file (default: the checked-in analysis/baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="every finding is 'new' (full inventory mode)",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="regenerate the baseline from this run's findings and exit 0",
+    )
+    ap.add_argument(
+        "--rules", default=None, help="comma-separated rule id filter"
+    )
+    ap.add_argument(
+        "--all",
+        action="store_true",
+        help="print all findings, not just new ones",
+    )
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+
+    paths = args.paths or [default_target()]
+    rules = args.rules.split(",") if args.rules else None
+    try:
+        findings = analyze_paths(paths, rules=rules)
+    except Exception as e:  # pragma: no cover - internal error surface
+        print(f"policyd-lint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.write_baseline:
+        _, notes = (
+            load_baseline(baseline_path)
+            if not args.no_baseline
+            else ({}, {})
+        )
+        write_baseline(findings, baseline_path, justifications=notes)
+        print(
+            f"policyd-lint: wrote {len(findings)} finding(s) to "
+            f"{baseline_path}",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.no_baseline:
+        fresh = list(findings)
+        baseline_used = None
+    else:
+        counts, _notes = load_baseline(baseline_path)
+        fresh = new_findings(findings, counts)
+        baseline_used = baseline_path
+
+    if args.format == "json":
+        payload = {
+            "tool": "policyd-lint",
+            "total": len(findings),
+            "new": len(fresh),
+            "baseline": baseline_used,
+            "new_findings": [f.to_dict() for f in fresh],
+        }
+        if args.all:
+            payload["findings"] = [f.to_dict() for f in findings]
+        print(json.dumps(payload))
+    else:
+        shown = findings if args.all else fresh
+        for f in shown:
+            print(f.render())
+        print(
+            f"policyd-lint: {len(findings)} finding(s), "
+            f"{len(fresh)} new"
+            + (f" (baseline: {baseline_used})" if baseline_used else ""),
+            file=sys.stderr,
+        )
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

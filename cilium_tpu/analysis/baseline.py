@@ -1,0 +1,117 @@
+"""Baseline persistence for policyd-lint.
+
+The baseline is a checked-in inventory of accepted findings. CI fails
+only on findings NOT covered by it, so the gate stops regressions the
+day it lands without demanding every pre-existing finding be fixed
+first.
+
+Matching is by (rule, path, context) — context is the stripped source
+text of the flagged line — with a per-key count, so:
+
+- edits elsewhere in a file (line drift) don't break the baseline;
+- editing the flagged line itself invalidates its baseline entry (the
+  new text is a new finding — re-justify or fix);
+- adding a second identical violation on an identical line is caught
+  by the count.
+
+Entries may carry a ``justification`` string; ``--write-baseline``
+preserves justifications for keys that survive regeneration.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .core import Finding
+
+Key = Tuple[str, str, str]
+
+BASELINE_VERSION = 1
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def load_baseline(path: str) -> Tuple[Dict[Key, int], Dict[Key, str]]:
+    """→ (counts per key, justifications per key). Missing file → empty
+    baseline (everything is "new")."""
+    counts: Dict[Key, int] = {}
+    notes: Dict[Key, str] = {}
+    if not os.path.exists(path):
+        return counts, notes
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"unsupported baseline version {data.get('version')!r} "
+            f"in {path}"
+        )
+    for entry in data.get("findings", []):
+        key = (entry["rule"], entry["path"], entry.get("context", ""))
+        counts[key] = counts.get(key, 0) + int(entry.get("count", 1))
+        if entry.get("justification"):
+            notes[key] = entry["justification"]
+    return counts, notes
+
+
+def new_findings(
+    findings: Iterable[Finding], baseline: Dict[Key, int]
+) -> List[Finding]:
+    """Findings not covered by the baseline (count-aware)."""
+    budget = dict(baseline)
+    out: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+def write_baseline(
+    findings: Iterable[Finding],
+    path: str,
+    justifications: Optional[Dict[Key, str]] = None,
+) -> None:
+    """Serialize ``findings`` as the new baseline, carrying over any
+    surviving justifications."""
+    justifications = justifications or {}
+    counts: Dict[Key, int] = {}
+    lines: Dict[Key, int] = {}
+    sev: Dict[Key, str] = {}
+    for f in findings:
+        k = f.key()
+        counts[k] = counts.get(k, 0) + 1
+        lines.setdefault(k, f.line)
+        sev.setdefault(k, f.severity)
+    entries = []
+    for k in sorted(counts):
+        rule, relpath, context = k
+        entry = {
+            "rule": rule,
+            "path": relpath,
+            "context": context,
+            "severity": sev[k],
+            # advisory only (drifts with edits); matching ignores it
+            "line_hint": lines[k],
+        }
+        if counts[k] > 1:
+            entry["count"] = counts[k]
+        if k in justifications:
+            entry["justification"] = justifications[k]
+        entries.append(entry)
+    payload = {
+        "version": BASELINE_VERSION,
+        "tool": "policyd-lint",
+        "findings": entries,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)

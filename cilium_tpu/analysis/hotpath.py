@@ -1,0 +1,569 @@
+"""Family A: TPU hot-path rules.
+
+The verdict path dies silently from host↔device round trips — an
+``int()`` on a device value blocks the dispatch queue, a ``jnp`` call
+inside a Python loop traces one XLA op per iteration, a jit closing
+over a mutable global silently recompiles (or worse, bakes in stale
+state). These rules flag the syntactic shapes of those bugs inside
+modules marked hot (ops/, engine.py, datapath/pipeline.py, or a
+``# policyd: hot`` marker).
+
+Rules
+-----
+TPU001  host-sync coercion: ``int()/float()/bool()/np.asarray()/
+        .item()/.tolist()`` applied to a value that flows from a jnp
+        op or a jit-decorated function (error), or to an array
+        reduction (``x.max()``, ``x.sum()``, ...) on a parameter-
+        derived array (warning — may be numpy, but on the hot path
+        the coercion belongs off-path or on numpy before device_put).
+TPU002  ``jnp``/``jax.lax`` call inside a Python ``for``/``while``
+        loop — the per-flow gather anti-pattern (ops/verdict.py
+        documents the ~1000× regression). Intentional static unrolls
+        carry an inline suppression.
+TPU003  jit-decorated function closes over a mutable module-level
+        global (list/dict/set): jit traces the value once and never
+        sees later mutation.
+TPU004  dtype-literal drift: a matmul (``@``, ``jnp.matmul``,
+        ``jnp.dot``, ``lax.dot_general``) whose two operands are cast
+        to different integer/float dtype literals.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (
+    SEV_ERROR,
+    SEV_WARNING,
+    Finding,
+    ModuleSource,
+    attr_chain,
+    call_name,
+    iter_target_names,
+    walk_skipping,
+)
+
+COERCIONS = {"int", "float", "bool"}
+NP_SYNC_FUNCS = {"asarray", "array", "copy"}
+SYNC_METHODS = {"item", "tolist", "__array__"}
+REDUCTIONS = {
+    "max", "min", "sum", "mean", "prod", "any", "all",
+    "argmax", "argmin", "item",
+}
+DTYPE_LITERALS = {
+    "int4", "int8", "int16", "int32", "int64",
+    "uint4", "uint8", "uint16", "uint32", "uint64",
+    "float16", "float32", "float64", "bfloat16",
+}
+MUTABLE_FACTORIES = {
+    "list", "dict", "set", "bytearray", "deque", "defaultdict",
+    "OrderedDict", "Counter",
+}
+
+
+class _Imports:
+    """Resolved aliases for jax / jax.numpy / jax.lax / numpy."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.jnp: Set[str] = set()
+        self.jax: Set[str] = set()
+        self.lax: Set[str] = set()
+        self.np: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    if a.name == "jax.numpy":
+                        self.jnp.add(a.asname or "jax")
+                    elif a.name == "jax.lax":
+                        self.lax.add(a.asname or "jax")
+                    elif a.name == "jax":
+                        self.jax.add(name)
+                    elif a.name == "numpy":
+                        self.np.add(name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "numpy":
+                            self.jnp.add(a.asname or "numpy")
+                        elif a.name == "lax":
+                            self.lax.add(a.asname or "lax")
+                elif node.module == "jax.numpy":
+                    # from jax.numpy import X — treat X as device op
+                    for a in node.names:
+                        self.jnp.add(a.asname or a.name)
+
+    def is_device_chain(self, chain: Optional[List[str]]) -> bool:
+        """True for jnp.*, jax.lax.*, jax.* chains (device-producing)."""
+        if not chain:
+            return False
+        root = chain[0]
+        if root in self.jnp or root in self.lax:
+            return True
+        if root in self.jax and len(chain) >= 2:
+            # jax.jit / jax.device_put / jax.lax... — device side
+            return chain[1] not in ("tree_util", "typing", "config")
+        return False
+
+
+def _collect_jit_names(tree: ast.Module, imports: _Imports) -> Set[str]:
+    """Names of functions decorated with jax.jit (bare, called, or via
+    functools.partial(jax.jit, ...))."""
+    jit_names: Set[str] = set()
+
+    def is_jit_deco(d: ast.AST) -> bool:
+        chain = attr_chain(d)
+        if chain and chain[0] in imports.jax and chain[-1] in ("jit", "pmap"):
+            return True
+        if isinstance(d, ast.Call):
+            fchain = attr_chain(d.func)
+            if fchain and fchain[-1] == "partial":
+                return any(is_jit_deco(a) for a in d.args)
+            return is_jit_deco(d.func)
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(is_jit_deco(d) for d in node.decorator_list):
+                jit_names.add(node.name)
+    return jit_names
+
+
+def _collect_mutable_globals(tree: ast.Module) -> Dict[str, int]:
+    """Module-level names bound to mutable containers → def line."""
+    out: Dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            value = node.value
+            mutable = isinstance(
+                value,
+                (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+                 ast.DictComp),
+            )
+            if isinstance(value, ast.Call):
+                cn = call_name(value)
+                if cn and cn.split(".")[-1] in MUTABLE_FACTORIES:
+                    mutable = True
+            if mutable:
+                for name in iter_target_names(
+                    node.targets[0] if len(node.targets) == 1
+                    else ast.Tuple(elts=list(node.targets))
+                ):
+                    out[name] = node.lineno
+        elif isinstance(node, ast.AugAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            out[node.target.id] = node.lineno
+    return out
+
+
+class _FuncTaint:
+    """Intra-function taint walk for TPU001.
+
+    ``device``: names known to flow from jnp ops / jit calls — an
+    ``int()`` on these is a guaranteed host-device sync.
+    ``arrayish``: parameter-derived names in hot modules — probably
+    arrays; only reduction-coercions on these are flagged (warning).
+    """
+
+    def __init__(
+        self,
+        mod: ModuleSource,
+        imports: _Imports,
+        jit_names: Set[str],
+        func: ast.AST,
+        findings: List[Finding],
+    ) -> None:
+        self.mod = mod
+        self.imports = imports
+        self.jit_names = jit_names
+        self.findings = findings
+        self.device: Set[str] = set()
+        self.arrayish: Set[str] = set()
+        args = func.args
+        all_args = (
+            list(args.posonlyargs) + list(args.args)
+            + list(args.kwonlyargs)
+        )
+        for a in all_args:
+            if a.arg in ("self", "cls"):
+                continue
+            ann = getattr(a, "annotation", None)
+            if ann is not None and self._mentions_device(ann):
+                self.device.add(a.arg)
+            else:
+                self.arrayish.add(a.arg)
+        self.run(func)
+
+    # -- expression classification -------------------------------------
+    def _mentions_device(self, expr: ast.AST) -> bool:
+        """Expression contains a jnp/lax-rooted chain, a tainted name,
+        or a call of a jit-decorated function."""
+        for n in walk_skipping(expr, (ast.FunctionDef, ast.Lambda)):
+            if isinstance(n, ast.Name) and n.id in self.device:
+                return True
+            if isinstance(n, ast.Attribute):
+                chain = attr_chain(n)
+                if self.imports.is_device_chain(chain):
+                    return True
+            if isinstance(n, ast.Call):
+                cn = call_name(n)
+                if cn and cn.split(".")[-1] in self.jit_names:
+                    return True
+        return False
+
+    def _mentions_arrayish(self, expr: ast.AST) -> bool:
+        for n in walk_skipping(expr, (ast.FunctionDef, ast.Lambda)):
+            if isinstance(n, ast.Name) and n.id in self.arrayish:
+                return True
+        return False
+
+    def _is_host_pull(self, expr: ast.AST) -> bool:
+        """True when ``expr`` is an explicit host pull — np.asarray(x),
+        int(x), possibly sliced or .astype()'d. The pull itself is
+        flagged once at the call site; its RESULT is host data and must
+        not re-taint downstream uses."""
+        while True:
+            if isinstance(expr, (ast.Subscript, ast.Attribute)):
+                expr = expr.value
+            elif (
+                isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Attribute)
+                and not attr_chain(expr.func)
+            ):
+                # method on a call result: np.asarray(x).astype(...)
+                expr = expr.func.value
+            else:
+                break
+        if not isinstance(expr, ast.Call):
+            return False
+        fchain = attr_chain(expr.func)
+        if not fchain:
+            return False
+        if len(fchain) == 1 and fchain[0] in COERCIONS:
+            return True
+        return (
+            len(fchain) == 2
+            and fchain[0] in self.imports.np
+            and fchain[1] in NP_SYNC_FUNCS
+        )
+
+    # -- walk ------------------------------------------------------------
+    def run(self, func: ast.AST) -> None:
+        for stmt in func.body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested scopes get their own walk from the rule
+        if isinstance(stmt, ast.Assign):
+            self._check_expr(stmt.value)
+            names = [
+                n
+                for t in stmt.targets
+                for n in iter_target_names(t)
+            ]
+            if self._is_host_pull(stmt.value):
+                self.arrayish.update(names)
+                self.device.difference_update(names)
+            elif self._mentions_device(stmt.value):
+                self.device.update(names)
+                self.arrayish.difference_update(names)
+            elif self._mentions_arrayish(stmt.value):
+                self.arrayish.update(names)
+            else:
+                for n in names:
+                    self.device.discard(n)
+                    self.arrayish.discard(n)
+        elif isinstance(stmt, ast.AugAssign):
+            self._check_expr(stmt.value)
+            if isinstance(stmt.target, ast.Name) and self._mentions_device(
+                stmt.value
+            ):
+                self.device.add(stmt.target.id)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._check_expr(stmt.iter)
+            names = list(iter_target_names(stmt.target))
+            if self._mentions_device(stmt.iter):
+                self.device.update(names)
+            elif self._mentions_arrayish(stmt.iter):
+                self.arrayish.update(names)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+        elif isinstance(stmt, ast.While):
+            self._check_expr(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+        elif isinstance(stmt, ast.If):
+            self._check_expr(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_expr(item.context_expr)
+            for s in stmt.body:
+                self._stmt(s)
+        elif isinstance(stmt, ast.Try):
+            for s in (
+                stmt.body + stmt.orelse + stmt.finalbody
+                + [h for hh in stmt.handlers for h in hh.body]
+            ):
+                self._stmt(s)
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self._check_expr(stmt.value)
+        elif isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self._check_expr(stmt.exc)
+
+    # -- the actual TPU001 checks ---------------------------------------
+    def _check_expr(self, expr: ast.AST) -> None:
+        for node in walk_skipping(expr, (ast.FunctionDef, ast.Lambda)):
+            if not isinstance(node, ast.Call):
+                continue
+            self._check_call(node)
+
+    def _check_call(self, node: ast.Call) -> None:
+        fchain = attr_chain(node.func)
+        fname = ".".join(fchain) if fchain else None
+
+        # .item() / .tolist() on a device-tainted value
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in SYNC_METHODS
+            and self._mentions_device(node.func.value)
+        ):
+            self._emit(
+                node,
+                SEV_ERROR,
+                f".{node.func.attr}() forces a host-device sync on the "
+                "hot path; hoist it off-path or keep the value on device",
+            )
+            return
+
+        # int()/float()/bool()/np.asarray() on device values
+        is_coercion = fname in COERCIONS
+        is_np_pull = (
+            fchain is not None
+            and len(fchain) == 2
+            and fchain[0] in self.imports.np
+            and fchain[1] in NP_SYNC_FUNCS
+        )
+        if not (is_coercion or is_np_pull) or not node.args:
+            return
+        arg = node.args[0]
+        if self._mentions_device(arg):
+            what = fname if is_coercion else fname
+            self._emit(
+                node,
+                SEV_ERROR,
+                f"{what}() on a value that flows from jnp/jit — this "
+                "blocks on the device (implicit transfer) inside a hot "
+                "module; hoist the coercion off the hot path",
+            )
+            return
+        # reduction-coercion on a parameter-derived array: int(x.max())
+        if is_coercion and isinstance(arg, ast.Call):
+            f = arg.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in REDUCTIONS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in self.arrayish
+            ):
+                self._emit(
+                    node,
+                    SEV_WARNING,
+                    f"{fname}({f.value.id}.{f.attr}(...)) in a hot module "
+                    "syncs if the array is device-resident; coerce on "
+                    "numpy before device_put or hoist off the hot path",
+                )
+
+    def _emit(self, node: ast.AST, severity: str, message: str) -> None:
+        self.findings.append(
+            self.mod.finding("TPU001", severity, node.lineno, message)
+        )
+
+
+# ---------------------------------------------------------------------------
+
+
+def _check_loops(
+    mod: ModuleSource,
+    imports: _Imports,
+    func: ast.AST,
+    findings: List[Finding],
+) -> None:
+    """TPU002: jnp/lax calls under a Python for/while in a hot module."""
+    seen_loops: Set[int] = set()
+    for node in walk_skipping(func, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        if id(node) in seen_loops:
+            continue
+        # mark nested loops visited so each offending call reports once
+        inner = [
+            n
+            for n in walk_skipping(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            )
+            if isinstance(n, (ast.For, ast.AsyncFor, ast.While))
+        ]
+        for lp in inner:
+            seen_loops.add(id(lp))
+        calls = [
+            n
+            for n in walk_skipping(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            )
+            if isinstance(n, ast.Call)
+            and imports.is_device_chain(attr_chain(n.func))
+        ]
+        if not calls:
+            continue
+        first = min(calls, key=lambda c: c.lineno)
+        cn = call_name(first) or "jnp op"
+        findings.append(
+            mod.finding(
+                "TPU002",
+                SEV_WARNING,
+                first.lineno,
+                f"{cn} inside a Python {type(node).__name__.lower()} loop "
+                "in a hot module — each iteration traces/dispatches its "
+                "own op (per-flow gather anti-pattern); batch it, or "
+                "suppress with a justification if this is a bounded "
+                "static unroll",
+            )
+        )
+
+
+def _check_jit_globals(
+    mod: ModuleSource,
+    imports: _Imports,
+    tree: ast.Module,
+    findings: List[Finding],
+) -> None:
+    """TPU003: jit functions reading mutable module-level globals."""
+    mutable = _collect_mutable_globals(tree)
+    if not mutable:
+        return
+    jit_names = _collect_jit_names(tree, imports)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in jit_names:
+            continue
+        local: Set[str] = set()
+        args = node.args
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        ):
+            local.add(a.arg)
+        for n in walk_skipping(node, (ast.FunctionDef, ast.Lambda)):
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    n.targets if isinstance(n, ast.Assign) else [n.target]
+                )
+                for t in targets:
+                    local.update(iter_target_names(t))
+        for n in walk_skipping(node, (ast.FunctionDef, ast.Lambda)):
+            if (
+                isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and n.id in mutable
+                and n.id not in local
+            ):
+                findings.append(
+                    mod.finding(
+                        "TPU003",
+                        SEV_ERROR,
+                        n.lineno,
+                        f"jit function '{node.name}' closes over mutable "
+                        f"global '{n.id}' (defined line {mutable[n.id]}): "
+                        "jit traces the value once — later mutation is "
+                        "silently ignored (or forces recompiles); pass it "
+                        "as an argument or make it immutable",
+                    )
+                )
+                break  # one finding per function is enough signal
+
+
+def _operand_dtypes(imports: _Imports, expr: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in walk_skipping(expr, (ast.FunctionDef, ast.Lambda)):
+        if isinstance(n, ast.Attribute) and n.attr in DTYPE_LITERALS:
+            chain = attr_chain(n)
+            if chain and (
+                chain[0] in imports.jnp or chain[0] in imports.np
+            ):
+                out.add(n.attr)
+    return out
+
+
+def _check_dtype_drift(
+    mod: ModuleSource,
+    imports: _Imports,
+    tree: ast.Module,
+    findings: List[Finding],
+) -> None:
+    """TPU004: matmul with operands cast to different dtype literals."""
+    for node in ast.walk(tree):
+        pairs: List[Tuple[ast.AST, ast.AST, int]] = []
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+            pairs.append((node.left, node.right, node.lineno))
+        elif isinstance(node, ast.Call):
+            fchain = attr_chain(node.func)
+            if (
+                fchain
+                and len(node.args) >= 2
+                and fchain[-1] in ("matmul", "dot", "dot_general", "einsum")
+                and (
+                    fchain[0] in imports.jnp
+                    or fchain[0] in imports.lax
+                    or (len(fchain) >= 2 and fchain[0] in imports.jax)
+                )
+            ):
+                a, b = node.args[0], node.args[1]
+                if fchain[-1] == "einsum":
+                    if len(node.args) >= 3:
+                        a, b = node.args[1], node.args[2]
+                    else:
+                        continue
+                pairs.append((a, b, node.lineno))
+        for left, right, line in pairs:
+            dl = _operand_dtypes(imports, left)
+            dr = _operand_dtypes(imports, right)
+            if dl and dr and dl.isdisjoint(dr):
+                findings.append(
+                    mod.finding(
+                        "TPU004",
+                        SEV_WARNING,
+                        line,
+                        "matmul operands carry different dtype literals "
+                        f"({'/'.join(sorted(dl))} vs {'/'.join(sorted(dr))})"
+                        " — mixed-precision contraction promotes off the "
+                        "int8 MXU path; align the operand dtypes",
+                    )
+                )
+
+
+# ---------------------------------------------------------------------------
+
+
+def analyze_hotpath(mod: ModuleSource) -> List[Finding]:
+    """Run Family A over one module. TPU003 applies everywhere (jit
+    closures are a correctness bug wherever they live); the rest only
+    fire inside hot modules."""
+    findings: List[Finding] = []
+    imports = _Imports(mod.tree)
+    _check_jit_globals(mod, imports, mod.tree, findings)
+    if mod.is_hot():
+        jit_names = _collect_jit_names(mod.tree, imports)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _FuncTaint(mod, imports, jit_names, node, findings)
+                _check_loops(mod, imports, node, findings)
+        _check_dtype_drift(mod, imports, mod.tree, findings)
+    return findings

@@ -1,0 +1,671 @@
+"""Family B: lock-discipline rules.
+
+The agent side of this codebase carries ~70 ``threading.Lock``s
+(identity registry, kvstore, watchers, pipeline). Two failure modes
+matter at fleet scale: lock-order inversions between modules (deadlock
+under concurrent churn) and long blocking operations performed while a
+lock is held (every verdict-serving thread convoys behind one disk
+write). Both are invisible to the tier-1 tests, which are mostly
+single-threaded.
+
+Rules
+-----
+LOCK001  potential lock-order cycle: lock B is acquired while lock A
+         is held on one path, and A while B on another (including
+         one level through method calls). Error.
+LOCK002  blocking operation (file I/O, subprocess, socket, sleep,
+         block_until_ready) while a lock is held. Error.
+LOCK003  invoking a stored callback/observer while a lock is held —
+         the callee can acquire arbitrary locks or block, turning the
+         caller's lock into an ordering hazard it cannot see. Warning.
+LOCK004  guard inconsistency: an attribute mutated both under the
+         class's lock and outside any lock (outside __init__) — the
+         unguarded site races the guarded readers. Warning.
+
+Lock model: ``with self._lock:`` blocks plus ``X.acquire()`` /
+``X.release()`` pairs (held until the matching release in the same
+suite, else to function end). Locks are recognized by construction
+(``threading.Lock()`` etc.) or by name (``*lock*``, ``*mutex*``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    SEV_ERROR,
+    SEV_WARNING,
+    Finding,
+    ModuleSource,
+    attr_chain,
+    call_name,
+    iter_target_names,
+    walk_skipping,
+)
+
+_LOCKNAME_RE = re.compile(r"(^|_)(lock|mutex|mu)($|_)|lock$", re.IGNORECASE)
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore"}
+
+# call-name patterns (matched against the dotted call chain) that block
+BLOCKING_CALLS: Tuple[Tuple[str, str], ...] = (
+    ("open", "file I/O"),
+    ("os.open", "file I/O"),
+    ("os.fsync", "file I/O"),
+    ("os.replace", "file I/O"),
+    ("os.rename", "file I/O"),
+    ("subprocess.", "subprocess"),
+    ("socket.", "socket"),
+    ("time.sleep", "sleep"),
+    ("requests.", "network I/O"),
+    ("urllib.", "network I/O"),
+    ("block_until_ready", "device sync"),
+    ("jax.device_put", "device transfer"),
+    ("shutil.", "file I/O"),
+)
+# method names on arbitrary receivers that block
+BLOCKING_METHODS = {
+    "recv": "socket", "recv_into": "socket", "sendall": "socket",
+    "accept": "socket", "connect": "socket", "makefile": "socket",
+    "block_until_ready": "device sync", "fsync": "file I/O",
+    "communicate": "subprocess", "check_call": "subprocess",
+    "check_output": "subprocess", "run": None,  # too generic: skip
+}
+
+# receiver-attribute name patterns whose *call* is a stored callback
+_CALLBACK_ATTR_RE = re.compile(
+    r"^(_?on_|.*callback|.*_cb$|.*observer|.*hook|.*handler)", re.IGNORECASE
+)
+
+MUTATOR_METHODS = {
+    "append", "add", "pop", "popitem", "update", "setdefault", "clear",
+    "remove", "extend", "insert", "discard", "appendleft",
+}
+
+# ubiquitous method names never resolved across classes (container
+# methods would create bogus cross-class edges)
+_GENERIC_METHODS = {
+    "get", "set", "add", "pop", "items", "keys", "values", "update",
+    "append", "remove", "close", "insert", "delete", "acquire",
+    "release", "put", "send", "join", "start", "copy", "clear", "wait",
+    "drain", "dump", "read", "write", "run", "stop", "next", "count",
+}
+
+
+def _is_lock_expr(expr: ast.AST) -> Optional[str]:
+    """Lock identity for a with-item / acquire receiver, or None.
+
+    ``self.X`` → "self.X"; bare ``Name`` → "<name>"; anything else
+    (e.g. ``backend._lock``) → dotted chain.
+    """
+    chain = attr_chain(expr)
+    if not chain:
+        return None
+    leaf = chain[-1]
+    if not _LOCKNAME_RE.search(leaf):
+        return None
+    return ".".join(chain)
+
+
+class _ClassInfo:
+    def __init__(self, mod: ModuleSource, node: ast.ClassDef) -> None:
+        self.mod = mod
+        self.node = node
+        self.name = node.name
+        self.qual = f"{mod.relpath}:{node.name}"
+        self.lock_attrs: Set[str] = set()
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        # method name -> set of lock node ids acquired anywhere in it
+        self.method_acquires: Dict[str, Set[str]] = {}
+        # callee method name -> [(caller method, locks held at the site)]
+        self.call_sites: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
+        # methods whose every call site holds a common lock (or named
+        # *_locked): method -> locks assumed held on entry
+        self.assumed_held: Dict[str, Tuple[str, ...]] = {}
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        for n in ast.walk(node):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    chain = attr_chain(t)
+                    if (
+                        chain
+                        and len(chain) == 2
+                        and chain[0] == "self"
+                        and isinstance(n.value, ast.Call)
+                    ):
+                        cn = call_name(n.value) or ""
+                        if cn.split(".")[-1] in LOCK_FACTORIES:
+                            self.lock_attrs.add(chain[1])
+
+    def lock_id(self, expr_id: str) -> str:
+        """Canonical graph node for a lock expression in this class."""
+        if expr_id.startswith("self."):
+            return f"{self.qual}.{expr_id[5:]}"
+        return f"{self.qual}.{expr_id}"
+
+
+class LockIndex:
+    """Package-wide view built in pass 1: which class methods acquire
+    which locks (for one-level interprocedural edges)."""
+
+    def __init__(self) -> None:
+        self.classes: List[_ClassInfo] = []
+        # method name -> [(classinfo, lock ids it acquires)]
+        self.by_method: Dict[str, List[Tuple[_ClassInfo, Set[str]]]] = {}
+
+    def add_module(self, mod: ModuleSource) -> None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = _ClassInfo(mod, node)
+            self.classes.append(ci)
+            for mname, mnode in ci.methods.items():
+                acquires: Set[str] = set()
+                for n in ast.walk(mnode):
+                    if isinstance(n, (ast.With, ast.AsyncWith)):
+                        for item in n.items:
+                            lid = _is_lock_expr(item.context_expr)
+                            if lid is not None:
+                                acquires.add(ci.lock_id(lid))
+                    elif (
+                        isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "acquire"
+                    ):
+                        lid = _is_lock_expr(n.func.value)
+                        if lid is not None:
+                            acquires.add(ci.lock_id(lid))
+                ci.method_acquires[mname] = acquires
+                if acquires:
+                    self.by_method.setdefault(mname, []).append(
+                        (ci, acquires)
+                    )
+            # collect pass: record self.M() call sites with held locks
+            # (findings/edges discarded — only call_sites matter here)
+            for mname, mnode in ci.methods.items():
+                _MethodWalk(mod, ci, self, mnode, [], [], [],
+                            call_sites=ci.call_sites)
+
+    def finalize(self) -> None:
+        """Held-context fixpoint: a method is *assumed held* when it is
+        named ``*_locked`` (and the class declares a lock), or every
+        non-``__init__`` call site holds a common lock — directly or via
+        an assumed-held caller. Bodies of assumed-held methods are then
+        analyzed with that lock as entry context, so helpers invoked
+        under the caller's lock neither raise bogus LOCK004s nor hide
+        real blocking/callback findings."""
+        for ci in self.classes:
+            lock_ids = tuple(sorted(
+                ci.lock_id(f"self.{a}") for a in ci.lock_attrs
+            ))
+            assumed = ci.assumed_held
+            for mname in ci.methods:
+                if mname.endswith("_locked") and lock_ids:
+                    assumed[mname] = lock_ids
+            changed = True
+            while changed:
+                changed = False
+                for mname in ci.methods:
+                    # only private helpers qualify via call sites:
+                    # public methods can always be entered bare from
+                    # outside the class
+                    if (
+                        mname in assumed
+                        or not mname.startswith("_")
+                        or mname.startswith("__")
+                    ):
+                        continue
+                    sites = [
+                        s for s in ci.call_sites.get(mname, ())
+                        if s[0] != "__init__"
+                    ]
+                    if not sites:
+                        continue
+                    common: Optional[Set[str]] = None
+                    for caller, held in sites:
+                        eff = set(held) | set(assumed.get(caller, ()))
+                        common = eff if common is None else common & eff
+                        if not common:
+                            break
+                    if common:
+                        assumed[mname] = tuple(sorted(common))
+                        changed = True
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "mod", "line", "where")
+
+    def __init__(self, src, dst, mod, line, where):
+        self.src, self.dst = src, dst
+        self.mod, self.line, self.where = mod, line, where
+
+
+class _MethodWalk:
+    """Held-region walk over one method: emits LOCK002/LOCK003 findings
+    and acquisition edges for the LOCK001 graph."""
+
+    def __init__(
+        self,
+        mod: ModuleSource,
+        ci: _ClassInfo,
+        index: LockIndex,
+        func: ast.FunctionDef,
+        findings: List[Finding],
+        edges: List[_Edge],
+        mutations: List[Tuple[str, int, bool, str]],
+        call_sites: Optional[
+            Dict[str, List[Tuple[str, Tuple[str, ...]]]]
+        ] = None,
+        entry_held: Tuple[str, ...] = (),
+    ) -> None:
+        self.mod = mod
+        self.ci = ci
+        self.index = index
+        self.func = func
+        self.findings = findings
+        self.edges = edges
+        self.mutations = mutations  # (attr, line, held, method)
+        self.call_sites = call_sites
+        self.where = f"{ci.name}.{func.name}"
+        if entry_held:
+            self.where += " [called with lock held]"
+        self._suite(func.body, entry_held)
+
+    # ------------------------------------------------------------------
+    def _suite(self, stmts: Sequence[ast.stmt], held: Tuple[str, ...]):
+        i = 0
+        while i < len(stmts):
+            stmt = stmts[i]
+            acq = self._acquire_stmt(stmt)
+            if acq is not None:
+                self._on_acquire(acq, held, stmt.lineno)
+                # held until the matching release in this suite, else
+                # to the end of the suite (coarse but safe)
+                rel = self._find_release(stmts, i + 1, acq)
+                inner = stmts[i + 1: rel if rel is not None else len(stmts)]
+                self._suite(inner, held + (acq,))
+                i = rel if rel is not None else len(stmts)
+                continue
+            self._stmt(stmt, held)
+            i += 1
+
+    def _acquire_stmt(self, stmt: ast.stmt) -> Optional[str]:
+        """lock id when ``stmt`` is ``X.acquire()`` (expression stmt)."""
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Call)
+            and isinstance(stmt.value.func, ast.Attribute)
+            and stmt.value.func.attr == "acquire"
+        ):
+            lid = _is_lock_expr(stmt.value.func.value)
+            if lid is not None:
+                return self.ci.lock_id(lid)
+        return None
+
+    def _find_release(
+        self, stmts: Sequence[ast.stmt], start: int, lock_id: str
+    ) -> Optional[int]:
+        for j in range(start, len(stmts)):
+            s = stmts[j]
+            if (
+                isinstance(s, ast.Expr)
+                and isinstance(s.value, ast.Call)
+                and isinstance(s.value.func, ast.Attribute)
+                and s.value.func.attr == "release"
+            ):
+                lid = _is_lock_expr(s.value.func.value)
+                if lid is not None and self.ci.lock_id(lid) == lock_id:
+                    return j
+        return None
+
+    # ------------------------------------------------------------------
+    def _stmt(self, stmt: ast.stmt, held: Tuple[str, ...]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs execute later, not under this hold
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new: List[str] = []
+            for item in stmt.items:
+                lid = _is_lock_expr(item.context_expr)
+                if lid is not None:
+                    full = self.ci.lock_id(lid)
+                    self._on_acquire(full, held + tuple(new), stmt.lineno)
+                    if full not in held:  # re-entrant (RLock) re-take
+                        new.append(full)
+                else:
+                    self._expr(item.context_expr, held)
+            self._record_mutations(stmt, held)
+            for s in stmt.body:
+                self._stmt(s, held + tuple(new))
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body + stmt.orelse + stmt.finalbody:
+                self._stmt(s, held)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s, held)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter, held)
+            self._record_mutation_target(stmt.target, stmt.lineno, held)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s, held)
+            return
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test, held)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s, held)
+            return
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, held)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s, held)
+            return
+        # leaf statements: record mutations + scan expressions
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                self._record_mutation_target(t, stmt.lineno, held)
+            self._expr(stmt.value, held)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            self._record_mutation_target(stmt.target, stmt.lineno, held)
+            if stmt.value is not None:
+                self._expr(stmt.value, held)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._record_mutation_target(t, stmt.lineno, held)
+        elif isinstance(stmt, (ast.Expr, ast.Return)):
+            if stmt.value is not None:
+                self._expr(stmt.value, held)
+        elif isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self._expr(stmt.exc, held)
+
+    def _record_mutations(self, node: ast.AST, held) -> None:
+        pass  # placeholder: with-items carry no mutations
+
+    def _record_mutation_target(
+        self, target: ast.AST, line: int, held
+    ) -> None:
+        attr = self._self_attr_of_target(target)
+        if attr is not None:
+            self.mutations.append(
+                (attr, line, bool(held), self.func.name)
+            )
+
+    @staticmethod
+    def _self_attr_of_target(target: ast.AST) -> Optional[str]:
+        """self.A / self.A[...] / self.A.b assignment target → "A"."""
+        node = target
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            parent = node
+            node = node.value
+            if (
+                isinstance(node, ast.Name)
+                and node.id == "self"
+                and isinstance(parent, ast.Attribute)
+            ):
+                return parent.attr
+        return None
+
+    # ------------------------------------------------------------------
+    def _on_acquire(
+        self, lock_id: str, held: Tuple[str, ...], line: int
+    ) -> None:
+        for h in held:
+            if h != lock_id:
+                self.edges.append(
+                    _Edge(h, lock_id, self.mod, line, self.where)
+                )
+
+    def _expr(self, expr: ast.AST, held: Tuple[str, ...]) -> None:
+        for node in walk_skipping(
+            expr, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                self.call_sites is not None
+                and isinstance(node.func, ast.Attribute)
+                and attr_chain(node.func.value) == ["self"]
+            ):
+                self.call_sites.setdefault(node.func.attr, []).append(
+                    (self.func.name, tuple(held))
+                )
+            if held:
+                self._check_blocking(node, held)
+                self._check_callback(node, held)
+                self._check_cross_method(node, held)
+            # container mutators on self attrs count as mutations
+            # regardless of hold state (LOCK004 needs both sides)
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in MUTATOR_METHODS
+            ):
+                attr = self._self_attr_of_target(f.value)
+                if attr is None:
+                    chain = attr_chain(f.value)
+                    if chain and chain[0] == "self" and len(chain) >= 2:
+                        attr = chain[1]
+                if attr is not None:
+                    self.mutations.append(
+                        (attr, node.lineno, bool(held), self.func.name)
+                    )
+
+    def _check_blocking(self, node: ast.Call, held) -> None:
+        cn = call_name(node) or ""
+        kind = None
+        for pat, k in BLOCKING_CALLS:
+            if pat.endswith("."):
+                if cn.startswith(pat) or ("." + pat) in ("." + cn):
+                    kind = k
+                    break
+            elif cn == pat or cn.endswith("." + pat):
+                kind = k
+                break
+        if kind is None and isinstance(node.func, ast.Attribute):
+            k = BLOCKING_METHODS.get(node.func.attr)
+            if k:
+                kind = k
+        if kind is None:
+            return
+        self.findings.append(
+            self.mod.finding(
+                "LOCK002",
+                SEV_ERROR,
+                node.lineno,
+                f"{kind} call ({cn or node.func.attr}) while holding "
+                f"{', '.join(held)} in {self.where} — every thread "
+                "contending on the lock convoys behind it; move the "
+                "blocking work outside the critical section",
+            )
+        )
+
+    def _check_callback(self, node: ast.Call, held) -> None:
+        f = node.func
+        name = None
+        if isinstance(f, ast.Attribute):
+            chain = attr_chain(f)
+            if chain and chain[0] == "self" and _CALLBACK_ATTR_RE.match(
+                f.attr
+            ):
+                name = f"self.{f.attr}"
+        elif isinstance(f, ast.Name) and _CALLBACK_ATTR_RE.match(f.id):
+            name = f.id
+        elif isinstance(f, ast.Name):
+            # loop variable over a callback-ish container:
+            # ``for obs in self._observers: obs(...)``
+            for anc in ast.walk(self.func):
+                if (
+                    isinstance(anc, (ast.For, ast.AsyncFor))
+                    and isinstance(anc.target, ast.Name)
+                    and anc.target.id == f.id
+                ):
+                    chain = attr_chain(anc.iter)
+                    if chain and chain[0] == "self" and _CALLBACK_ATTR_RE.match(
+                        chain[-1]
+                    ):
+                        name = f"{f.id} (from self.{chain[-1]})"
+                        break
+        if name is None:
+            return
+        self.findings.append(
+            self.mod.finding(
+                "LOCK003",
+                SEV_WARNING,
+                node.lineno,
+                f"callback {name} invoked while holding "
+                f"{', '.join(held)} in {self.where} — the callee can "
+                "acquire arbitrary locks or block; snapshot under the "
+                "lock, invoke after release (or document the ordering "
+                "invariant in a suppression)",
+            )
+        )
+
+    def _check_cross_method(self, node: ast.Call, held) -> None:
+        """One-level interprocedural edges: calling a method that is
+        known (by name, package-wide) to acquire locks."""
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return
+        mname = f.attr
+        if mname in _GENERIC_METHODS:
+            return
+        receiver = attr_chain(f.value)
+        is_self_call = receiver == ["self"]
+        targets: List[Tuple[_ClassInfo, Set[str]]] = []
+        if is_self_call:
+            acq = self.ci.method_acquires.get(mname)
+            if acq:
+                targets.append((self.ci, acq))
+        else:
+            targets = self.index.by_method.get(mname, [])
+        for tci, acquires in targets:
+            for lock in acquires:
+                for h in held:
+                    if h != lock:
+                        self.edges.append(
+                            _Edge(h, lock, self.mod, node.lineno,
+                                  f"{self.where} via .{mname}()")
+                        )
+
+
+# ---------------------------------------------------------------------------
+
+
+def _cycles(edges: List[_Edge]) -> List[List[_Edge]]:
+    """Simple lock-order cycles (length 2..4) in the acquisition graph,
+    deduped by node set. Returns one representative edge list each."""
+    graph: Dict[str, Dict[str, _Edge]] = {}
+    for e in edges:
+        graph.setdefault(e.src, {}).setdefault(e.dst, e)
+    out: List[List[_Edge]] = []
+    seen: Set[frozenset] = set()
+
+    def dfs(start: str, node: str, path: List[_Edge], depth: int):
+        if depth > 4:
+            return
+        for dst, edge in graph.get(node, {}).items():
+            if dst == start and path:
+                key = frozenset(
+                    [start] + [p.dst for p in path]
+                )
+                if key not in seen:
+                    seen.add(key)
+                    out.append(path + [edge])
+            elif all(p.dst != dst for p in path) and dst != start:
+                dfs(start, dst, path + [edge], depth + 1)
+
+    for start in sorted(graph):
+        dfs(start, start, [], 0)
+    return out
+
+
+def analyze_locks_module(
+    mod: ModuleSource, index: LockIndex
+) -> Tuple[List[Finding], List[_Edge]]:
+    """LOCK002/003/004 findings + acquisition edges for one module."""
+    findings: List[Finding] = []
+    edges: List[_Edge] = []
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        ci = next(
+            (c for c in index.classes
+             if c.mod.path == mod.path and c.node is cls),
+            None,
+        )
+        if ci is None:
+            ci = _ClassInfo(mod, cls)
+        mutations: List[Tuple[str, int, bool, str]] = []
+        for mname, mnode in ci.methods.items():
+            _MethodWalk(
+                mod, ci, index, mnode, findings, edges, mutations,
+                entry_held=ci.assumed_held.get(mname, ()),
+            )
+        if ci.lock_attrs:
+            _guard_inconsistency(mod, ci, mutations, findings)
+    return findings, edges
+
+
+def _guard_inconsistency(
+    mod: ModuleSource,
+    ci: _ClassInfo,
+    mutations: List[Tuple[str, int, bool, str]],
+    findings: List[Finding],
+) -> None:
+    """LOCK004: attr mutated both under a lock and bare."""
+    by_attr: Dict[str, List[Tuple[int, bool, str]]] = {}
+    for attr, line, held, method in mutations:
+        if method == "__init__" or attr in ci.lock_attrs:
+            continue
+        by_attr.setdefault(attr, []).append((line, held, method))
+    for attr, sites in sorted(by_attr.items()):
+        guarded = [s for s in sites if s[1]]
+        bare = [s for s in sites if not s[1]]
+        if not guarded or not bare:
+            continue
+        line, _, method = min(bare)
+        findings.append(
+            mod.finding(
+                "LOCK004",
+                SEV_WARNING,
+                line,
+                f"{ci.name}.{attr} is mutated under a lock elsewhere "
+                f"(e.g. {guarded[0][2]}:{guarded[0][0]}) but bare in "
+                f"{method} — the unguarded write races guarded "
+                "readers; take the lock or document why it's safe",
+            )
+        )
+
+
+def cycle_findings(edges: List[_Edge]) -> List[Finding]:
+    """LOCK001 findings from the package-wide acquisition graph. The
+    finding anchors at the first edge's acquisition site (suppressing
+    any edge site suppresses the cycle)."""
+    out: List[Finding] = []
+    for cyc in _cycles(edges):
+        path = " -> ".join([cyc[0].src] + [e.dst for e in cyc])
+        sites = "; ".join(
+            f"{e.mod.relpath}:{e.line} ({e.where})" for e in cyc
+        )
+        first = cyc[0]
+        f = first.mod.finding(
+            "LOCK001",
+            SEV_ERROR,
+            first.line,
+            f"potential lock-order cycle: {path} — acquisition sites: "
+            f"{sites}; pick one order and enforce it (or suppress with "
+            "the ordering invariant written out)",
+        )
+        # a suppression on ANY edge site kills the cycle finding
+        if any(
+            e.mod.is_suppressed("LOCK001", e.line) for e in cyc
+        ):
+            continue
+        out.append(f)
+    return out

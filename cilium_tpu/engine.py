@@ -350,7 +350,10 @@ class PolicyEngine:
             jj = np.asarray([x[1] for x in items])
             # value carried per write: 1 for appends, 0 for deletion
             # retractions (DirectionPacker.remove_rule)
-            vv8 = jnp.asarray(
+            # control-plane scatter prep: one upload per touched table
+            # (≤9 names), not a per-flow loop — the serving path never
+            # runs this
+            vv8 = jnp.asarray(  # policyd-lint: disable=TPU002
                 np.asarray([x[2] for x in items], np.int8)
             )
             if name in transposed:

@@ -59,8 +59,14 @@ class IdentityRegistry:
             self._row_of[ident.id] = len(self._id_of_row)
             self._id_of_row.append(ident.id)
         self.version += 1
+        # ordering invariant: observers must see add/remove events in
+        # `version` order — delivered outside the lock, a racing
+        # allocate/release pair could invert add-then-remove for the
+        # same identity and corrupt row-mapping consumers. Observers
+        # are contractually non-blocking and lock-free (engine appends
+        # to a pending list; prefixmap diffs two sets).
         for obs in self._observers:
-            obs(ident, True)
+            obs(ident, True)  # policyd-lint: disable=LOCK003
 
     def observe(self, fn: Callable[[Identity, bool], None]) -> None:
         """Register a change observer fn(identity, added)."""
@@ -142,8 +148,10 @@ class IdentityRegistry:
                 self._by_id.pop(ident.id, None)
                 self._by_labels.pop(ident.labels, None)
                 self.version += 1
+                # same ordering invariant as _insert: in-order,
+                # non-blocking observer delivery under the lock
                 for obs in self._observers:
-                    obs(ident, False)
+                    obs(ident, False)  # policyd-lint: disable=LOCK003
                 return True
             return False
 

@@ -97,23 +97,31 @@ class Allocator:
         read through to the store, but the cache is what makes repeated
         lookups and remote-identity resolution cheap."""
         n = 0
-        for ev in self._watcher.drain():
-            n += 1
-            if ev.typ == EventTypeListDone:
-                continue
-            try:
-                id_ = int(ev.key[len(self.id_prefix):])
-            except ValueError:
-                continue
-            if ev.typ in (EventTypeCreate, EventTypeModify):
-                key = (ev.value or b"").decode()
-                self._cache[id_] = key
-                if self._on_event:
-                    self._on_event("upsert", id_, key)
-            elif ev.typ == EventTypeDelete:
-                self._cache.pop(id_, None)
-                if self._on_event:
-                    self._on_event("delete", id_, None)
+        # mutate the cache under the lock (get()/cache_items() readers
+        # hold it); fire callbacks only after release — an observer that
+        # re-enters the allocator or takes its own lock must not do so
+        # under ours. pump() runs on one controller thread, so the
+        # deferred events still reach observers in watch order.
+        events: List[Tuple[str, int, Optional[str]]] = []
+        with self._lock:
+            for ev in self._watcher.drain():
+                n += 1
+                if ev.typ == EventTypeListDone:
+                    continue
+                try:
+                    id_ = int(ev.key[len(self.id_prefix):])
+                except ValueError:
+                    continue
+                if ev.typ in (EventTypeCreate, EventTypeModify):
+                    key = (ev.value or b"").decode()
+                    self._cache[id_] = key
+                    events.append(("upsert", id_, key))
+                elif ev.typ == EventTypeDelete:
+                    self._cache.pop(id_, None)
+                    events.append(("delete", id_, None))
+        if self._on_event:
+            for typ, id_, key in events:
+                self._on_event(typ, id_, key)
         return n
 
     # -- lookups --------------------------------------------------------

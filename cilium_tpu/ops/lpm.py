@@ -155,7 +155,10 @@ def lpm_lookup(
     for lvl in range(levels):
         byte = addr_bytes[:, lvl]
         flat = node * 256 + byte
-        hit = jnp.take(info.reshape(-1), flat)
+        # bounded static unroll: `levels` is a jit-static argument (4 or
+        # 16), so this traces ONCE into `levels` fused gathers — it is
+        # not a per-call dispatch loop
+        hit = jnp.take(info.reshape(-1), flat)  # policyd-lint: disable=TPU002
         best = jnp.where(alive & (hit > 0), hit, best)
         nxt = jnp.take(child.reshape(-1), flat)
         alive = alive & (nxt > 0)
@@ -480,13 +483,18 @@ def merge_flat_tries(ip_arrays, deny_arrays):
     arrays, or None when either side uses the 16-8-8 pointer layout
     (merging needs the dense form). Identity values must stay below
     DENY_BIT."""
-    ip_ri, ip_rc, ip_sc, ip_si = ip_arrays
-    d_ri, d_rc, d_sc, d_si = deny_arrays
+    # host-side table prep: the merge needs fancy indexing and in-place
+    # writes, so pin the inputs to numpy up front — a device array
+    # slipping in would otherwise turn every reduction below into a
+    # blocking transfer (and int(...) on it into a device sync)
+    ip_ri, ip_rc, ip_sc, ip_si = (np.asarray(a) for a in ip_arrays)
+    d_ri, d_rc, d_sc, d_si = (np.asarray(a) for a in deny_arrays)
     if ip_si.shape[-1] != 65536 or d_si.shape[-1] != 65536:
         return None
-    if int(ip_si.max(initial=0)) >= int(DENY_BIT) or int(
-        ip_ri.max(initial=0)
-    ) >= int(DENY_BIT):
+    if (
+        np.max(ip_si, initial=0) >= DENY_BIT
+        or np.max(ip_ri, initial=0) >= DENY_BIT
+    ):
         return None
 
     # hi16 buckets where either side holds longer-than-/16 prefixes

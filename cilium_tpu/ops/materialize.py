@@ -216,7 +216,10 @@ def materialize_endpoints_state(
         pad = min(_seg_bucket(hi - lo), seg_chunk) - (hi - lo)
         aw, l3w, rw = _sweep_device(
             device,
-            jnp.asarray(np.pad(sr[lo:hi], (0, pad))),
+            # control-plane rebuild: VRAM-bounded chunking over the
+            # segment sweep — a handful of large device calls, not a
+            # per-flow dispatch loop (the serving path never runs this)
+            jnp.asarray(np.pad(sr[lo:hi], (0, pad))),  # policyd-lint: disable=TPU002
             jnp.asarray(np.pad(sp[lo:hi], (0, pad))),
             jnp.asarray(np.pad(spr[lo:hi], (0, pad))),
             jnp.asarray(np.pad(sl[lo:hi], (0, pad))),

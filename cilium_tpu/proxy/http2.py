@@ -208,7 +208,11 @@ class _ConnBase:
 
     def send(self, data: bytes) -> None:
         with self._wlock:
-            self.sock.sendall(data)
+            # the write lock EXISTS to serialize whole frames onto one
+            # socket — h2 frames from concurrent streams must not
+            # interleave mid-frame; holding it across sendall is the
+            # design, not a convoy bug
+            self.sock.sendall(data)  # policyd-lint: disable=LOCK002
 
     def send_frame(self, ftype: int, flags: int, sid: int, payload: bytes = b"") -> None:
         self.send(pack_frame(ftype, flags, sid, payload))

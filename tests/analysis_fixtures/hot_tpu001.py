@@ -1,0 +1,46 @@
+# policyd: hot
+"""TPU001 fixture: host-sync coercions on device-flowing values."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def positive_int_coercion():
+    x = jnp.ones(4)
+    return int(x.sum())  # POS: int() on device value
+
+
+def positive_item():
+    x = jnp.zeros(3)
+    return x.item()  # POS: .item() sync
+
+
+def positive_np_pull_chain():
+    y = jnp.arange(8) * 2
+    z = y + 1
+    return np.asarray(z)  # POS: asarray on device-derived name
+
+
+def positive_reduction_warning(table):
+    # POS (warning): reduction-coercion on a parameter-derived array
+    return int(table.max(initial=0))
+
+
+def negative_plain_python():
+    n = len([1, 2, 3])
+    return int(n)  # NEG: no device flow
+
+
+def negative_numpy_only():
+    a = np.arange(4)
+    return np.asarray(a)  # NEG: numpy in, numpy out
+
+
+def negative_host_pull_result():
+    x = jnp.ones(4)
+    host = np.asarray(x)  # POS: the one intended pull
+    return int(host[0])  # NEG: already host data
+
+
+def negative_suppressed():
+    x = jnp.ones(2)
+    return int(x.sum())  # policyd-lint: disable=TPU001

@@ -1,0 +1,38 @@
+# policyd: hot
+"""TPU002 fixture: jnp calls inside Python loops."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def positive_loop(flows):
+    out = []
+    for f in flows:
+        out.append(jnp.take(f, 0))  # POS: per-iteration dispatch
+    return out
+
+
+def positive_while(t):
+    i = 0
+    while i < 4:
+        t = jnp.roll(t, 1)  # POS
+        i += 1
+    return t
+
+
+def negative_numpy_loop(rows):
+    acc = 0
+    for r in rows:
+        acc += np.sum(r)  # NEG: numpy, not device
+    return acc
+
+
+def negative_batched(flows):
+    return jnp.take(flows, 0, axis=1)  # NEG: no loop
+
+
+def negative_suppressed(xs):
+    for x in xs:
+        # comment-only suppression applies to the next line
+        # policyd-lint: disable=TPU002
+        xs = jnp.roll(xs, 1)
+    return xs

@@ -1,0 +1,20 @@
+# policyd: hot
+"""TPU004 fixture: dtype-literal drift across matmul operands."""
+import jax.numpy as jnp
+
+
+def positive_mixed(a, b):
+    # POS: int8 x int32 promotes off the int8 MXU path
+    return jnp.matmul(a.astype(jnp.int8), b.astype(jnp.int32))
+
+
+def positive_operator(a, b):
+    return a.astype(jnp.int8) @ b.astype(jnp.float32)  # POS
+
+
+def negative_aligned(a, b):
+    return jnp.matmul(a.astype(jnp.int8), b.astype(jnp.int8))  # NEG
+
+
+def negative_uncast(a, b):
+    return a @ b  # NEG: no literals to compare

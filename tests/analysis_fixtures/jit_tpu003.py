@@ -1,0 +1,21 @@
+"""TPU003 fixture: jit closing over a mutable global (fires even
+without the hot marker — it is a correctness bug anywhere)."""
+import jax
+import jax.numpy as jnp
+
+SCALE_TABLE = [1, 2, 4]  # mutable module-level state
+LIMIT = 7  # immutable: fine to close over
+
+
+@jax.jit
+def positive_closure(x):
+    return x * SCALE_TABLE[0]  # POS: traced once, mutation invisible
+
+
+@jax.jit
+def negative_argument(x, scale):
+    return x * scale + LIMIT  # NEG: passed in / immutable global
+
+
+def negative_not_jitted(x):
+    return x * SCALE_TABLE[0]  # NEG: plain python re-reads the list

@@ -1,0 +1,66 @@
+"""LOCK002/003/004 fixture: blocking ops, callbacks, guard drift."""
+import subprocess
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.data = {}
+        self.hits = 0
+        self.on_change = None
+        self._observers = []
+
+    def positive_io_under_lock(self, path):
+        with self._lock:
+            with open(path) as f:  # POS LOCK002: file I/O under lock
+                return f.read()
+
+    def positive_subprocess(self):
+        with self._lock:
+            subprocess.check_call(["true"])  # POS LOCK002
+
+    def positive_callback(self, key):
+        with self._lock:
+            self.data[key] = 1
+            if self.on_change:
+                self.on_change(key)  # POS LOCK003: callback under lock
+
+    def positive_observer_loop(self, key):
+        with self._lock:
+            for obs in self._observers:
+                obs(key)  # POS LOCK003: loop over observer container
+
+    def negative_io_outside(self, path):
+        with self._lock:
+            keys = list(self.data)
+        with open(path) as f:  # NEG: lock released first
+            return keys, f.read()
+
+    def guarded_bump(self):
+        with self._lock:
+            self.hits += 1  # guarded site for LOCK004
+
+    def positive_bare_bump(self):
+        self.hits += 1  # POS LOCK004: same attr, no lock
+
+    def _apply_locked(self, key):
+        # NEG LOCK004: *_locked suffix => analyzed as called-with-lock
+        self.data[key] = 2
+
+    def _drain_pending(self):
+        # NEG LOCK004: private helper, only ever called under the lock
+        self.data.clear()
+
+    def flush(self):
+        with self._lock:
+            self._drain_pending()
+
+    def positive_blocking_in_held_helper(self):
+        with self._lock:
+            self._write_out()
+
+    def _write_out(self):
+        # POS LOCK002 via held-context: only call site holds the lock
+        with open("/tmp/x", "w") as f:
+            f.write("state")

@@ -1,0 +1,39 @@
+"""LOCK001 fixture: two locks taken in both orders."""
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._map_lock = threading.Lock()
+        self._idx_lock = threading.Lock()
+        self.map = {}
+        self.idx = {}
+
+    def forward(self, k, v):
+        with self._map_lock:
+            with self._idx_lock:  # POS edge: map -> idx
+                self.map[k] = v
+                self.idx[v] = k
+
+    def backward(self, v):
+        with self._idx_lock:
+            with self._map_lock:  # POS edge: idx -> map (cycle!)
+                k = self.idx.get(v)
+                self.map.pop(k, None)
+                return k
+
+
+class Ordered:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:  # NEG: consistent a -> b order
+                pass
+
+    def two(self):
+        with self._a_lock:
+            with self._b_lock:  # NEG: same order again
+                pass

@@ -1,0 +1,15 @@
+# policyd: hot
+# policyd-lint: disable-file=TPU001
+"""File-wide suppression fixture: TPU001 silenced, TPU002 still live."""
+import jax.numpy as jnp
+
+
+def silenced():
+    x = jnp.ones(3)
+    return int(x.sum())  # NEG: file-wide TPU001 suppression
+
+
+def still_fires(xs):
+    for x in xs:
+        xs = jnp.roll(xs, 1)  # POS TPU002: not covered by disable-file
+    return xs

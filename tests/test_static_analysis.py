@@ -1,0 +1,203 @@
+"""policyd-lint gate + unit tests.
+
+The first test IS the CI gate: the whole package must be clean against
+the checked-in ``cilium_tpu/analysis/baseline.json``. The rest pin the
+analyzer's behavior on fixture snippets (one positive and one negative
+case per rule) and the baseline/suppression machinery.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from cilium_tpu.analysis import analyze_paths
+from cilium_tpu.analysis.baseline import (
+    default_baseline_path,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+from cilium_tpu.analysis.core import Finding
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "cilium_tpu")
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "analysis_fixtures"
+)
+
+
+def fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+def lines_of(findings, rule):
+    return sorted(f.line for f in findings if f.rule == rule)
+
+
+def run_cli(*args, **popen):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # keep the CLI import-light
+    return subprocess.run(
+        [sys.executable, "-m", "cilium_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO, env=env, **popen
+    )
+
+
+# ---------------------------------------------------------------- CI gate
+
+
+def test_package_clean_against_baseline():
+    """THE gate: no analyzer finding outside the checked-in baseline."""
+    findings = analyze_paths([PKG])
+    counts, _ = load_baseline(default_baseline_path())
+    fresh = new_findings(findings, counts)
+    assert not fresh, (
+        "new policyd-lint findings (fix them, suppress with a written "
+        "justification, or regenerate the baseline via "
+        "`python -m cilium_tpu.analysis --write-baseline`):\n"
+        + "\n".join(f.render() for f in fresh)
+    )
+
+
+def test_cli_package_exits_zero():
+    res = run_cli("--format", "json", "cilium_tpu/")
+    assert res.returncode == 0, res.stdout + res.stderr
+    payload = json.loads(res.stdout)
+    assert payload["tool"] == "policyd-lint"
+    assert payload["new"] == 0
+
+
+def test_cli_seeded_violation_exits_nonzero(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "# policyd: hot\n"
+        "import jax.numpy as jnp\n"
+        "def leak():\n"
+        "    x = jnp.ones(4)\n"
+        "    return int(x.sum())\n"
+    )
+    res = run_cli("--format", "json", str(bad))
+    assert res.returncode == 1, res.stdout + res.stderr
+    payload = json.loads(res.stdout)
+    assert payload["new"] == 1
+    assert payload["new_findings"][0]["rule"] == "TPU001"
+
+
+# ---------------------------------------------------------- Family A rules
+
+
+def test_tpu001_positive_and_negative():
+    f = analyze_paths([fixture("hot_tpu001.py")])
+    assert lines_of(f, "TPU001") == [9, 14, 20, 25, 40]
+    sev = {x.line: x.severity for x in f if x.rule == "TPU001"}
+    assert sev[9] == "error"  # int() on device value
+    assert sev[25] == "warning"  # reduction on param-derived array
+    # the np.asarray *result* is host data: int(host[0]) stays clean,
+    # and the same-line suppression at the end is honored
+    assert all(x.line not in (41, 47) for x in f)
+
+
+def test_tpu002_positive_and_negative():
+    f = analyze_paths([fixture("hot_tpu002.py")])
+    assert lines_of(f, "TPU002") == [10, 17]  # for-loop + while-loop
+    assert not any(x.rule == "TPU001" for x in f)
+
+
+def test_tpu003_fires_without_hot_marker():
+    f = analyze_paths([fixture("jit_tpu003.py")])
+    assert lines_of(f, "TPU003") == [12]
+    assert len(f) == 1  # negatives stay silent
+
+
+def test_tpu004_dtype_drift():
+    f = analyze_paths([fixture("hot_tpu004.py")])
+    assert lines_of(f, "TPU004") == [8, 12]
+    assert len(f) == 2
+
+
+def test_hot_gating_rules_need_hot_module(tmp_path):
+    cold = tmp_path / "cold.py"
+    cold.write_text(
+        "import jax.numpy as jnp\n"
+        "def f():\n"
+        "    x = jnp.ones(4)\n"
+        "    return int(x.sum())\n"
+    )
+    assert analyze_paths([str(cold)]) == []
+
+
+# ---------------------------------------------------------- Family B rules
+
+
+def test_lock001_cycle_detected_once():
+    f = analyze_paths([fixture("lock_cycle.py")])
+    cyc = [x for x in f if x.rule == "LOCK001"]
+    assert len(cyc) == 1
+    assert "_map_lock" in cyc[0].message and "_idx_lock" in cyc[0].message
+    # the consistently-ordered class contributes no cycle
+    assert "Ordered" not in cyc[0].message
+
+
+def test_lock002_003_004_blocking_fixture():
+    f = analyze_paths([fixture("lock_blocking.py")])
+    assert lines_of(f, "LOCK002") == [16, 21, 65]
+    assert lines_of(f, "LOCK003") == [27, 32]
+    assert lines_of(f, "LOCK004") == [45]
+
+
+def test_held_context_propagation():
+    """_write_out only runs under the lock → its open() is LOCK002;
+    *_locked / always-held helpers raise no LOCK004 for their writes."""
+    f = analyze_paths([fixture("lock_blocking.py")])
+    held = [x for x in f if x.line == 65]
+    assert held and held[0].rule == "LOCK002"
+    assert "called with lock held" in held[0].message
+    assert not any(
+        x.rule == "LOCK004" and "data" in x.message for x in f
+    )
+
+
+# ------------------------------------------------- suppressions + baseline
+
+
+def test_file_level_suppression():
+    f = analyze_paths([fixture("suppressed_file.py")])
+    assert not any(x.rule == "TPU001" for x in f)
+    assert lines_of(f, "TPU002") == [14]
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = analyze_paths([fixture("hot_tpu001.py")])
+    assert findings
+    path = str(tmp_path / "baseline.json")
+    write_baseline(findings, path)
+    counts, _ = load_baseline(path)
+    assert new_findings(findings, counts) == []
+    # editing the flagged line invalidates its entry (context changed)
+    f0 = findings[0]
+    edited = Finding(
+        rule=f0.rule, severity=f0.severity, path=f0.path,
+        line=f0.line, message=f0.message, context="return int(other)",
+    )
+    assert new_findings([edited], counts) == [edited]
+    # a second identical violation exceeds the count budget
+    assert new_findings([f0, f0], counts) == [f0]
+
+
+def test_baseline_preserves_justifications(tmp_path):
+    findings = analyze_paths([fixture("hot_tpu001.py")])
+    path = str(tmp_path / "baseline.json")
+    key = findings[0].key()
+    write_baseline(findings, path, justifications={key: "intended pull"})
+    _, notes = load_baseline(path)
+    assert notes[key] == "intended pull"
+
+
+def test_cli_write_baseline_then_clean(tmp_path):
+    path = str(tmp_path / "b.json")
+    res = run_cli("--write-baseline", "--baseline", path,
+                  fixture("lock_blocking.py"))
+    assert res.returncode == 0, res.stdout + res.stderr
+    res = run_cli("--baseline", path, fixture("lock_blocking.py"))
+    assert res.returncode == 0, res.stdout + res.stderr
