@@ -817,33 +817,59 @@ def _bench_dispatch_rtt() -> float:
     return sorted(samples)[len(samples) // 2] * 1000
 
 
-def _attach_watchdog(timeout_s: float):
+class _AttachStages:
+    """Staged attach heartbeat: ``stage(name)`` records progress so a
+    wedged round reports the LAST COMPLETED stage instead of a bare
+    timeout; ``set()`` disarms the watchdog."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self.done = threading.Event()
+        self.last = "start"
+        self.t0 = time.time()
+        self.history: list = []
+
+    def stage(self, name: str) -> None:
+        self.last = name
+        self.history.append([name, round(time.time() - self.t0, 3)])
+
+    def set(self) -> None:
+        self.done.set()
+
+
+def _attach_watchdog(timeout_s: float) -> _AttachStages:
     """The axon tunnel can wedge indefinitely at device attach (seen
     in-round: >6h unresponsive). A silent hang records NOTHING for the
     round — this watchdog emits an explanatory one-line JSON and exits
-    instead, so the failure is visible and bounded. Disarmed the
-    moment the first device op completes."""
+    instead, so the failure is visible and bounded. Disarmed once the
+    full attach sequence (backend init → device visible → first
+    compile → first batch) completes; on timeout the JSON tail names
+    the last completed stage."""
     import threading
 
-    attached = threading.Event()
+    st = _AttachStages()
 
     def watch():
-        if attached.wait(timeout_s):
+        if st.done.wait(timeout_s):
             return
         print(json.dumps({
             "metric": f"policy verdicts/sec at {N_RULES} rules",
             "value": 0,
             "unit": "verdicts/s",
             "vs_baseline": 0.0,
+            "attach_stage": st.last,
+            "attach_history": st.history,
             "error": (
                 f"TPU attach did not complete within {timeout_s:.0f}s "
-                "(axon tunnel wedged?) — no measurements taken"
+                f"(axon tunnel wedged?) — last completed stage: "
+                f"{st.last} — no measurements taken"
             ),
         }), flush=True)
         os._exit(3)
 
     threading.Thread(target=watch, daemon=True).start()
-    return attached
+    return st
 
 
 def _lint_preflight() -> None:
@@ -884,9 +910,12 @@ def main() -> None:
     attached = _attach_watchdog(
         float(os.environ.get("BENCH_ATTACH_TIMEOUT", 900))
     )
-    # first device op: forces backend init through the tunnel
+    attached.stage("backend-init")
+    devs = jax.devices()  # backend handshake; no program yet
+    attached.stage(f"device-visible:{devs[0].platform}")
+    # first device op: forces the first XLA compile through the tunnel
     jax.block_until_ready(jnp.zeros(8) + 1)
-    attached.set()
+    attached.stage("first-compile")
 
     rng = random.Random(42)
     t0 = time.time()
@@ -898,6 +927,7 @@ def main() -> None:
     compiled = engine.refresh()
     jax.block_until_ready(engine.device_policy.sel_match)
     t_compile = time.time() - t0
+    attached.stage("policy-compile")
 
     ep_ids = [idents[i].id for i in range(N_ENDPOINTS)]
     t0 = time.time()
@@ -920,6 +950,8 @@ def main() -> None:
 
     dec, red = lookup_batch(tables, ep_idx, src, dport, proto)
     jax.block_until_ready(dec)
+    attached.stage("first-batch")
+    attached.set()
 
     t0 = time.time()
     for _ in range(ITERS):

@@ -120,6 +120,9 @@ class APIClient:
     def debuginfo(self):
         return self._request("GET", "/debuginfo")
 
+    def traces_get(self, limit: int = 16):
+        return self._request("GET", f"/traces?limit={limit}")
+
     def fqdn_poll(self):
         return self._request("POST", "/fqdn/poll")
 

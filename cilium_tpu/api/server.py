@@ -159,6 +159,9 @@ class _Handler(UnixHandler):
             self._json(200, d.health_probe_now())
         elif path == "/debuginfo" and method == "GET":
             self._json(200, d.debuginfo())
+        elif path == "/traces" and method == "GET":
+            limit = int(q.get("limit", ["16"])[0])
+            self._json(200, d.traces(limit=limit))
         elif path == "/fqdn/poll" and method == "POST":
             self._json(200, d.fqdn_poll())
         elif path == "/service" and method == "GET":

@@ -58,6 +58,9 @@ def collect_debuginfo(daemon) -> Dict:
         },
         "health": daemon.health.report(),
         "accesslog": [r.to_dict() for r in daemon.proxy.accesslog.recent(200)],
+        # policyd-trace ring (metrics.prom in the archive carries the
+        # matching /metrics snapshot via write_archive_from)
+        "traces": daemon.traces(limit=64),
     }
 
 

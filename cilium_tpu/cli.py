@@ -101,6 +101,9 @@ class _Surface:
     def _d_debuginfo(self):
         return self._daemon.debuginfo()
 
+    def _d_traces_get(self, limit=16):
+        return self._daemon.traces(limit=limit)
+
     def _d_config_get(self):
         return self._daemon.config_get()
 
@@ -228,9 +231,18 @@ def build_parser() -> argparse.ArgumentParser:
     mon.add_argument("--json", action="store_true", help="print raw events")
     mon.add_argument("--type", action="append", default=None,
                      dest="types", metavar="TYPE",
-                     choices=["drop", "trace", "agent", "l7", "capture"],
+                     choices=["drop", "trace", "agent", "l7", "capture",
+                              "trace-summary"],
                      help="only these event types (repeatable; "
                           "cilium monitor --type)")
+
+    trc = sub.add_parser(
+        "traces", help="print recent verdict-batch phase waterfalls"
+    )
+    trc.add_argument("-n", "--last", type=int, default=5,
+                     help="how many traces to show (default 5)")
+    trc.add_argument("--json", action="store_true",
+                     help="raw trace dicts instead of waterfalls")
     mon.add_argument("--timeout", type=float, default=None,
                      help="stop after N idle seconds (default: run forever)")
 
@@ -684,11 +696,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             EVENT_DROP,
             EVENT_L7,
             EVENT_TRACE,
+            EVENT_TRACE_SUMMARY,
         )
 
         _type_names = {EVENT_DROP: "drop", EVENT_TRACE: "trace",
                        EVENT_AGENT: "agent", EVENT_L7: "l7",
-                       EVENT_CAPTURE: "capture"}
+                       EVENT_CAPTURE: "capture",
+                       EVENT_TRACE_SUMMARY: "trace-summary"}
         try:
             for ev in monitor_stream(path, timeout=args.timeout):
                 if args.types and _type_names.get(ev.type) not in args.types:
@@ -972,6 +986,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return 1
         else:
             _print(s.health_probe() if args.probe else s.health())
+    elif args.cmd == "traces":
+        out = s.traces_get(limit=args.last)
+        if args.json:
+            _print(out)
+        else:
+            from .monitor.dissect import render_waterfall
+
+            if not out.get("enabled") and not out.get("traces"):
+                print("phase tracing is disabled (enable with "
+                      "`cilium-tpu config PhaseTracing=true`)")
+            for t in out.get("traces", ()):
+                print(render_waterfall(
+                    t["kind"], t["batch"], t["total_ns"], t["phases"],
+                ))
+                print()
     elif args.cmd == "bugtool":
         import time as _time
 

@@ -687,7 +687,10 @@ class Daemon:
     # rest are rejected so the surface never claims changes it cannot
     # deliver (the reference verifies per-option too, option.go)
     _MUTABLE_OPTIONS = frozenset(
-        {"Conntrack", "TraceNotification", "DropNotification", "Debug"}
+        {
+            "Conntrack", "TraceNotification", "DropNotification", "Debug",
+            "PhaseTracing",
+        }
     )
 
     def _on_option_change(self, name: str, value: bool) -> None:
@@ -705,6 +708,12 @@ class Daemon:
             self.pipeline.conntrack = self.conntrack if value else None
         elif name == "DropNotification":
             self.pipeline.drop_notifications = value
+        elif name == "PhaseTracing":
+            # policyd-trace: span tracing on the verdict path
+            if value:
+                self.pipeline.tracer.enable()
+            else:
+                self.pipeline.tracer.disable()
         elif name == "Debug":
             import logging as _logging
 
@@ -919,6 +928,15 @@ class Daemon:
         from . import bugtool
 
         return bugtool.collect_debuginfo(self)
+
+    def traces(self, limit: int = 16) -> Dict:
+        """GET /traces (policyd-trace ring buffer)."""
+        tr = self.pipeline.tracer
+        return {
+            "enabled": tr.active,
+            "capacity": tr.capacity,
+            "traces": tr.traces(limit),
+        }
 
     # -- status ---------------------------------------------------------
     def status(self) -> Dict:

@@ -31,8 +31,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import metrics as _metrics
 from ..engine import PolicyEngine
 from ..identity.model import ID_WORLD
+from ..observe.tracer import NOOP_BATCH as _NOOP_BATCH, Tracer
 from ..ipcache.ipcache import IPCache
 from ..ipcache.prefilter import PreFilter
 from ..ops.lookup import PolicymapTables, lookup_batch
@@ -62,6 +64,14 @@ FORWARD = 1
 DROP_POLICY = 2
 DROP_PREFILTER = 3
 DROP_NO_SERVICE = 4  # frontend matched but zero backends (lb4_local)
+
+# verdict code → metrics outcome label (metricsmap REASON strings)
+_OUTCOME_NAMES = (
+    (FORWARD, "forwarded"),
+    (DROP_POLICY, "dropped_policy"),
+    (DROP_PREFILTER, "dropped_prefilter"),
+    (DROP_NO_SERVICE, "dropped_no_service"),
+)
 
 
 @chex.dataclass(frozen=True)
@@ -431,6 +441,7 @@ class DatapathPipeline:
         lb=None,  # Optional[lb.service.ServiceManager]
         monitor=None,  # Optional[monitor.hub.MonitorHub]
         device_ct_bits: Optional[int] = None,
+        tracer: Optional[Tracer] = None,
     ) -> None:
         self.engine = engine
         self.ipcache = ipcache
@@ -451,6 +462,13 @@ class DatapathPipeline:
             self.conntrack = FlowConntrack(capacity_bits=max(10, device_ct_bits))
         self.lb = lb
         self.monitor = monitor
+        # policyd-trace span tracer (observe/): off by default — the
+        # verdict path pays one `tracer.active` attribute read per
+        # batch (the hub's `active` pattern) until enabled
+        self.tracer = tracer if tracer is not None else Tracer()
+        # jit-cache key shapes already dispatched (tracing telemetry:
+        # a new member ≈ one XLA recompile)
+        self._seen_shapes: set = set()
         # called for every redirect verdict with a known 5-tuple:
         # fn(peer_addr_bytes, ep_idx, sport, dport, proto, ingress,
         # family) — the cilium_proxy4/6 write hook (bpf_lxc.c inserts
@@ -878,6 +896,18 @@ class DatapathPipeline:
         if events:
             hub.publish_many(events)
 
+    def _account_batch(self, verdict: np.ndarray) -> None:
+        """Registry accounting for one completed batch (the metricsmap →
+        pkg/metrics bridge). Post-host-sync by construction: callers
+        pass the already-pulled numpy verdict array, so no new device
+        syncs happen here."""
+        counts = np.bincount(verdict.astype(np.int64), minlength=5)
+        _metrics.verdict_batches.inc({"path": "pipeline"})
+        for code, outcome in _OUTCOME_NAMES:
+            n = int(counts[code])
+            if n:
+                _metrics.verdicts_total.inc({"outcome": outcome}, float(n))
+
     def _dispatch(
         self,
         peer_bytes: np.ndarray,
@@ -907,36 +937,70 @@ class DatapathPipeline:
         # empty deny set skips the walk entirely (it's one of the two
         # LPM walks that dominate the pipeline)
         pf_stage = ingress and not pf_empty[0 if family == 4 else 1]
-        if family == 4:
-            peer_u32 = _pack_v4_u32(peer_bytes)
-            v, red, counters = process_flows_wide(
-                t,
-                jnp.asarray(peer_u32),
-                jnp.asarray(ep_idx),
-                jnp.asarray(dports),
-                jnp.asarray(protos),
-                ep_count=max(1, len(self._endpoints)),
-                prefilter=pf_stage,
-                row_override=ro,
+        ep_count = max(1, len(self._endpoints))
+        tr = self.tracer
+        if tr.active:
+            bt = tr.current()
+            # shape-bucket telemetry: the jit cache keys on padded
+            # batch shape + the static args below — a fresh key on
+            # this pipeline ≈ one XLA recompile on dispatch
+            key = (
+                direction, family, peer_bytes.shape[0], pf_stage,
+                ep_count, ro is not None, v6_fused,
             )
+            if key in self._seen_shapes:
+                _metrics.jit_shape_buckets_total.inc(
+                    {"site": "dispatch", "result": "hit"}
+                )
+            else:
+                self._seen_shapes.add(key)
+                _metrics.jit_shape_buckets_total.inc(
+                    {"site": "dispatch", "result": "miss"}
+                )
+            _metrics.device_transfers_total.inc(
+                {"direction": "h2d"}, 4.0 + (ro is not None)
+            )
+            _metrics.device_transfers_total.inc({"direction": "d2h"}, 3.0)
+            bt.mark(padded=int(peer_bytes.shape[0]))
         else:
-            v, red, counters = process_flows(
-                t,
-                jnp.asarray(peer_bytes),
-                jnp.asarray(ep_idx),
-                jnp.asarray(dports),
-                jnp.asarray(protos),
-                ep_count=max(1, len(self._endpoints)),
-                levels=16,
-                prefilter=pf_stage,
-                fused=v6_fused,
-                row_override=ro,
+            bt = _NOOP_BATCH
+        # "dispatch" covers the h2d uploads + the async XLA enqueue of
+        # the FUSED device program (LPM walks + policymap lookup +
+        # counter matmul trace as one jit — splitting them into
+        # separate spans would de-fuse the program); the actual device
+        # execution time aggregates into "host_sync" below.
+        with bt.phase("dispatch"):
+            if family == 4:
+                peer_u32 = _pack_v4_u32(peer_bytes)
+                v, red, counters = process_flows_wide(
+                    t,
+                    jnp.asarray(peer_u32),
+                    jnp.asarray(ep_idx),
+                    jnp.asarray(dports),
+                    jnp.asarray(protos),
+                    ep_count=ep_count,
+                    prefilter=pf_stage,
+                    row_override=ro,
+                )
+            else:
+                v, red, counters = process_flows(
+                    t,
+                    jnp.asarray(peer_bytes),
+                    jnp.asarray(ep_idx),
+                    jnp.asarray(dports),
+                    jnp.asarray(protos),
+                    ep_count=ep_count,
+                    levels=16,
+                    prefilter=pf_stage,
+                    fused=v6_fused,
+                    row_override=ro,
+                )
+        with bt.phase("host_sync"):
+            return (
+                np.asarray(v)[:b],
+                np.asarray(red)[:b],
+                np.asarray(counters),
             )
-        return (
-            np.asarray(v)[:b],
-            np.asarray(red)[:b],
-            np.asarray(counters),
-        )
 
     def _process(
         self,
@@ -952,21 +1016,66 @@ class DatapathPipeline:
         want_rev_nat: bool = False,
         tunnel_identities: Optional[np.ndarray] = None,
     ):
-        self.rebuild()
-        ep_idx = np.asarray(ep_idx, np.int32)
-        dports = np.asarray(dports, np.int32)
-        protos = np.asarray(protos, np.int32)
-        b = peer_bytes.shape[0]
-
-        # Overlay path (bpf_overlay.c): decapped flows carry the peer's
-        # security identity in the tunnel key — trust it over the
-        # ipcache LPM when it resolves to a known device row; unknown
-        # or zero identities fall back to the LPM walk.
-        row_override: Optional[np.ndarray] = None
-        if tunnel_identities is not None:
-            row_override = self.engine.rows_or_negative(
-                np.asarray(tunnel_identities, np.int64)
+        """Trace shell around _process_inner: the disabled cost is ONE
+        ``tracer.active`` attribute read per batch (the hub's `active`
+        pattern, observe/tracer.py) — the no-op singleton constructs
+        zero span objects. Enabled batches open a BatchTrace whose
+        phases the inner body (and _dispatch, via the thread-local
+        span stack) fill in."""
+        tr = self.tracer
+        if not tr.active:
+            return self._process_inner(
+                peer_bytes, ep_idx, dports, protos, sports,
+                ingress=ingress, family=family, peer_words=peer_words,
+                want_rev_nat=want_rev_nat,
+                tunnel_identities=tunnel_identities, bt=_NOOP_BATCH,
             )
+        bt = tr.begin(
+            f"v{family}-{'ingress' if ingress else 'egress'}",
+            peer_bytes.shape[0],
+        )
+        try:
+            return self._process_inner(
+                peer_bytes, ep_idx, dports, protos, sports,
+                ingress=ingress, family=family, peer_words=peer_words,
+                want_rev_nat=want_rev_nat,
+                tunnel_identities=tunnel_identities, bt=bt,
+            )
+        finally:
+            bt.end(self.monitor)
+
+    def _process_inner(
+        self,
+        peer_bytes: np.ndarray,  # [B, 4|16] int32 peer address bytes
+        ep_idx: np.ndarray,
+        dports: np.ndarray,
+        protos: np.ndarray,
+        sports: Optional[np.ndarray],
+        *,
+        ingress: bool,
+        family: int,
+        peer_words: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        want_rev_nat: bool = False,
+        tunnel_identities: Optional[np.ndarray] = None,
+        bt=_NOOP_BATCH,
+    ):
+        with bt.phase("rebuild"):
+            self.rebuild()
+        with bt.phase("prepare"):
+            ep_idx = np.asarray(ep_idx, np.int32)
+            dports = np.asarray(dports, np.int32)
+            protos = np.asarray(protos, np.int32)
+            b = peer_bytes.shape[0]
+
+            # Overlay path (bpf_overlay.c): decapped flows carry the
+            # peer's security identity in the tunnel key — trust it over
+            # the ipcache LPM when it resolves to a known device row;
+            # unknown or zero identities fall back to the LPM walk.
+            row_override: Optional[np.ndarray] = None
+            if tunnel_identities is not None:
+                row_override = self.engine.rows_or_negative(
+                    np.asarray(tunnel_identities, np.int64)
+                )
 
         # --- LB stage (egress only): VIP→backend translate -------------
         # bpf_lxc.c:444-455 — the service lookup precedes conntrack and
@@ -975,32 +1084,36 @@ class DatapathPipeline:
         svc_drop: Optional[np.ndarray] = None
         revnat_vals: Optional[np.ndarray] = None
         if not ingress and self.lb is not None:
-            lbt = self._lb_tables.get(family)
-            if lbt is not None:
-                # hash over STABLE endpoint ids so unrelated endpoint
-                # churn cannot re-select backends for established flows
-                if self._endpoint_ids:
-                    ep_ids = np.asarray(self._endpoint_ids, np.int64)[
-                        np.clip(ep_idx, 0, len(self._endpoint_ids) - 1)
-                    ]
-                else:
-                    ep_ids = ep_idx
-                fh = flow_hash32(peer_bytes, sports, dports, protos, ep_ids)
-                nb, npo, rv, ok, nobk = lb_translate(
-                    lbt,
-                    jnp.asarray(peer_bytes),
-                    jnp.asarray(dports),
-                    jnp.asarray(protos),
-                    jnp.asarray(fh),
-                )
-                ok = np.asarray(ok)
-                nobk = np.asarray(nobk)
-                if ok.any() or nobk.any():
-                    peer_bytes = np.asarray(nb)
-                    dports = np.asarray(npo, np.int32)
-                    revnat_vals = np.asarray(rv).astype(np.uint16)
-                    svc_drop = nobk
-                    peer_words = None  # address changed — repack for CT
+            with bt.phase("lb_translate"):
+                lbt = self._lb_tables.get(family)
+                if lbt is not None:
+                    # hash over STABLE endpoint ids so unrelated
+                    # endpoint churn cannot re-select backends for
+                    # established flows
+                    if self._endpoint_ids:
+                        ep_ids = np.asarray(self._endpoint_ids, np.int64)[
+                            np.clip(ep_idx, 0, len(self._endpoint_ids) - 1)
+                        ]
+                    else:
+                        ep_ids = ep_idx
+                    fh = flow_hash32(
+                        peer_bytes, sports, dports, protos, ep_ids
+                    )
+                    nb, npo, rv, ok, nobk = lb_translate(
+                        lbt,
+                        jnp.asarray(peer_bytes),
+                        jnp.asarray(dports),
+                        jnp.asarray(protos),
+                        jnp.asarray(fh),
+                    )
+                    ok = np.asarray(ok)
+                    nobk = np.asarray(nobk)
+                    if ok.any() or nobk.any():
+                        peer_bytes = np.asarray(nb)
+                        dports = np.asarray(npo, np.int32)
+                        revnat_vals = np.asarray(rv).astype(np.uint16)
+                        svc_drop = nobk
+                        peer_words = None  # address changed — repack for CT
 
         # ── device-resident conntrack: ONE fused program per batch ──
         # Host fallbacks: any family with an active LB table (BOTH
@@ -1028,65 +1141,70 @@ class DatapathPipeline:
                 peer_bytes, ep_idx, dports, protos, ingress=ingress,
                 family=family, row_override=row_override,
             )
-            if svc_drop is not None and svc_drop.any():
-                v = v.copy()
-                red = red.copy()
-                v[svc_drop] = DROP_NO_SERVICE
-                red[svc_drop] = False
-                # device counters classified these flows pre-override —
-                # accumulate host-side instead for this batch
-                with self._lock:
-                    if self.counters.shape[0] == max(1, len(self._endpoints)):
-                        cls = np.select(
-                            [v == FORWARD, v == DROP_POLICY], [0, 1], default=2
-                        )
-                        np.add.at(self.counters, (ep_idx, cls), 1)
-            else:
-                with self._lock:
-                    if self.counters.shape == counters.shape:
-                        self.counters += counters
-            self._emit_flow_events(
-                peer_bytes, ep_idx, dports, protos, v,
-                ingress=ingress, family=family, redirect=red,
-            )
+            with bt.phase("counters"):
+                if svc_drop is not None and svc_drop.any():
+                    v = v.copy()
+                    red = red.copy()
+                    v[svc_drop] = DROP_NO_SERVICE
+                    red[svc_drop] = False
+                    # device counters classified these flows
+                    # pre-override — accumulate host-side instead for
+                    # this batch
+                    with self._lock:
+                        if self.counters.shape[0] == max(1, len(self._endpoints)):
+                            cls = np.select(
+                                [v == FORWARD, v == DROP_POLICY], [0, 1], default=2
+                            )
+                            np.add.at(self.counters, (ep_idx, cls), 1)
+                else:
+                    with self._lock:
+                        if self.counters.shape == counters.shape:
+                            self.counters += counters
+                self._account_batch(v)
+            with bt.phase("emit_events"):
+                self._emit_flow_events(
+                    peer_bytes, ep_idx, dports, protos, v,
+                    ingress=ingress, family=family, redirect=red,
+                )
             if want_rev_nat:
                 # no CT → replies can't be recognized → no NAT restore
                 return v, red, np.zeros(b, np.uint16)
             return v, red
 
         # --- conntrack pre-pass (vectorized host) ----------------------
-        sports = np.asarray(sports, np.int64)
-        if peer_words is not None:
-            # caller already holds packed address words (IPv4 u32 path)
-            peer_hi, peer_lo = peer_words
-        else:
-            bytes64 = peer_bytes.astype(np.uint64)
-            if family == 4:
-                peer_lo = (
-                    (bytes64[:, 0] << 24) | (bytes64[:, 1] << 16)
-                    | (bytes64[:, 2] << 8) | bytes64[:, 3]
-                )
-                peer_hi = np.zeros(b, np.uint64)
+        with bt.phase("ct_prepass"):
+            sports = np.asarray(sports, np.int64)
+            if peer_words is not None:
+                # caller already holds packed address words (IPv4 u32 path)
+                peer_hi, peer_lo = peer_words
             else:
-                shift = np.arange(7, -1, -1, dtype=np.uint64) * np.uint64(8)
-                peer_hi = (bytes64[:, :8] << shift).sum(axis=1, dtype=np.uint64)
-                peer_lo = (bytes64[:, 8:] << shift).sum(axis=1, dtype=np.uint64)
-        direction = np.full(b, 0 if ingress else 1, np.uint64)
-        ka, kb, kc = pack_keys(
-            peer_hi, peer_lo, ep_idx.astype(np.uint64), sports,
-            dports.astype(np.uint64), protos.astype(np.uint64), direction,
-        )
-        if want_rev_nat:
-            from .conntrack import CT_REPLY
+                bytes64 = peer_bytes.astype(np.uint64)
+                if family == 4:
+                    peer_lo = (
+                        (bytes64[:, 0] << 24) | (bytes64[:, 1] << 16)
+                        | (bytes64[:, 2] << 8) | bytes64[:, 3]
+                    )
+                    peer_hi = np.zeros(b, np.uint64)
+                else:
+                    shift = np.arange(7, -1, -1, dtype=np.uint64) * np.uint64(8)
+                    peer_hi = (bytes64[:, :8] << shift).sum(axis=1, dtype=np.uint64)
+                    peer_lo = (bytes64[:, 8:] << shift).sum(axis=1, dtype=np.uint64)
+            direction = np.full(b, 0 if ingress else 1, np.uint64)
+            ka, kb, kc = pack_keys(
+                peer_hi, peer_lo, ep_idx.astype(np.uint64), sports,
+                dports.astype(np.uint64), protos.astype(np.uint64), direction,
+            )
+            if want_rev_nat:
+                from .conntrack import CT_REPLY
 
-            # revNAT ids read under the SAME lock hold as the find: a
-            # timer gc()/compact between the lookup and a post-hoc
-            # revnat read could hand back another flow's id
-            state, slot, ct_rev = ct.lookup_batch(ka, kb, kc, want_revnat=True)
-            ct_rev[state != CT_REPLY] = 0
-        else:
-            state, slot = ct.lookup_batch(ka, kb, kc)
-        miss = state == CT_NEW
+                # revNAT ids read under the SAME lock hold as the find:
+                # a timer gc()/compact between the lookup and a post-hoc
+                # revnat read could hand back another flow's id
+                state, slot, ct_rev = ct.lookup_batch(ka, kb, kc, want_revnat=True)
+                ct_rev[state != CT_REPLY] = 0
+            else:
+                state, slot = ct.lookup_batch(ka, kb, kc)
+            miss = state == CT_NEW
 
         verdict = np.full(b, FORWARD, np.int8)
         redirect = np.zeros(b, bool)
@@ -1118,13 +1236,14 @@ class DatapathPipeline:
             # reference tracks them in the proxymap instead).
             ok = (v == FORWARD) & ~red
             if ok.any():
-                oidx = midx[ok]
-                ct.create_batch(
-                    ka[oidx],
-                    kb[oidx],
-                    kc[oidx],
-                    revnat=None if revnat_vals is None else revnat_vals[oidx],
-                )
+                with bt.phase("ct_create"):
+                    oidx = midx[ok]
+                    ct.create_batch(
+                        ka[oidx],
+                        kb[oidx],
+                        kc[oidx],
+                        revnat=None if revnat_vals is None else revnat_vals[oidx],
+                    )
 
         # proxymap handoff: redirected flows carry their full 5-tuple
         # here (sports present) — record for the L7 front-end
@@ -1137,18 +1256,21 @@ class DatapathPipeline:
                 )
 
         # host counter accumulation (CT hits included)
-        with self._lock:
-            if self.counters.shape[0] == max(1, len(self._endpoints)):
-                cls = np.select(
-                    [verdict == FORWARD, verdict == DROP_POLICY],
-                    [0, 1],
-                    default=2,
-                )
-                np.add.at(self.counters, (ep_idx, cls), 1)
-        self._emit_flow_events(
-            peer_bytes, ep_idx, dports, protos, verdict,
-            ingress=ingress, family=family, redirect=redirect,
-        )
+        with bt.phase("counters"):
+            with self._lock:
+                if self.counters.shape[0] == max(1, len(self._endpoints)):
+                    cls = np.select(
+                        [verdict == FORWARD, verdict == DROP_POLICY],
+                        [0, 1],
+                        default=2,
+                    )
+                    np.add.at(self.counters, (ep_idx, cls), 1)
+            self._account_batch(verdict)
+        with bt.phase("emit_events"):
+            self._emit_flow_events(
+                peer_bytes, ep_idx, dports, protos, verdict,
+                ingress=ingress, family=family, redirect=redirect,
+            )
         if want_rev_nat:
             # revNAT restore (bpf/lib/lb.h lb4_rev_nat via the CT
             # entry's rev_nat_index): flows whose CT hit is in the
@@ -1176,6 +1298,8 @@ class DatapathPipeline:
 
         from .device_ct import make_state
 
+        tr = self.tracer
+        bt = tr.current() if tr.active else _NOOP_BATCH
         direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
         # same atomic snapshot rule as _dispatch (fused flag must match
         # the tables it was computed with)
@@ -1194,32 +1318,37 @@ class DatapathPipeline:
             if self._device_ct is None:
                 self._device_ct = make_state(self._device_ct_bits)
             state = self._device_ct
-            v, red, counters, new_state = process_flows_ct(
-                t,
-                state,
-                jnp.asarray(peer),
-                jnp.asarray(ep_idx),
-                jnp.asarray(dports),
-                jnp.asarray(protos),
-                jnp.asarray(sports),
-                jnp.asarray(np.int32(0 if ingress else 1)),
-                now,
-                jnp.asarray(valid),
-                ep_count=max(1, len(self._endpoints)),
-                prefilter=(
-                    ingress
-                    and not pf_empty[0 if family == 4 else 1]
-                ),
-                levels=16,
-                family=family,
-                fused=v6_fused if family == 6 else False,
-            )
+            with bt.phase("dispatch"):
+                v, red, counters, new_state = process_flows_ct(
+                    t,
+                    state,
+                    jnp.asarray(peer),
+                    jnp.asarray(ep_idx),
+                    jnp.asarray(dports),
+                    jnp.asarray(protos),
+                    jnp.asarray(sports),
+                    jnp.asarray(np.int32(0 if ingress else 1)),
+                    now,
+                    jnp.asarray(valid),
+                    ep_count=max(1, len(self._endpoints)),
+                    prefilter=(
+                        ingress
+                        and not pf_empty[0 if family == 4 else 1]
+                    ),
+                    levels=16,
+                    family=family,
+                    fused=v6_fused if family == 6 else False,
+                )
             self._device_ct = new_state
-            counters = np.asarray(counters)
+            with bt.phase("host_sync"):
+                counters = np.asarray(counters)
             if self.counters.shape == counters.shape:
                 self.counters += counters
-        verdict = np.asarray(v)[:b]
-        redirect = np.asarray(red)[:b]
+        with bt.phase("host_sync"):
+            verdict = np.asarray(v)[:b]
+            redirect = np.asarray(red)[:b]
+        with bt.phase("counters"):
+            self._account_batch(verdict)
         if self.on_redirect is not None and redirect.any():
             for i in np.nonzero(redirect)[0]:
                 self.on_redirect(

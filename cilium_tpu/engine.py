@@ -22,12 +22,14 @@ deletion, or vocab word growth.
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import metrics as _metrics
 from . import u8proto
 from .compiler import (
     CompiledPolicy,
@@ -154,6 +156,7 @@ class PolicyEngine:
             if force or self._compiled is None:
                 return self._full_refresh()
 
+            t0 = time.perf_counter()
             c = self._compiled
             rule_ops = []
             if c.revision != self.repo.revision:
@@ -184,6 +187,10 @@ class PolicyEngine:
                         list(payload[1]), rev
                     ):
                         return self._full_refresh()
+            _metrics.engine_refresh_seconds.observe(
+                time.perf_counter() - t0, {"kind": "incremental"}
+            )
+            _metrics.engine_refreshes_total.inc({"kind": "incremental"})
             return c
 
     @staticmethod
@@ -230,10 +237,15 @@ class PolicyEngine:
         self._log_delta("full", ())
 
     def _full_refresh(self) -> CompiledPolicy:
+        t0 = time.perf_counter()
         compiled, state, sel_match, device = self._compute_full(
             self.repo, self.registry
         )
         self._install_compiled(compiled, state, sel_match, device)
+        _metrics.engine_refresh_seconds.observe(
+            time.perf_counter() - t0, {"kind": "full"}
+        )
+        _metrics.engine_refreshes_total.inc({"kind": "full"})
         return compiled
 
     # -- incremental paths ---------------------------------------------
@@ -251,7 +263,15 @@ class PolicyEngine:
         if len(pend) != target_version - c.identity_version:
             return False
         if self.registry.padded_rows() != c.id_bits.shape[0]:
-            return False  # row-capacity bucket crossed
+            # row-capacity bucket crossed → the device tables reshape
+            # and every jitted program over them recompiles
+            _metrics.jit_shape_buckets_total.inc(
+                {"site": "engine_rows", "result": "miss"}
+            )
+            return False
+        _metrics.jit_shape_buckets_total.inc(
+            {"site": "engine_rows", "result": "hit"}
+        )
 
         vocab = self.registry.vocab
         touched: List[int] = []
@@ -691,6 +711,7 @@ class PolicyEngine:
             low = self._low_rows.copy() if self._low_rows is not None else None
             high = dict(self._high_rows)
         assert device is not None and low is not None
+        _metrics.verdict_batches.inc({"path": "engine"})
         n = len(subj_ids)
         hl4 = np.ones(n, dtype=bool) if has_l4 is None else np.asarray(has_l4, bool)
         return verdict_batch(

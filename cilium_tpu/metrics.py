@@ -59,37 +59,66 @@ class Gauge(Counter):
         return out
 
 
+class _HistSeries:
+    """One (label-set) series of a histogram: per-bucket counts + sum/n."""
+
+    __slots__ = ("counts", "sum", "n")
+
+    def __init__(self, n_buckets: int) -> None:
+        self.counts = [0] * (n_buckets + 1)
+        self.sum = 0.0
+        self.n = 0
+
+
 class Histogram:
     DEFAULT_BUCKETS = (0.001, 0.01, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0)
 
     def __init__(self, name: str, help_: str, buckets: Sequence[float] = DEFAULT_BUCKETS):
         self.name, self.help = name, help_
         self.buckets = tuple(buckets)
-        self._counts = [0] * (len(self.buckets) + 1)
-        self._sum = 0.0
-        self._n = 0
+        # label-set → series; the unlabeled series exists from the
+        # start so an unobserved histogram still exposes its zeros
+        self._series: Dict[_LabelKey, _HistSeries] = {
+            (): _HistSeries(len(self.buckets))
+        }
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        k = _labels_key(labels)
         with self._lock:
-            self._sum += value
-            self._n += 1
+            s = self._series.get(k)
+            if s is None:
+                s = self._series[k] = _HistSeries(len(self.buckets))
+            s.sum += value
+            s.n += 1
             for i, b in enumerate(self.buckets):
                 if value <= b:
-                    self._counts[i] += 1
+                    s.counts[i] += 1
                     return
-            self._counts[-1] += 1
+            s.counts[-1] += 1
+
+    def get_count(self, labels: Optional[Dict[str, str]] = None) -> int:
+        s = self._series.get(_labels_key(labels))
+        return 0 if s is None else s.n
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
-        cum = 0
-        for i, b in enumerate(self.buckets):
-            cum += self._counts[i]
-            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
-        cum += self._counts[-1]
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-        out.append(f"{self.name}_sum {self._sum}")
-        out.append(f"{self.name}_count {self._n}")
+        with self._lock:
+            series = sorted(self._series.items())
+        for key, s in series:
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += s.counts[i]
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(key + (('le', str(b)),))} {cum}"
+                )
+            cum += s.counts[-1]
+            out.append(
+                f"{self.name}_bucket{_fmt_labels(key + (('le', '+Inf'),))} {cum}"
+            )
+            out.append(f"{self.name}_sum{_fmt_labels(key)} {s.sum}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {s.n}")
         return out
 
 
@@ -154,4 +183,40 @@ l7_host_fallback_evaluations = registry.counter(
 )
 compile_time = registry.histogram(
     "cilium_tpu_policy_compile_seconds", "Policy tensor compile latency"
+)
+
+# -- policyd-trace (observe/) families -----------------------------------
+# Verdict-path phases run µs–ms, far below DEFAULT_BUCKETS' 1ms floor;
+# the top buckets still catch first-compile outliers.
+PHASE_BUCKETS = (
+    20e-6, 50e-6, 100e-6, 250e-6, 500e-6, 1e-3, 2.5e-3, 5e-3, 10e-3,
+    25e-3, 50e-3, 100e-3, 250e-3, 1.0,
+)
+pipeline_phase_seconds = registry.histogram(
+    "cilium_tpu_pipeline_phase_seconds",
+    "Verdict-path phase latency (label: phase — a stable name set, "
+    "see cilium_tpu/observe/README.md)",
+    buckets=PHASE_BUCKETS,
+)
+batch_total_seconds = registry.histogram(
+    "cilium_tpu_pipeline_batch_seconds",
+    "End-to-end wall time of one traced verdict batch",
+    buckets=PHASE_BUCKETS,
+)
+engine_refresh_seconds = registry.histogram(
+    "cilium_tpu_engine_refresh_seconds",
+    "Policy engine refresh latency (label kind: full|incremental)",
+    buckets=PHASE_BUCKETS,
+)
+engine_refreshes_total = registry.counter(
+    "cilium_tpu_engine_refreshes_total",
+    "Policy engine refreshes by kind (full recompile vs incremental patch)",
+)
+jit_shape_buckets_total = registry.counter(
+    "cilium_tpu_jit_shape_buckets_total",
+    "Shape-bucket cache outcomes (result=miss ≈ an XLA recompile)",
+)
+device_transfers_total = registry.counter(
+    "cilium_tpu_device_transfers_total",
+    "Host↔device array transfers on traced dispatches (label: direction)",
 )

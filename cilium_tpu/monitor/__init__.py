@@ -2,13 +2,14 @@
 lossy multicast hub, and the monitor socket protocol (the roles of
 monitor/ + pkg/monitor in the reference)."""
 
-from .dissect import Dissection, dissect
+from .dissect import Dissection, dissect, render_waterfall
 from .events import (
     EVENT_AGENT,
     EVENT_CAPTURE,
     EVENT_DROP,
     EVENT_L7,
     EVENT_TRACE,
+    EVENT_TRACE_SUMMARY,
     REASON_NO_SERVICE,
     REASON_POLICY,
     REASON_PREFILTER,
@@ -17,6 +18,7 @@ from .events import (
     DropNotify,
     L7Notify,
     TraceNotify,
+    TraceSummary,
     decode,
     encode,
     reason_name,
@@ -34,7 +36,10 @@ __all__ = [
     "EVENT_DROP",
     "EVENT_L7",
     "EVENT_TRACE",
+    "EVENT_TRACE_SUMMARY",
     "L7Notify",
+    "TraceSummary",
+    "render_waterfall",
     "MonitorHub",
     "MonitorServer",
     "REASON_NO_SERVICE",

@@ -215,3 +215,50 @@ def _l4(d: Dissection, p: bytes) -> Dissection:
             return d
         d.icmp_type = _ICMP6_TYPES.get(p[0], f"type-{p[0]}")
     return d
+
+
+# ---------------------------------------------------------------------
+# policyd-trace waterfall rendering (the trace-summary analogue of a
+# packet dissection: turn one TraceSummary's phase list into a human
+# view). Lives here so the CLI and monitor share one renderer.
+
+def _fmt_ns(ns: int) -> str:
+    if ns >= 1_000_000:
+        return f"{ns / 1e6:.2f}ms"
+    if ns >= 1_000:
+        return f"{ns / 1e3:.1f}µs"
+    return f"{ns}ns"
+
+
+def render_waterfall(
+    kind: str,
+    batch: int,
+    total_ns: int,
+    phases,
+    width: int = 40,
+) -> str:
+    """Render one trace as a phase waterfall::
+
+        v4-ingress batch=1024 total=1.20ms
+          rebuild      |#                   |   12.0µs   1.0%
+          dispatch     |   ########        |  480.0µs  40.0%
+
+    ``phases`` is the trace's ordered (name, rel_start_ns, dur_ns)
+    list; bars are positioned by start offset so overlap/ordering is
+    visible at a glance. Phase names are a stable API (observe/
+    README.md) — bench rounds diff these waterfalls across commits.
+    """
+    total = max(1, int(total_ns))
+    name_w = max((len(p[0]) for p in phases), default=4)
+    lines = [f"{kind} batch={batch} total={_fmt_ns(int(total_ns))}"]
+    for name, rel, dur in phases:
+        start = min(width, int(rel * width / total))
+        span = max(1, int(dur * width / total))
+        span = min(span, width - start) or 1
+        bar = " " * start + "#" * span
+        pct = 100.0 * dur / total
+        lines.append(
+            f"  {name:<{name_w}} |{bar:<{width}}| "
+            f"{_fmt_ns(int(dur)):>9} {pct:5.1f}%"
+        )
+    return "\n".join(lines)

@@ -23,6 +23,7 @@ EVENT_TRACE = 2
 EVENT_AGENT = 3
 EVENT_L7 = 4
 EVENT_CAPTURE = 5  # DebugCapture (datapath_debug.go:368)
+EVENT_TRACE_SUMMARY = 6  # policyd-trace per-batch phase breakdown
 
 # drop reasons (bpf/lib/common.h DROP_* / pkg/monitor/api errors)
 REASON_POLICY = 133  # DROP_POLICY
@@ -179,6 +180,32 @@ class DebugCapture:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class TraceSummary:
+    """One completed verdict-batch trace (policyd-trace): total wall
+    time plus the (name, start-offset-ns, duration-ns) phase list. The
+    tracer publishes these only while a monitor listener is attached
+    (hub.active), same cost contract as flow events."""
+
+    kind: str  # e.g. "v4-ingress"
+    batch: int  # flow count of the batch
+    total_ns: int
+    phases: Tuple[Tuple[str, int, int], ...]
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+    @property
+    def type(self) -> int:
+        return EVENT_TRACE_SUMMARY
+
+    def summary(self) -> str:
+        top = sorted(self.phases, key=lambda p: -p[2])[:3]
+        parts = ", ".join(f"{n} {d / 1e6:.2f}ms" for n, _r, d in top)
+        return (
+            f"## trace {self.kind} batch={self.batch} "
+            f"total={self.total_ns / 1e6:.2f}ms [{parts}]"
+        )
+
+
 _FLOW_FMT = "<BBBBIIHHd16s"
 _FLOW_LEN = struct.calcsize(_FLOW_FMT)
 
@@ -212,6 +239,18 @@ def encode(ev) -> bytes:
                         ev.timestamp)
             + data
         )
+    if t == EVENT_TRACE_SUMMARY:
+        kind = ev.kind.encode()[:255]
+        out = [struct.pack(
+            "<BBHIQd", t, len(kind), len(ev.phases), ev.batch,
+            ev.total_ns, ev.timestamp,
+        ), kind]
+        for name, rel, dur in ev.phases:
+            nb = name.encode()[:255]
+            out.append(struct.pack("<B", len(nb)))
+            out.append(nb)
+            out.append(struct.pack("<QQ", rel, dur))
+        return b"".join(out)
     raise ValueError(f"unknown event type {t}")
 
 
@@ -245,5 +284,26 @@ def decode(buf: bytes):
         return DebugCapture(
             endpoint=ep, data=buf[hdr:hdr + dlen], orig_len=orig,
             timestamp=ts,
+        )
+    if t == EVENT_TRACE_SUMMARY:
+        hdr = struct.calcsize("<BBHIQd")
+        _, klen, n_phases, batch, total_ns, ts = struct.unpack(
+            "<BBHIQd", buf[:hdr]
+        )
+        off = hdr
+        kind = buf[off:off + klen].decode()
+        off += klen
+        phases = []
+        for _ in range(n_phases):
+            nlen = buf[off]
+            off += 1
+            name = buf[off:off + nlen].decode()
+            off += nlen
+            rel, dur = struct.unpack("<QQ", buf[off:off + 16])
+            off += 16
+            phases.append((name, rel, dur))
+        return TraceSummary(
+            kind=kind, batch=batch, total_ns=total_ns,
+            phases=tuple(phases), timestamp=ts,
         )
     raise ValueError(f"unknown event type {t}")

@@ -1,0 +1,10 @@
+"""policyd-trace: verdict-path observability (see README.md here).
+
+Span tracer + phase-timing telemetry for the datapath. Import-light by
+design (stdlib only) — the CLI and the analysis tooling import this
+without pulling JAX.
+"""
+
+from .tracer import BatchTrace, NOOP_BATCH, Tracer
+
+__all__ = ["BatchTrace", "NOOP_BATCH", "Tracer"]

@@ -86,6 +86,7 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
         OptionSpec("TraceNotification", "Trace notification events"),
         OptionSpec("Policy", "Policy enforcement"),
         OptionSpec("PolicyVerdictNotification", "Per-verdict events"),
+        OptionSpec("PhaseTracing", "Verdict-path phase tracing (observe/)"),
     )
 }
 
