@@ -502,6 +502,93 @@ def _bench_pipeline_e2e(
     return v4_rate, iters * b6 / (time.time() - t0), pf_rate
 
 
+def _bench_overlap(
+    repo, reg, idents, nrng: np.random.Generator
+) -> Tuple[float, float]:
+    """→ (overlap_ratio, pipelined_vps).
+
+    Achieved dispatch overlap at depth 2: K host-fed batches run
+    back-to-back synchronously (process() = enqueue + immediate pull)
+    vs pipelined (submit() defers each pull behind the NEXT batch's
+    host prep). The ratio reports how much of the pure device
+    execution time the overlap hid:
+
+        (t_sync − t_pipelined) / t_device   clamped to [0, 1]
+
+    → 0 on a host-bound box (nothing worth hiding), → 1 when host prep
+    fully covers device execution."""
+    from cilium_tpu.datapath.pipeline import (
+        TRAFFIC_INGRESS,
+        DatapathPipeline,
+        process_flows_wide,
+    )
+    from cilium_tpu.engine import PolicyEngine
+    from cilium_tpu.ipcache.ipcache import IPCache
+    from cilium_tpu.ipcache.prefilter import PreFilter
+
+    eng = PolicyEngine(repo, reg)
+    cache = IPCache()
+    for i, ident in enumerate(idents):
+        cache.upsert(
+            f"10.{(i >> 8) & 255}.{i & 255}.1/32", ident.id, source="k8s"
+        )
+    pipe = DatapathPipeline(
+        eng, cache, PreFilter(), conntrack=None, pipeline_depth=2
+    )
+    pipe.set_endpoints([idents[j].id for j in range(N_ENDPOINTS)])
+    b, k = 1 << 18, 8
+    batches = []
+    for _ in range(k):
+        i_sel = nrng.integers(0, len(idents), b)
+        ips = (
+            np.uint32(10) << 24
+            | ((i_sel >> 8) & 255).astype(np.uint32) << 16
+            | (i_sel & 255).astype(np.uint32) << 8
+            | 1
+        ).astype(np.uint32)
+        eps = nrng.integers(0, N_ENDPOINTS, b).astype(np.int32)
+        dports = nrng.choice(np.array([80, 443, 8080, 53, 22], np.int32), b)
+        protos = np.where(dports == 53, 17, 6).astype(np.int32)
+        batches.append((ips, eps, dports, protos))
+    pipe.process(*batches[0])  # warm the jit cache + tables
+
+    t0 = time.time()
+    for bt in batches:
+        pipe.process(*bt)
+    t_sync = time.time() - t0
+
+    t0 = time.time()
+    pend = [pipe.submit(*bt) for bt in batches]
+    for p in pend:
+        p.result()
+    t_pipe = time.time() - t0
+
+    # pure device execution for the same K batches: pre-staged device
+    # arrays, one fused dispatch each, single block at the end
+    t = pipe._tables[(TRAFFIC_INGRESS, 4)]
+    staged = [tuple(jnp.asarray(a) for a in bt) for bt in batches]
+    pf_stage = not pipe._pf_empty[0]
+    v = None
+    for d in staged[:1]:  # warm this exact shape
+        v, _red, _c = process_flows_wide(
+            t, *d, ep_count=N_ENDPOINTS, prefilter=pf_stage,
+            row_override=None,
+        )
+    jax.block_until_ready(v)
+    t0 = time.time()
+    for d in staged:
+        v, _red, _c = process_flows_wide(
+            t, *d, ep_count=N_ENDPOINTS, prefilter=pf_stage,
+            row_override=None,
+        )
+    jax.block_until_ready(v)
+    t_dev = time.time() - t0
+
+    hidden = max(0.0, t_sync - t_pipe)
+    ratio = min(1.0, hidden / t_dev) if t_dev > 0 else 0.0
+    return ratio, k * b / t_pipe
+
+
 def _bench_native_e2e(snaps, idents, nrng: np.random.Generator):
     """The native front-end's FULL per-node pipeline (conntrack probe →
     identity LPM → policymap, bpf_lxc.c end to end) — (mixed_vps,
@@ -872,6 +959,80 @@ def _attach_watchdog(timeout_s: float) -> _AttachStages:
     return st
 
 
+def _attach_backend(
+    attached: _AttachStages,
+    attempt_timeout_s: float,
+    attempts: int = 2,
+    local_fallback: bool = False,
+) -> str:
+    """Bounded attach with retry: the backend handshake + first compile
+    run on a worker thread under a per-attempt deadline (the watchdog
+    above still bounds the WHOLE attach sequence). A wedged axon tunnel
+    sometimes recovers on reconnect, so one backoff retry is cheap
+    insurance before declaring the round dead; ``--local-fallback``
+    swaps in the host CPU backend after the final timeout instead of
+    aborting — the result JSON records backend=local-fallback so the
+    numbers are never mistaken for device rates. Returns the platform
+    name actually attached."""
+    import threading
+
+    for attempt in range(1, attempts + 1):
+        attached.stage(f"backend-init:attempt{attempt}")
+        out: dict = {}
+
+        def probe():
+            try:
+                devs = jax.devices()  # backend handshake; no program yet
+                # first device op: forces the first XLA compile
+                # through the tunnel
+                jax.block_until_ready(jnp.zeros(8) + 1)
+                out["platform"] = devs[0].platform
+            except Exception as e:  # init raised cleanly — retryable
+                out["error"] = f"{type(e).__name__}: {e}"
+
+        th = threading.Thread(target=probe, daemon=True)
+        th.start()
+        th.join(attempt_timeout_s)
+        if "platform" in out:
+            attached.stage(f"device-visible:{out['platform']}")
+            attached.stage("first-compile")
+            return out["platform"]
+        attached.stage(
+            f"attach-{'timeout' if th.is_alive() else 'error'}"
+            f":attempt{attempt}"
+        )
+        if attempt < attempts:
+            time.sleep(2.0 * attempt)  # backoff before reattaching
+            try:
+                jax.clear_backends()  # drop the wedged client if possible
+            except Exception:
+                pass
+    if not local_fallback:
+        print(json.dumps({
+            "metric": f"policy verdicts/sec at {N_RULES} rules",
+            "value": 0,
+            "unit": "verdicts/s",
+            "vs_baseline": 0.0,
+            "attach_stage": attached.last,
+            "attach_history": attached.history,
+            "error": (
+                f"TPU attach failed after {attempts} bounded attempt(s) "
+                f"({attempt_timeout_s:.0f}s each) — last stage: "
+                f"{attached.last} — no measurements taken "
+                "(re-run with --local-fallback for host-CPU numbers)"
+            ),
+        }), flush=True)
+        os._exit(3)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.clear_backends()
+    except Exception:
+        pass
+    jax.block_until_ready(jnp.zeros(8) + 1)
+    attached.stage("local-fallback")
+    return "local-fallback"
+
+
 def _lint_preflight() -> None:
     """``--lint``: refuse the round when the hot path carries NEW
     policyd-lint findings — a fresh device sync or loop-dispatch would
@@ -910,12 +1071,11 @@ def main() -> None:
     attached = _attach_watchdog(
         float(os.environ.get("BENCH_ATTACH_TIMEOUT", 900))
     )
-    attached.stage("backend-init")
-    devs = jax.devices()  # backend handshake; no program yet
-    attached.stage(f"device-visible:{devs[0].platform}")
-    # first device op: forces the first XLA compile through the tunnel
-    jax.block_until_ready(jnp.zeros(8) + 1)
-    attached.stage("first-compile")
+    backend = _attach_backend(
+        attached,
+        float(os.environ.get("BENCH_ATTACH_ATTEMPT_TIMEOUT", 300)),
+        local_fallback="--local-fallback" in sys.argv[1:],
+    )
 
     rng = random.Random(42)
     t0 = time.time()
@@ -1010,6 +1170,10 @@ def main() -> None:
         _bench_pipeline_e2e(repo, reg, idents, np.random.default_rng(13))
         if extra else (0.0, 0.0, 0.0)
     )
+    overlap_ratio, pipeline_submit_vps = (
+        _bench_overlap(repo, reg, idents, np.random.default_rng(17))
+        if extra else (0.0, 0.0)
+    )
     t0 = time.time()
     tables2, _ = materialize_endpoints(
         compiled, engine.device_policy, ep_ids, ingress=True
@@ -1056,6 +1220,14 @@ def main() -> None:
         "native_e2e_est_vps": round(native_e2e_est_vps),
         "pipeline_e2e_vps": round(pipeline_e2e_vps),
         "pipeline_e2e_v6_vps": round(pipeline_e2e_v6_vps),
+        # pipelined dispatch (submit/result, depth 2): rate + the share
+        # of pure device time hidden behind host prep of the successor
+        "pipeline_submit_vps": round(pipeline_submit_vps),
+        "overlap_ratio": round(overlap_ratio, 3),
+        "pipeline_depth": 2,
+        # which backend produced these numbers (local-fallback = host
+        # CPU after device attach failed; NOT comparable to device runs)
+        "backend": backend,
         # deny stage ACTIVE via the fused one-walk table (negative =
         # fusion unexpectedly absent)
         "pipeline_e2e_fused_pf_vps": round(pipeline_e2e_fused_pf_vps),

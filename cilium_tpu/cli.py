@@ -996,6 +996,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             if not out.get("enabled") and not out.get("traces"):
                 print("phase tracing is disabled (enable with "
                       "`cilium-tpu config PhaseTracing=true`)")
+            if "pipeline_depth" in out:
+                # overlap context: with depth>1 a trace's host_sync is
+                # the residual wait, not the device execution time
+                print(
+                    f"pipeline depth {out['pipeline_depth']}, "
+                    f"{out.get('in_flight', 0)} batch(es) in flight"
+                )
+                print()
             for t in out.get("traces", ()):
                 print(render_waterfall(
                     t["kind"], t["batch"], t["total_ns"], t["phases"],

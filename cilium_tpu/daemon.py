@@ -56,7 +56,7 @@ from .monitor.events import AgentNotify, L7Notify
 from .monitor.hub import MonitorHub
 from .ops.materialize import TRAFFIC_EGRESS, TRAFFIC_INGRESS
 from .policy.api.serialization import rule_from_dict, rule_to_dict, rules_from_json
-from .option import OptionMap
+from .option import OptionMap, get_config
 from .policy.repository import Repository
 from .policy.search import Decision, PortContext, SearchContext, Trace
 from .proxy.proxy import Proxy
@@ -96,10 +96,13 @@ class Daemon:
         self.conntrack = FlowConntrack() if conntrack else None
         self.services = ServiceManager()
         self.monitor = MonitorHub()
+        cfg = get_config()
         self.pipeline = DatapathPipeline(
             self.engine, self.ipcache, self.prefilter,
             conntrack=self.conntrack, lb=self.services,
             monitor=self.monitor,
+            pipeline_depth=cfg.verdict_pipeline_depth,
+            sharding=cfg.verdict_sharding,
         )
         # ONE controller registry for the whole daemon (pkg/controller;
         # `cilium status --all-controllers` reads it) — the endpoint
@@ -150,6 +153,9 @@ class Daemon:
         self.options.set("Policy", True)
         self.options.set("Conntrack", conntrack)
         self.options.set("DropNotification", True)
+        # boot value rides DaemonConfig; the pipeline already took it
+        # via its ctor, so seed the map BEFORE wiring on_change
+        self.options.set("VerdictSharding", cfg.verdict_sharding)
         self.options.on_change(self._on_option_change)
         # fleet regeneration is synchronous by default (tests and
         # small deployments observe effects immediately); a busy node
@@ -689,7 +695,7 @@ class Daemon:
     _MUTABLE_OPTIONS = frozenset(
         {
             "Conntrack", "TraceNotification", "DropNotification", "Debug",
-            "PhaseTracing",
+            "PhaseTracing", "VerdictSharding",
         }
     )
 
@@ -714,6 +720,10 @@ class Daemon:
                 self.pipeline.tracer.enable()
             else:
                 self.pipeline.tracer.disable()
+        elif name == "VerdictSharding":
+            # flow-sharded dispatch; placement changes on next rebuild
+            # (a single-device node accepts the option as a no-op)
+            self.pipeline.set_sharding(value)
         elif name == "Debug":
             import logging as _logging
 
@@ -935,6 +945,8 @@ class Daemon:
         return {
             "enabled": tr.active,
             "capacity": tr.capacity,
+            "pipeline_depth": self.pipeline.pipeline_depth,
+            "in_flight": self.pipeline.inflight_depth,
             "traces": tr.traces(limit),
         }
 
@@ -1124,6 +1136,9 @@ class Daemon:
         return n
 
     def shutdown(self) -> None:
+        # complete in-flight verdict batches first: their finish halves
+        # publish events/counters that the subsystems below consume
+        self.pipeline.drain()
         self.controllers.remove_all()
         self.health.stop()
         self.fqdn.stop()

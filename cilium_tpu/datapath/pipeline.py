@@ -24,12 +24,14 @@ from __future__ import annotations
 
 import functools
 import threading
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import chex
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import metrics as _metrics
 from ..engine import PolicyEngine
@@ -37,7 +39,7 @@ from ..identity.model import ID_WORLD
 from ..observe.tracer import NOOP_BATCH as _NOOP_BATCH, Tracer
 from ..ipcache.ipcache import IPCache
 from ..ipcache.prefilter import PreFilter
-from ..ops.lookup import PolicymapTables, lookup_batch
+from ..ops.lookup import PolicymapTables, lookup_batch, replicate_tables
 from ..ops.lpm import (
     DENY_BIT,
     MERGED_VALUE_MASK,
@@ -48,6 +50,7 @@ from ..ops.lpm import (
     lpm_lookup_wide,
     merge_flat_tries,
     merge_trie_entries,
+    place_table,
 )
 from ..ops.materialize import (
     EndpointPolicySnapshot,
@@ -426,6 +429,71 @@ def _pad_flows(pad: int, peer_bytes, *arrays, row_override=None):
     return (peer_bytes, *arrays, row_override)
 
 
+def _bucket_multiple(n: int, ndev: int, floor: int = 1024) -> int:
+    """_bucket(), then rounded up to a multiple of the mesh device
+    count so a flow-sharded batch splits evenly (P("flows") shards
+    dim 0; an uneven split would compile per-remainder programs)."""
+    b = _bucket(n, floor)
+    return b + ((-b) % ndev)
+
+
+class PendingBatch:
+    """Handle for one batch accepted by ``DatapathPipeline.submit()``.
+    Batches complete strictly FIFO; ``result()`` blocks until this
+    batch's host pull + accounting have run (completing any older
+    in-flight batches first, preserving event/conntrack order)."""
+
+    __slots__ = ("_pipe", "_event", "_value", "_exc")
+
+    def __init__(self, pipe: "DatapathPipeline") -> None:
+        self._pipe = pipe
+        self._event = threading.Event()
+        self._value = None
+        self._exc: Optional[BaseException] = None
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self):
+        if not self._event.is_set():
+            self._pipe._complete_until(self)
+            self._event.wait()
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class _InFlight:
+    """One submitted batch: its handle, the completion closure (host
+    pull + counters + CT create + events), and the trace that must end
+    when the batch COMPLETES. ``finish=None`` marks a batch that ran
+    synchronously (the donated-state device-CT path)."""
+
+    __slots__ = ("pending", "finish", "bt")
+
+    def __init__(self, pending: PendingBatch, finish, bt) -> None:
+        self.pending = pending
+        self.finish = finish
+        self.bt = bt
+
+
+class _Enqueued:
+    """Un-pulled device results of one dispatch: per-chunk (verdict,
+    redirect, counters) device arrays plus the spans that produced
+    them. ``exact`` marks device counters usable as-is (no padded
+    lanes polluted them)."""
+
+    __slots__ = ("chunks", "spans", "b", "exact", "ndev")
+
+    def __init__(self, chunks, spans, b, exact, ndev) -> None:
+        self.chunks = chunks
+        self.spans = spans
+        self.b = b
+        self.exact = exact
+        self.ndev = ndev
+
+
 class DatapathPipeline:
     """Host orchestrator: owns the device snapshot of prefilter +
     ipcache + materialized policymaps for a set of local endpoints, and
@@ -442,6 +510,8 @@ class DatapathPipeline:
         monitor=None,  # Optional[monitor.hub.MonitorHub]
         device_ct_bits: Optional[int] = None,
         tracer: Optional[Tracer] = None,
+        pipeline_depth: int = 2,
+        sharding: bool = False,
     ) -> None:
         self.engine = engine
         self.ipcache = ipcache
@@ -509,15 +579,44 @@ class DatapathPipeline:
         self._pf_empty: Tuple[bool, bool] = (True, True)
         self._v6_fused = False  # v6 merged deny+identity trie present
         # ATOMIC read snapshot for the lock-free dispatch paths:
-        # (tables, pf_empty, v6_fused) swap together — reading them as
-        # separate attributes could pair a new flag with old tables
-        # (e.g. fused=True against placeholder merged arrays, which
-        # would resolve every v6 flow to world with no denies)
-        self._dp_state: Tuple[Dict, Tuple[bool, bool], bool] = (
-            {}, (True, True), False
-        )
+        # (tables, pf_empty, v6_fused, flow_sharding, ndev) swap
+        # together — reading them as separate attributes could pair a
+        # new flag with old tables (e.g. fused=True against placeholder
+        # merged arrays, which would resolve every v6 flow to world
+        # with no denies, or a flow sharding against tables placed for
+        # a different mesh)
+        self._dp_state: Tuple = ({}, (True, True), False, None, 1)
         self._tries: Optional[Tuple] = None  # ((pf4, ip4), (pf6, ip6), world_row)
         self.counters = np.zeros((0, 3), np.int64)
+        # -- bounded in-flight dispatch queue -------------------------
+        # submit() enqueues the device program and defers the host pull
+        # (+ counters/ct_create/events) until completion; depth bounds
+        # how many batches sit un-pulled so host prep of batch N+1
+        # overlaps device execution of batch N. Depth 1 = synchronous.
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._inflight: deque = deque()  # FIFO of _InFlight
+        self._queue_lock = threading.Lock()  # guards _inflight only
+        # conntrack basis epoch: bumped on every CT flush so a batch
+        # completing AFTER a basis move (policy/ipcache change raced
+        # its in-flight window) cannot create entries verdicted under
+        # the old basis
+        self._ct_epoch = 0
+        # shape buckets already dispatched: the chunker splits a batch
+        # larger than the largest warm bucket into full warm-bucket
+        # dispatches (overlapped by the queue) instead of padding to
+        # the next power of two (~2x waste just past 2^k)
+        self._warm_buckets: set = set()
+        # -- multi-device flow sharding (VerdictSharding) -------------
+        # active mesh → tables replicated, flow batches split over the
+        # "flows" axis. The dispatch-visible sharding rides _dp_state
+        # so it can never pair with tables placed for a different mesh.
+        self._sharding_requested = bool(sharding)
+        self._mesh: Optional[Mesh] = None
+        self._flow_sharding: Optional[NamedSharding] = None
+        self._table_sharding: Optional[NamedSharding] = None
+        # direction → (source policymap, replicated copy): re-place
+        # only when materialization swaps the source object
+        self._placed_pm: Dict[int, Tuple[object, object]] = {}
 
     def set_endpoints(self, endpoints: Sequence) -> None:
         """Accepts identity ids (endpoint id == identity id) or
@@ -535,6 +634,7 @@ class DatapathPipeline:
             # endpoint's established-flow bypass entries.
             if self.conntrack is not None:
                 self.conntrack.flush()
+            self._ct_epoch += 1
             self._device_ct = None
 
     def endpoint_index(self, endpoint_id: int) -> Optional[int]:
@@ -549,6 +649,39 @@ class DatapathPipeline:
                 return self._endpoint_ids[idx]
         return None
 
+    def set_sharding(self, on: bool) -> None:
+        """Toggle multi-device flow sharding (the VerdictSharding
+        runtime option). Takes effect on the next rebuild; a mesh only
+        forms with >1 visible device. Clears placed tables and the
+        shape/warm caches — sharded and unsharded dispatches compile
+        different programs."""
+        with self._lock:
+            if bool(on) == self._sharding_requested:
+                return
+            self._sharding_requested = bool(on)
+            self._tables = {}
+            self._tries = None
+            self._placed_pm.clear()
+        # telemetry/warm caches: best-effort sets the lock-free dispatch
+        # paths also mutate bare (GIL-atomic; a racing add only costs
+        # one redundant compile or a miscounted cache-hit metric)
+        self._seen_shapes.clear()
+        self._warm_buckets.clear()
+
+    def _refresh_mesh_locked(self) -> None:
+        """Form/drop the verdict mesh to match the sharding request
+        (held-lock helper for rebuild)."""
+        want = self._sharding_requested and len(jax.devices()) > 1
+        if want and self._mesh is None:
+            # Mesh normalizes the device list itself — no host pull
+            self._mesh = Mesh(jax.devices(), ("flows",))
+            self._flow_sharding = NamedSharding(self._mesh, P("flows"))
+            self._table_sharding = NamedSharding(self._mesh, P())
+        elif not want and self._mesh is not None:
+            self._mesh = None
+            self._flow_sharding = None
+            self._table_sharding = None
+
     # ------------------------------------------------------------------
     def rebuild(self, force: bool = False) -> Dict[Tuple[int, int], DatapathTables]:
         """Bring device state up to date. Incremental where possible:
@@ -561,6 +694,7 @@ class DatapathPipeline:
         Returns {(direction, family): DatapathTables}.
         """
         with self._lock:
+            self._refresh_mesh_locked()
             # Capture versions BEFORE reading the sources: a concurrent
             # mutation mid-build then triggers one extra rebuild rather
             # than being silently marked materialized.
@@ -690,12 +824,17 @@ class DatapathPipeline:
                 world_row = compiled.id_to_row.get(ID_WORLD)
                 if world_row is None:
                     raise RuntimeError("reserved:world identity has no device row")
+                # sharding-aware upload (ops/lpm.py place_table):
+                # tries are replicated across the verdict mesh — every
+                # flow shard walks the whole trie
+                tsh = self._table_sharding
                 self._tries = (
                     tuple(
-                        jnp.asarray(a) for a in (*pf_wide, *ip_wide, *merged)
+                        place_table(a, tsh)
+                        for a in (*pf_wide, *ip_wide, *merged)
                     ),
-                    tuple(jnp.asarray(a) for a in (*pf6, *ip6, *merged6)),
-                    jnp.asarray(np.int32(world_row)),
+                    tuple(place_table(a, tsh) for a in (*pf6, *ip6, *merged6)),
+                    place_table(np.int32(world_row), tsh),
                 )
                 self._trie_versions = trie_versions
 
@@ -714,6 +853,10 @@ class DatapathPipeline:
             if mat_fresh or saw_row_event or basis_moved:
                 if self.conntrack is not None:
                     self.conntrack.flush()
+                # a basis move while batches are in flight: their
+                # completion halves must not create CT entries
+                # verdicted under the old basis
+                self._ct_epoch += 1
                 self._device_ct = None  # zeroed on next use
 
             # LB tables: deterministic per-flow backend selection means
@@ -727,6 +870,7 @@ class DatapathPipeline:
                 self._lb_version = lb_ver
                 if self.conntrack is not None:
                     self.conntrack.flush()
+                self._ct_epoch += 1
                 self._device_ct = None
 
             assert self._tries is not None and self._mat
@@ -736,6 +880,7 @@ class DatapathPipeline:
             # partially-populated dict.
             tables: Dict[Tuple[int, int], object] = {}
             for direction, mat in self._mat.items():
+                pm = self._replicated_policymap(direction, mat.tables)
                 tables[(direction, 4)] = WideDatapathTables(
                     pf_root_info=v4[0],
                     pf_root_child=v4[1],
@@ -750,7 +895,7 @@ class DatapathPipeline:
                     merged_sub_child=v4[10],
                     merged_sub_info=v4[11],
                     world_row=world,
-                    policymap=mat.tables,
+                    policymap=pm,
                 )
                 tables[(direction, 6)] = DatapathTables(
                     pf_child=v6[0],
@@ -763,13 +908,30 @@ class DatapathPipeline:
                     merged_info=v6[7],
                     merged_common=v6[8],
                     world_row=world,
-                    policymap=mat.tables,
+                    policymap=pm,
                 )
             self._tables = tables
-            self._dp_state = (tables, self._pf_empty, self._v6_fused)
+            ndev = 1 if self._mesh is None else int(self._mesh.size)
+            self._dp_state = (
+                tables, self._pf_empty, self._v6_fused,
+                self._flow_sharding, ndev,
+            )
             if self.counters.shape[0] != len(self._endpoints):
                 self.counters = np.zeros((len(self._endpoints), 3), np.int64)
             return self._tables
+
+    def _replicated_policymap(self, direction: int, pm: PolicymapTables):
+        """Mesh-replicated copy of one direction's policymap, cached on
+        the source object so row patches (which swap the arrays) re-place
+        while steady-state rebuilds reuse the committed copy."""
+        if self._table_sharding is None:
+            return pm
+        src, placed = self._placed_pm.get(direction, (None, None))
+        if src is pm:
+            return placed
+        placed = replicate_tables(pm, self._table_sharding)
+        self._placed_pm[direction] = (pm, placed)
+        return placed
 
     def _materialize_both(self, compiled, device) -> None:
         self._mat = {
@@ -896,17 +1058,219 @@ class DatapathPipeline:
         if events:
             hub.publish_many(events)
 
-    def _account_batch(self, verdict: np.ndarray) -> None:
+    def _account_batch(
+        self, verdict: np.ndarray, shard_of: Optional[np.ndarray] = None
+    ) -> None:
         """Registry accounting for one completed batch (the metricsmap →
         pkg/metrics bridge). Post-host-sync by construction: callers
         pass the already-pulled numpy verdict array, so no new device
-        syncs happen here."""
-        counts = np.bincount(verdict.astype(np.int64), minlength=5)
+        syncs happen here. ``shard_of`` ([B] device index per flow,
+        sharded dispatches only) switches verdicts_total to per-device
+        series so hot shards are visible."""
         _metrics.verdict_batches.inc({"path": "pipeline"})
-        for code, outcome in _OUTCOME_NAMES:
-            n = int(counts[code])
-            if n:
-                _metrics.verdicts_total.inc({"outcome": outcome}, float(n))
+        if shard_of is None:
+            counts = np.bincount(verdict.astype(np.int64), minlength=5)
+            for code, outcome in _OUTCOME_NAMES:
+                n = int(counts[code])
+                if n:
+                    _metrics.verdicts_total.inc({"outcome": outcome}, float(n))
+            return
+        for d in np.unique(shard_of):
+            counts = np.bincount(
+                verdict[shard_of == d].astype(np.int64), minlength=5
+            )
+            for code, outcome in _OUTCOME_NAMES:
+                n = int(counts[code])
+                if n:
+                    _metrics.verdicts_total.inc(
+                        {"outcome": outcome, "device": str(int(d))}, float(n)
+                    )
+
+    @staticmethod
+    def _shard_map(spans, ndev: int, b: int) -> np.ndarray:
+        """[B] device index per flow: P("flows") splits each padded
+        chunk's dim 0 into ndev contiguous shards in mesh device
+        order."""
+        out = np.zeros(b, np.int32)
+        for lo, hi, padded in spans:
+            w = max(1, padded // ndev)
+            out[lo:hi] = np.minimum(np.arange(hi - lo) // w, ndev - 1)
+        return out
+
+    def _chunk_spans(self, n: int, *, bucketed: bool, ndev: int):
+        """Dispatch spans [(lo, hi, padded)] for an n-flow batch.
+
+        Unbucketed (the no-CT full-batch path) keeps the exact shape —
+        padded lanes would pollute the device-side counters — except
+        under sharding, where the batch must split evenly across the
+        mesh. Bucketed spans (the CT-miss tail) reuse warm compiled
+        shapes: a batch larger than the largest warm bucket dispatches
+        as full warm-bucket chunks plus one bucketed tail (each chunk
+        its own overlapped enqueue) instead of padding to the next
+        power of two, which wastes ~2x just past 2^k."""
+        if not bucketed:
+            return [(0, n, n + ((-n) % ndev) if ndev > 1 else n)]
+        w = max(self._warm_buckets, default=1024)
+        if n <= w:
+            return [(0, n, _bucket_multiple(n, ndev))]
+        spans = []
+        lo = 0
+        while n - lo > w:
+            spans.append((lo, lo + w, w))
+            lo += w
+        spans.append((lo, n, _bucket_multiple(n - lo, ndev)))
+        return spans
+
+    def _enqueue_one(
+        self, t, peer_bytes, ep_idx, dports, protos, row_override,
+        lo, hi, padded, *, family, pf_stage, ep_count, v6_fused,
+        flow_sharding,
+    ):
+        """Pad + upload + enqueue ONE chunk; returns the UN-PULLED
+        device (verdict, redirect, counters) triple. Under sharding
+        the flow arrays are committed split over the mesh's "flows"
+        axis (the tests/test_multichip.py pattern) before the call."""
+        pb = peer_bytes[lo:hi]
+        ei = ep_idx[lo:hi]
+        dp = dports[lo:hi]
+        pr = protos[lo:hi]
+        ro = None if row_override is None else row_override[lo:hi]
+        pad = padded - (hi - lo)
+        if pad:
+            pb, ei, dp, pr, ro = _pad_flows(pad, pb, ei, dp, pr,
+                                            row_override=ro)
+        peer = _pack_v4_u32(pb) if family == 4 else pb
+        if flow_sharding is not None:
+            peer, ei, dp, pr = jax.device_put(
+                (peer, ei, dp, pr), flow_sharding
+            )
+            if ro is not None:
+                ro = jax.device_put(ro, flow_sharding)
+        elif ro is not None:
+            ro = jnp.asarray(ro)
+        if family == 4:
+            return process_flows_wide(
+                t, peer, ei, dp, pr, ep_count=ep_count,
+                prefilter=pf_stage, row_override=ro,
+            )
+        return process_flows(
+            t, peer, ei, dp, pr, ep_count=ep_count, levels=16,
+            prefilter=pf_stage, fused=v6_fused, row_override=ro,
+        )
+
+    def _dispatch_enqueue(
+        self,
+        peer_bytes: np.ndarray,
+        ep_idx: np.ndarray,
+        dports: np.ndarray,
+        protos: np.ndarray,
+        *,
+        ingress: bool,
+        family: int,
+        bucketed: bool = False,
+        row_override: Optional[np.ndarray] = None,
+        bt=_NOOP_BATCH,
+    ) -> _Enqueued:
+        """Non-blocking half of a dispatch: pad/chunk, upload, enqueue
+        the fused device program(s), return un-pulled device arrays.
+        The host pull lives in _dispatch_complete — with depth>1 it
+        runs after successor batches were enqueued, so device execution
+        hides behind their host prep."""
+        direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
+        # ONE atomic snapshot read: tables + flags + sharding swap
+        # together in rebuild(), so fused-ness and placement always
+        # match the tables they describe
+        tables_map, pf_empty, v6_fused, flow_sharding, ndev = self._dp_state
+        t = tables_map[(direction, family)]
+        b = peer_bytes.shape[0]
+        # XDP prefilter guards traffic entering the node only, and an
+        # empty deny set skips the walk entirely (it's one of the two
+        # LPM walks that dominate the pipeline)
+        pf_stage = ingress and not pf_empty[0 if family == 4 else 1]
+        ep_count = max(1, len(self._endpoints))
+        spans = self._chunk_spans(b, bucketed=bucketed, ndev=ndev)
+        tr = self.tracer
+        if tr.active:
+            # shape-bucket telemetry: the jit cache keys on padded
+            # chunk shape + the static args below — a fresh key on
+            # this pipeline ≈ one XLA recompile on dispatch
+            for _lo, _hi, padded in spans:
+                key = (
+                    direction, family, padded, pf_stage, ep_count,
+                    row_override is not None, v6_fused, ndev > 1,
+                )
+                if key in self._seen_shapes:
+                    _metrics.jit_shape_buckets_total.inc(
+                        {"site": "dispatch", "result": "hit"}
+                    )
+                else:
+                    self._seen_shapes.add(key)
+                    _metrics.jit_shape_buckets_total.inc(
+                        {"site": "dispatch", "result": "miss"}
+                    )
+            # each logical upload is one per-device slice transfer per
+            # mesh device under sharding (P("flows") splits dim 0)
+            _metrics.device_transfers_total.inc(
+                {"direction": "h2d"},
+                (4.0 + (row_override is not None)) * len(spans) * ndev,
+            )
+            bt.mark(
+                padded=int(sum(p for _, _, p in spans)), chunks=len(spans)
+            )
+        # "dispatch" covers the h2d uploads + the async XLA enqueue of
+        # the FUSED device program (LPM walks + policymap lookup +
+        # counter matmul trace as one jit — splitting them into
+        # separate spans would de-fuse the program); the actual device
+        # execution time aggregates into "host_sync" at completion.
+        with bt.phase("dispatch"):
+            chunks = [
+                self._enqueue_one(
+                    t, peer_bytes, ep_idx, dports, protos, row_override,
+                    lo, hi, padded, family=family, pf_stage=pf_stage,
+                    ep_count=ep_count, v6_fused=v6_fused,
+                    flow_sharding=flow_sharding,
+                )
+                for lo, hi, padded in spans
+            ]
+        if bucketed:
+            for _lo, _hi, padded in spans:
+                self._warm_buckets.add(padded)
+        exact = all(hi - lo == padded for lo, hi, padded in spans)
+        return _Enqueued(chunks, spans, b, exact, ndev)
+
+    def _dispatch_complete(
+        self, enq: _Enqueued, bt=_NOOP_BATCH
+    ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+        """Blocking half: pull chunk results to host. With depth>1 the
+        device worked through this batch while the host prepared its
+        successors, so "host_sync" here measures the RESIDUAL wait.
+        Counters come back None when padded lanes polluted the device
+        accumulation (callers fall back to host-side np.add.at)."""
+        if self.tracer.active:
+            _metrics.device_transfers_total.inc(
+                {"direction": "d2h"}, 3.0 * len(enq.chunks) * enq.ndev
+            )
+        with bt.phase("host_sync"):
+            b = enq.b
+            if len(enq.chunks) == 1:
+                v, red, c = enq.chunks[0]
+                verdict = np.asarray(v)[:b]
+                redirect = np.asarray(red)[:b]
+            else:
+                verdict = np.empty(b, np.int8)
+                redirect = np.empty(b, bool)
+                for (lo, hi, _padded), (v, red, _c) in zip(
+                    enq.spans, enq.chunks
+                ):
+                    verdict[lo:hi] = np.asarray(v)[: hi - lo]
+                    redirect[lo:hi] = np.asarray(red)[: hi - lo]
+            if enq.exact:
+                counters = np.asarray(enq.chunks[0][2])
+                for _v, _red, c in enq.chunks[1:]:
+                    counters = counters + np.asarray(c)
+            else:
+                counters = None
+        return verdict, redirect, counters
 
     def _dispatch(
         self,
@@ -919,90 +1283,58 @@ class DatapathPipeline:
         family: int,
         pad_to: Optional[int] = None,
         row_override: Optional[np.ndarray] = None,
-    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
-        # ONE atomic snapshot read: tables + flags swap together in
-        # rebuild(), so fused-ness always matches the tables it
-        # describes (a separate-attribute read could pair them stale)
-        tables_map, pf_empty, v6_fused = self._dp_state
-        t = tables_map[(direction, family)]
-        b = peer_bytes.shape[0]
-        if pad_to is not None and pad_to > b:
-            peer_bytes, ep_idx, dports, protos, row_override = _pad_flows(
-                pad_to - b, peer_bytes, ep_idx, dports, protos,
-                row_override=row_override,
-            )
-        ro = None if row_override is None else jnp.asarray(row_override)
-        # XDP prefilter guards traffic entering the node only, and an
-        # empty deny set skips the walk entirely (it's one of the two
-        # LPM walks that dominate the pipeline)
-        pf_stage = ingress and not pf_empty[0 if family == 4 else 1]
-        ep_count = max(1, len(self._endpoints))
+    ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+        """Synchronous dispatch (enqueue + immediate pull) — kept for
+        direct callers/tests; the pipelined path drives the two halves
+        separately. ``pad_to`` is honored as "bucket this batch"."""
         tr = self.tracer
-        if tr.active:
-            bt = tr.current()
-            # shape-bucket telemetry: the jit cache keys on padded
-            # batch shape + the static args below — a fresh key on
-            # this pipeline ≈ one XLA recompile on dispatch
-            key = (
-                direction, family, peer_bytes.shape[0], pf_stage,
-                ep_count, ro is not None, v6_fused,
-            )
-            if key in self._seen_shapes:
-                _metrics.jit_shape_buckets_total.inc(
-                    {"site": "dispatch", "result": "hit"}
-                )
-            else:
-                self._seen_shapes.add(key)
-                _metrics.jit_shape_buckets_total.inc(
-                    {"site": "dispatch", "result": "miss"}
-                )
-            _metrics.device_transfers_total.inc(
-                {"direction": "h2d"}, 4.0 + (ro is not None)
-            )
-            _metrics.device_transfers_total.inc({"direction": "d2h"}, 3.0)
-            bt.mark(padded=int(peer_bytes.shape[0]))
-        else:
-            bt = _NOOP_BATCH
-        # "dispatch" covers the h2d uploads + the async XLA enqueue of
-        # the FUSED device program (LPM walks + policymap lookup +
-        # counter matmul trace as one jit — splitting them into
-        # separate spans would de-fuse the program); the actual device
-        # execution time aggregates into "host_sync" below.
-        with bt.phase("dispatch"):
-            if family == 4:
-                peer_u32 = _pack_v4_u32(peer_bytes)
-                v, red, counters = process_flows_wide(
-                    t,
-                    jnp.asarray(peer_u32),
-                    jnp.asarray(ep_idx),
-                    jnp.asarray(dports),
-                    jnp.asarray(protos),
-                    ep_count=ep_count,
-                    prefilter=pf_stage,
-                    row_override=ro,
-                )
-            else:
-                v, red, counters = process_flows(
-                    t,
-                    jnp.asarray(peer_bytes),
-                    jnp.asarray(ep_idx),
-                    jnp.asarray(dports),
-                    jnp.asarray(protos),
-                    ep_count=ep_count,
-                    levels=16,
-                    prefilter=pf_stage,
-                    fused=v6_fused,
-                    row_override=ro,
-                )
-        with bt.phase("host_sync"):
-            return (
-                np.asarray(v)[:b],
-                np.asarray(red)[:b],
-                np.asarray(counters),
-            )
+        bt = tr.current() if tr.active else _NOOP_BATCH
+        enq = self._dispatch_enqueue(
+            peer_bytes, ep_idx, dports, protos, ingress=ingress,
+            family=family, bucketed=pad_to is not None,
+            row_override=row_override, bt=bt,
+        )
+        return self._dispatch_complete(enq, bt)
 
-    def _process(
+    # -- bounded in-flight queue ---------------------------------------
+    def _complete_oldest(self) -> bool:
+        """Pull + finish the oldest in-flight batch. Returns False when
+        nothing was queued. The finish closure runs OUTSIDE the queue
+        lock (it publishes events and fires callbacks)."""
+        with self._queue_lock:
+            if not self._inflight:
+                return False
+            inf = self._inflight.popleft()
+            _metrics.pipeline_inflight_depth.set(float(len(self._inflight)))
+        try:
+            inf.pending._value = inf.finish()
+        except BaseException as e:
+            inf.pending._exc = e
+        finally:
+            inf.pending._event.set()
+            if inf.bt is not _NOOP_BATCH:
+                inf.bt.end(self.monitor)
+        return True
+
+    def _complete_until(self, pending: PendingBatch) -> None:
+        """Complete in-flight batches FIFO until ``pending`` is done.
+        An empty queue with ``pending`` still unset means another
+        thread popped it and is mid-finish — the caller's event wait
+        covers that."""
+        while not pending.done:
+            if not self._complete_oldest():
+                return
+
+    def drain(self) -> None:
+        """Complete every in-flight batch (barrier; daemon shutdown)."""
+        while self._complete_oldest():
+            pass
+
+    @property
+    def inflight_depth(self) -> int:
+        return len(self._inflight)
+
+    def _submit(
         self,
         peer_bytes: np.ndarray,  # [B, 4|16] int32 peer address bytes
         ep_idx: np.ndarray,
@@ -1015,36 +1347,52 @@ class DatapathPipeline:
         peer_words: Optional[Tuple[np.ndarray, np.ndarray]] = None,
         want_rev_nat: bool = False,
         tunnel_identities: Optional[np.ndarray] = None,
-    ):
-        """Trace shell around _process_inner: the disabled cost is ONE
-        ``tracer.active`` attribute read per batch (the hub's `active`
-        pattern, observe/tracer.py) — the no-op singleton constructs
-        zero span objects. Enabled batches open a BatchTrace whose
-        phases the inner body (and _dispatch, via the thread-local
-        span stack) fill in."""
+    ) -> PendingBatch:
+        """Trace shell + queue admission around _submit_inner: the
+        disabled cost is ONE ``tracer.active`` attribute read per batch
+        (the hub's `active` pattern, observe/tracer.py). The trace is
+        DETACHED from the thread-local stack once the enqueue half
+        returns — it stays open and ends when the batch completes, so
+        spans attach to the batch that completes, not the one being
+        prepared — and admission beyond pipeline_depth completes the
+        oldest batch first (the bounded in-flight queue)."""
         tr = self.tracer
-        if not tr.active:
-            return self._process_inner(
-                peer_bytes, ep_idx, dports, protos, sports,
-                ingress=ingress, family=family, peer_words=peer_words,
-                want_rev_nat=want_rev_nat,
-                tunnel_identities=tunnel_identities, bt=_NOOP_BATCH,
+        if tr.active:
+            bt = tr.begin(
+                f"v{family}-{'ingress' if ingress else 'egress'}",
+                peer_bytes.shape[0],
             )
-        bt = tr.begin(
-            f"v{family}-{'ingress' if ingress else 'egress'}",
-            peer_bytes.shape[0],
-        )
+        else:
+            bt = _NOOP_BATCH
         try:
-            return self._process_inner(
+            inf = self._submit_inner(
                 peer_bytes, ep_idx, dports, protos, sports,
                 ingress=ingress, family=family, peer_words=peer_words,
                 want_rev_nat=want_rev_nat,
                 tunnel_identities=tunnel_identities, bt=bt,
             )
-        finally:
-            bt.end(self.monitor)
+        except BaseException:
+            if bt is not _NOOP_BATCH:
+                bt.end(self.monitor)
+            raise
+        if bt is not _NOOP_BATCH:
+            tr.detach(bt)
+        if inf.finish is None:
+            # ran synchronously (device-CT donated-state path)
+            if bt is not _NOOP_BATCH:
+                bt.end(self.monitor)
+            return inf.pending
+        with self._queue_lock:
+            self._inflight.append(inf)
+            _metrics.pipeline_inflight_depth.set(float(len(self._inflight)))
+            over = len(self._inflight) > self.pipeline_depth
+        while over:
+            self._complete_oldest()
+            with self._queue_lock:
+                over = len(self._inflight) > self.pipeline_depth
+        return inf.pending
 
-    def _process_inner(
+    def _submit_inner(
         self,
         peer_bytes: np.ndarray,  # [B, 4|16] int32 peer address bytes
         ep_idx: np.ndarray,
@@ -1128,48 +1476,73 @@ class DatapathPipeline:
             and row_override is None
             and (self.lb is None or self._lb_tables.get(family) is None)
         ):
-            return self._process_device_ct(
+            # the donated CT state is threaded batch-to-batch, so this
+            # path stays synchronous: run now, return already-done
+            result = self._process_device_ct(
                 peer_bytes, ep_idx, dports, protos,
                 np.asarray(sports, np.int32),
                 ingress=ingress, family=family, want_rev_nat=want_rev_nat,
             )
+            pending = PendingBatch(self)
+            pending._value = result
+            pending._event.set()
+            return _InFlight(pending, None, bt)
 
         ct = self.conntrack
         if ct is None or sports is None:
-            # No CT: full batch takes the device path (counters on MXU).
-            v, red, counters = self._dispatch(
+            # No CT: full batch takes the device path (counters on MXU
+            # when no padded lanes polluted them).
+            enq = self._dispatch_enqueue(
                 peer_bytes, ep_idx, dports, protos, ingress=ingress,
-                family=family, row_override=row_override,
+                family=family, row_override=row_override, bt=bt,
             )
-            with bt.phase("counters"):
-                if svc_drop is not None and svc_drop.any():
-                    v = v.copy()
-                    red = red.copy()
-                    v[svc_drop] = DROP_NO_SERVICE
-                    red[svc_drop] = False
-                    # device counters classified these flows
-                    # pre-override — accumulate host-side instead for
-                    # this batch
-                    with self._lock:
-                        if self.counters.shape[0] == max(1, len(self._endpoints)):
-                            cls = np.select(
-                                [v == FORWARD, v == DROP_POLICY], [0, 1], default=2
-                            )
-                            np.add.at(self.counters, (ep_idx, cls), 1)
-                else:
-                    with self._lock:
-                        if self.counters.shape == counters.shape:
-                            self.counters += counters
-                self._account_batch(v)
-            with bt.phase("emit_events"):
-                self._emit_flow_events(
-                    peer_bytes, ep_idx, dports, protos, v,
-                    ingress=ingress, family=family, redirect=red,
-                )
-            if want_rev_nat:
-                # no CT → replies can't be recognized → no NAT restore
-                return v, red, np.zeros(b, np.uint16)
-            return v, red
+            pending = PendingBatch(self)
+
+            def finish():
+                v, red, counters = self._dispatch_complete(enq, bt)
+                with bt.phase("counters"):
+                    if svc_drop is not None and svc_drop.any():
+                        v = v.copy()
+                        red = red.copy()
+                        v[svc_drop] = DROP_NO_SERVICE
+                        red[svc_drop] = False
+                        # device counters classified these flows
+                        # pre-override — accumulate host-side instead
+                        # for this batch
+                        counters = None
+                    if counters is None:
+                        with self._lock:
+                            if self.counters.shape[0] == max(
+                                1, len(self._endpoints)
+                            ):
+                                cls = np.select(
+                                    [v == FORWARD, v == DROP_POLICY],
+                                    [0, 1], default=2,
+                                )
+                                np.add.at(self.counters, (ep_idx, cls), 1)
+                    else:
+                        with self._lock:
+                            if self.counters.shape == counters.shape:
+                                self.counters += counters
+                    self._account_batch(
+                        v,
+                        shard_of=(
+                            self._shard_map(enq.spans, enq.ndev, b)
+                            if enq.ndev > 1
+                            else None
+                        ),
+                    )
+                with bt.phase("emit_events"):
+                    self._emit_flow_events(
+                        peer_bytes, ep_idx, dports, protos, v,
+                        ingress=ingress, family=family, redirect=red,
+                    )
+                if want_rev_nat:
+                    # no CT → replies can't be recognized → no restore
+                    return v, red, np.zeros(b, np.uint16)
+                return v, red
+
+            return _InFlight(pending, finish, bt)
 
         # --- conntrack pre-pass (vectorized host) ----------------------
         with bt.phase("ct_prepass"):
@@ -1208,77 +1581,99 @@ class DatapathPipeline:
 
         verdict = np.full(b, FORWARD, np.int8)
         redirect = np.zeros(b, bool)
+        enq = None
+        midx = None
         if miss.any():
             midx = np.nonzero(miss)[0]
-            v, red, _ = self._dispatch(
+            enq = self._dispatch_enqueue(
                 peer_bytes[midx],
                 ep_idx[midx],
                 dports[midx],
                 protos[midx],
                 ingress=ingress,
                 family=family,
-                pad_to=_bucket(len(midx)),
+                bucketed=True,
                 row_override=(
                     None if row_override is None else row_override[midx]
                 ),
+                bt=bt,
             )
-            if svc_drop is not None:
-                sd = svc_drop[midx]
-                v = np.where(sd, np.int8(DROP_NO_SERVICE), v)
-                red = red & ~sd
-            verdict[midx] = v
-            redirect[midx] = red
-            # CT entries for newly-allowed flows (ct_create4,
-            # bpf_lxc.c:~560: only successful verdicts create state).
-            # L7-redirect flows are EXCLUDED: a CT bypass would return
-            # redirect=False on later packets and route them around the
-            # proxy — proxied connections stay on the policy path (the
-            # reference tracks them in the proxymap instead).
-            ok = (v == FORWARD) & ~red
-            if ok.any():
-                with bt.phase("ct_create"):
-                    oidx = midx[ok]
-                    ct.create_batch(
-                        ka[oidx],
-                        kb[oidx],
-                        kc[oidx],
-                        revnat=None if revnat_vals is None else revnat_vals[oidx],
+        # completion must not create CT entries verdicted under a basis
+        # that moved while the batch was in flight
+        ct_epoch = self._ct_epoch
+        pending = PendingBatch(self)
+
+        def finish():
+            if enq is not None:
+                v, red, _c = self._dispatch_complete(enq, bt)
+                if svc_drop is not None:
+                    sd = svc_drop[midx]
+                    v = np.where(sd, np.int8(DROP_NO_SERVICE), v)
+                    red = red & ~sd
+                verdict[midx] = v
+                redirect[midx] = red
+                # CT entries for newly-allowed flows (ct_create4,
+                # bpf_lxc.c:~560: only successful verdicts create
+                # state). L7-redirect flows are EXCLUDED: a CT bypass
+                # would return redirect=False on later packets and
+                # route them around the proxy — proxied connections
+                # stay on the policy path (the reference tracks them in
+                # the proxymap instead).
+                ok = (v == FORWARD) & ~red
+                if (
+                    ok.any()
+                    and self.conntrack is ct
+                    and self._ct_epoch == ct_epoch
+                ):
+                    with bt.phase("ct_create"):
+                        oidx = midx[ok]
+                        ct.create_batch(
+                            ka[oidx],
+                            kb[oidx],
+                            kc[oidx],
+                            revnat=(
+                                None if revnat_vals is None
+                                else revnat_vals[oidx]
+                            ),
+                        )
+
+            # proxymap handoff: redirected flows carry their full
+            # 5-tuple here (sports present) — record for the L7
+            # front-end
+            if self.on_redirect is not None and redirect.any():
+                for i in np.nonzero(redirect)[0]:
+                    self.on_redirect(
+                        bytes(int(x) & 0xFF for x in peer_bytes[i]),
+                        int(ep_idx[i]), int(sports[i]), int(dports[i]),
+                        int(protos[i]), ingress, family,
                     )
 
-        # proxymap handoff: redirected flows carry their full 5-tuple
-        # here (sports present) — record for the L7 front-end
-        if self.on_redirect is not None and redirect.any():
-            for i in np.nonzero(redirect)[0]:
-                self.on_redirect(
-                    bytes(int(x) & 0xFF for x in peer_bytes[i]),
-                    int(ep_idx[i]), int(sports[i]), int(dports[i]),
-                    int(protos[i]), ingress, family,
+            # host counter accumulation (CT hits included)
+            with bt.phase("counters"):
+                with self._lock:
+                    if self.counters.shape[0] == max(1, len(self._endpoints)):
+                        cls = np.select(
+                            [verdict == FORWARD, verdict == DROP_POLICY],
+                            [0, 1],
+                            default=2,
+                        )
+                        np.add.at(self.counters, (ep_idx, cls), 1)
+                self._account_batch(verdict)
+            with bt.phase("emit_events"):
+                self._emit_flow_events(
+                    peer_bytes, ep_idx, dports, protos, verdict,
+                    ingress=ingress, family=family, redirect=redirect,
                 )
+            if want_rev_nat:
+                # revNAT restore (bpf/lib/lb.h lb4_rev_nat via the CT
+                # entry's rev_nat_index): flows whose CT hit is in the
+                # REPLY direction carry the id of the service that
+                # translated the original request — the caller rewrites
+                # the reply source back to that VIP (rev_nat_frontend()).
+                return verdict, redirect, ct_rev
+            return verdict, redirect
 
-        # host counter accumulation (CT hits included)
-        with bt.phase("counters"):
-            with self._lock:
-                if self.counters.shape[0] == max(1, len(self._endpoints)):
-                    cls = np.select(
-                        [verdict == FORWARD, verdict == DROP_POLICY],
-                        [0, 1],
-                        default=2,
-                    )
-                    np.add.at(self.counters, (ep_idx, cls), 1)
-            self._account_batch(verdict)
-        with bt.phase("emit_events"):
-            self._emit_flow_events(
-                peer_bytes, ep_idx, dports, protos, verdict,
-                ingress=ingress, family=family, redirect=redirect,
-            )
-        if want_rev_nat:
-            # revNAT restore (bpf/lib/lb.h lb4_rev_nat via the CT
-            # entry's rev_nat_index): flows whose CT hit is in the
-            # REPLY direction carry the id of the service that
-            # translated the original request — the caller rewrites
-            # the reply source back to that VIP (rev_nat_frontend()).
-            return verdict, redirect, ct_rev
-        return verdict, redirect
+        return _InFlight(pending, finish, bt)
 
     def _process_device_ct(
         self,
@@ -1303,7 +1698,7 @@ class DatapathPipeline:
         direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
         # same atomic snapshot rule as _dispatch (fused flag must match
         # the tables it was computed with)
-        tables_map, pf_empty, v6_fused = self._dp_state
+        tables_map, pf_empty, v6_fused, _fs, _ndev = self._dp_state
         t = tables_map[(direction, family)]
         b = peer_bytes.shape[0]
         pad = _bucket(b) - b
@@ -1366,6 +1761,56 @@ class DatapathPipeline:
         return verdict, redirect
 
     # ------------------------------------------------------------------
+    def submit(
+        self,
+        src_ips: np.ndarray,  # [B] uint32 IPv4 host-order (peer address)
+        ep_idx: np.ndarray,  # [B] int32 local endpoint index
+        dports: np.ndarray,
+        protos: np.ndarray,
+        *,
+        ingress: bool = True,
+        sports: Optional[np.ndarray] = None,
+        return_rev_nat: bool = False,
+        tunnel_identities: Optional[np.ndarray] = None,
+    ) -> PendingBatch:
+        """Enqueue an IPv4 batch WITHOUT pulling its results: returns a
+        PendingBatch whose .result() blocks on the device round-trip.
+        Submitting the next batch before resolving the previous one
+        overlaps host prep with device execution (bounded by
+        VerdictPipelineDepth — admission past the bound completes the
+        oldest in-flight batch first)."""
+        src = np.asarray(src_ips)
+        peer_bytes = ipv4_to_bytes(src)
+        return self._submit(
+            peer_bytes, ep_idx, dports, protos, sports,
+            ingress=ingress, family=4,
+            peer_words=(
+                np.zeros(src.shape[0], np.uint64),
+                src.astype(np.uint64),
+            ),
+            want_rev_nat=return_rev_nat,
+            tunnel_identities=tunnel_identities,
+        )
+
+    def submit_v6(
+        self,
+        peer_bytes: np.ndarray,  # [B, 16] int32 address bytes
+        ep_idx: np.ndarray,
+        dports: np.ndarray,
+        protos: np.ndarray,
+        *,
+        ingress: bool = True,
+        sports: Optional[np.ndarray] = None,
+        return_rev_nat: bool = False,
+        tunnel_identities: Optional[np.ndarray] = None,
+    ) -> PendingBatch:
+        """IPv6 counterpart of submit()."""
+        return self._submit(
+            np.asarray(peer_bytes, np.int32), ep_idx, dports, protos, sports,
+            ingress=ingress, family=6, want_rev_nat=return_rev_nat,
+            tunnel_identities=tunnel_identities,
+        )
+
     def process(
         self,
         src_ips: np.ndarray,  # [B] uint32 IPv4 host-order (peer address)
@@ -1389,18 +1834,11 @@ class DatapathPipeline:
         ``tunnel_identities`` ([B] int, 0 = none) marks overlay-decapped
         flows whose encap key carried the peer identity — trusted over
         the ipcache LPM when known (bpf_overlay.c)."""
-        src = np.asarray(src_ips)
-        peer_bytes = ipv4_to_bytes(src)
-        return self._process(
-            peer_bytes, ep_idx, dports, protos, sports,
-            ingress=ingress, family=4,
-            peer_words=(
-                np.zeros(src.shape[0], np.uint64),
-                src.astype(np.uint64),
-            ),
-            want_rev_nat=return_rev_nat,
+        return self.submit(
+            src_ips, ep_idx, dports, protos,
+            ingress=ingress, sports=sports, return_rev_nat=return_rev_nat,
             tunnel_identities=tunnel_identities,
-        )
+        ).result()
 
     def process_v6(
         self,
@@ -1415,11 +1853,11 @@ class DatapathPipeline:
         tunnel_identities: Optional[np.ndarray] = None,
     ):
         """IPv6 batch (16-level LPM walk, bpf_lxc.c:848 tail_ipv6_*)."""
-        return self._process(
-            np.asarray(peer_bytes, np.int32), ep_idx, dports, protos, sports,
-            ingress=ingress, family=6, want_rev_nat=return_rev_nat,
+        return self.submit_v6(
+            peer_bytes, ep_idx, dports, protos,
+            ingress=ingress, sports=sports, return_rev_nat=return_rev_nat,
             tunnel_identities=tunnel_identities,
-        )
+        ).result()
 
     def rev_nat_frontend(self, revnat_id: int):
         """revNAT id (from a return_rev_nat=True process call) → the

@@ -170,7 +170,10 @@ verdict_batches = registry.counter(
     "cilium_tpu_datapath_batches_total", "Flow batches processed"
 )
 verdicts_total = registry.counter(
-    "cilium_tpu_datapath_verdicts_total", "Flow verdicts by outcome"
+    "cilium_tpu_datapath_verdicts_total",
+    "Flow verdicts by outcome (batches dispatched under VerdictSharding "
+    "report per-device series via an extra device label instead of the "
+    "plain outcome series — sum across labels for the total)",
 )
 identity_count = registry.gauge("cilium_tpu_identity_count", "Allocated identities")
 l7_fallback_patterns = registry.counter(
@@ -218,5 +221,12 @@ jit_shape_buckets_total = registry.counter(
 )
 device_transfers_total = registry.counter(
     "cilium_tpu_device_transfers_total",
-    "Host↔device array transfers on traced dispatches (label: direction)",
+    "Host↔device array transfers on traced dispatches (label: direction; "
+    "under VerdictSharding each logical transfer counts once per mesh "
+    "device — the slices/gathers actually issued)",
+)
+pipeline_inflight_depth = registry.gauge(
+    "cilium_tpu_pipeline_inflight_depth",
+    "Verdict batches enqueued on device but not yet pulled to host "
+    "(bounded by VerdictPipelineDepth)",
 )

@@ -172,13 +172,32 @@ class Tracer:
         stack = getattr(self._tls, "stack", None)
         return stack[-1] if stack else NOOP_BATCH
 
+    def detach(self, bt: BatchTrace) -> None:
+        """Remove ``bt`` from this thread's span stack WITHOUT retiring
+        it. The pipelined dispatch path parks a submitted batch's trace
+        between its enqueue half and its completion half, so spans keep
+        attaching to the batch that COMPLETES while ``current()``
+        already serves the next submission being prepared."""
+        stack = getattr(self._tls, "stack", None)
+        if stack is not None:
+            try:
+                stack.remove(bt)
+            except ValueError:
+                pass
+
     def _complete(self, bt: BatchTrace, hub=None) -> None:
         """end() tail: pop the span stack, retire the trace into the
         ring, feed the metrics registry, and (monitor listeners only)
         publish a TraceSummary event."""
+        # identity-based removal, not a top-of-stack pop: with depth>1
+        # batches complete FIFO while newer traces sit above them (or
+        # were already detach()ed), so ``bt`` may be anywhere or gone
         stack = getattr(self._tls, "stack", None)
-        if stack and stack[-1] is bt:
-            stack.pop()
+        if stack is not None:
+            try:
+                stack.remove(bt)
+            except ValueError:
+                pass
         with self._lock:
             self._ring.append(bt)
         for name, _rel, dur in bt.phases:

@@ -57,6 +57,17 @@ class PolicymapTables:
         return self.id_bits[:, self.id_bits.shape[1] // 2:]
 
 
+def replicate_tables(t: PolicymapTables, sharding=None) -> PolicymapTables:
+    """Commit a policymap REPLICATED across a verdict mesh (chex
+    dataclasses are pytrees, so one ``device_put`` re-places every
+    column/bitmap leaf). The row-gather reads arbitrary identity rows
+    per flow, so the bitmap table must be whole on every device a flow
+    shard lands on. ``sharding=None`` returns the tables untouched."""
+    if sharding is None:
+        return t
+    return jax.device_put(t, sharding)
+
+
 @functools.partial(jax.jit, static_argnames=("block",))
 def lookup_batch(
     t: PolicymapTables,

@@ -528,6 +528,18 @@ def merge_flat_tries(ip_arrays, deny_arrays):
     return root_info, root_child, sub_child, sub_info
 
 
+def place_table(a, sharding=None):
+    """Upload one trie array to device. With a ``NamedSharding`` the
+    array is committed REPLICATED across the verdict mesh (every LPM
+    walk reads the whole trie regardless of which flow shard it
+    serves); without one this is the classic single-device upload.
+    Centralized here so every trie consumer places tables the same way
+    under VerdictSharding."""
+    if sharding is None:
+        return jnp.asarray(a)
+    return jax.device_put(np.asarray(a), sharding)
+
+
 def ipv4_to_bytes(addrs: np.ndarray) -> np.ndarray:
     """[B] uint32 host-order IPv4 → [B, 4] int32 big-endian bytes."""
     a = addrs.astype(np.uint32)

@@ -31,6 +31,14 @@ class DaemonConfig:
     monitor_queue_size: int = 4096
     proxy_port_min: int = 10000
     proxy_port_max: int = 20000
+    # Max verdict batches in flight on device before the pipeline
+    # blocks pulling the oldest: depth 1 = fully synchronous, depth 2
+    # overlaps host prep of batch N+1 with device execution of batch N.
+    verdict_pipeline_depth: int = 2
+    # Boot-time value of the VerdictSharding runtime option (flow
+    # batches split across jax.devices(), tables replicated). Only
+    # takes effect with >1 visible device.
+    verdict_sharding: bool = False
 
     def validate(self) -> None:
         if self.enforcement_mode not in ("default", "always", "never"):
@@ -39,6 +47,8 @@ class DaemonConfig:
             raise ValueError("cluster-id must be 0-255")
         if self.proxy_port_min >= self.proxy_port_max:
             raise ValueError("invalid proxy port range")
+        if not 1 <= self.verdict_pipeline_depth <= 64:
+            raise ValueError("verdict-pipeline-depth must be 1-64")
 
 
 _config = DaemonConfig()
@@ -87,6 +97,11 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
         OptionSpec("Policy", "Policy enforcement"),
         OptionSpec("PolicyVerdictNotification", "Per-verdict events"),
         OptionSpec("PhaseTracing", "Verdict-path phase tracing (observe/)"),
+        OptionSpec(
+            "VerdictSharding",
+            "Flow-sharded verdict dispatch across jax.devices() "
+            "(tables replicated, batches split; needs >1 device)",
+        ),
     )
 }
 

@@ -344,13 +344,15 @@ class TestConntrackPipeline:
         assert (v == FORWARD).all()
 
         calls = []
-        orig = pipe._dispatch
+        orig = pipe._dispatch_enqueue
 
         def counting_dispatch(*a, **k):
             calls.append(1)
             return orig(*a, **k)
 
-        monkeypatch.setattr(pipe, "_dispatch", counting_dispatch)
+        # _dispatch_enqueue is the single device-program entry for both
+        # the sync (_dispatch) and pipelined (submit) paths
+        monkeypatch.setattr(pipe, "_dispatch_enqueue", counting_dispatch)
         v, _ = pipe.process(src, eps, ports, protos, ingress=True, sports=sports)
         assert (v == FORWARD).all()
         # Zero device dispatches: the whole batch resolved in the CT

@@ -241,6 +241,8 @@ class TestSurfaces:
             out = d.traces()
             assert out == {"enabled": False,
                            "capacity": d.pipeline.tracer.capacity,
+                           "pipeline_depth": d.pipeline.pipeline_depth,
+                           "in_flight": 0,
                            "traces": []}
             d.config_patch({"PhaseTracing": True})
             assert d.pipeline.tracer.active
