@@ -589,6 +589,67 @@ def _bench_overlap(
     return ratio, k * b / t_pipe
 
 
+def _bench_flows(
+    repo, reg, idents, nrng: np.random.Generator
+) -> Tuple[float, float, float]:
+    """``--flows``: FlowAttribution cost on the N_RULES world →
+    (off_vps, on_vps, overhead_pct).
+
+    Same pipeline, same batches, pipelined dispatch at depth 2; the
+    only variable is the attribution program — the origin tail in the
+    verdict kernel, the [R] hit segment-sum, the wider completion pull
+    (6 arrays instead of 3), the metric accounting, and the sampled
+    flow-ring records. Verdicts are asserted bit-identical across the
+    two modes, so the overhead number can never come from a diverged
+    program."""
+    from cilium_tpu.datapath.pipeline import DatapathPipeline
+    from cilium_tpu.engine import PolicyEngine
+    from cilium_tpu.ipcache.ipcache import IPCache
+    from cilium_tpu.ipcache.prefilter import PreFilter
+
+    eng = PolicyEngine(repo, reg)
+    cache = IPCache()
+    for i, ident in enumerate(idents):
+        cache.upsert(
+            f"10.{(i >> 8) & 255}.{i & 255}.1/32", ident.id, source="k8s"
+        )
+    pipe = DatapathPipeline(
+        eng, cache, PreFilter(), conntrack=None, pipeline_depth=2
+    )
+    pipe.set_endpoints([idents[j].id for j in range(N_ENDPOINTS)])
+    b, k = 1 << 18, 8
+    batches = []
+    for _ in range(k):
+        i_sel = nrng.integers(0, len(idents), b)
+        ips = (
+            np.uint32(10) << 24
+            | ((i_sel >> 8) & 255).astype(np.uint32) << 16
+            | (i_sel & 255).astype(np.uint32) << 8
+            | 1
+        ).astype(np.uint32)
+        eps = nrng.integers(0, N_ENDPOINTS, b).astype(np.int32)
+        dports = nrng.choice(np.array([80, 443, 8080, 53, 22], np.int32), b)
+        protos = np.where(dports == 53, 17, 6).astype(np.int32)
+        batches.append((ips, eps, dports, protos))
+
+    def timed_run():
+        pipe.process(*batches[0])  # warm this mode's program
+        t0 = time.time()
+        pend = [pipe.submit(*bt) for bt in batches]
+        out = [p.result() for p in pend]
+        return time.time() - t0, out
+
+    t_off, off = timed_run()
+    pipe.set_attribution(True)
+    pipe.rebuild()
+    t_on, on = timed_run()
+    for (v0, r0), (v1, r1) in zip(off, on):
+        np.testing.assert_array_equal(v0, v1)
+        np.testing.assert_array_equal(r0, r1)
+    overhead = (t_on - t_off) / t_off * 100.0 if t_off > 0 else 0.0
+    return k * b / t_off, k * b / t_on, overhead
+
+
 def _bench_native_e2e(snaps, idents, nrng: np.random.Generator):
     """The native front-end's FULL per-node pipeline (conntrack probe →
     identity LPM → policymap, bpf_lxc.c end to end) — (mixed_vps,
@@ -1081,6 +1142,26 @@ def main() -> None:
     t0 = time.time()
     repo, reg, idents = build_world(rng)
     t_build = time.time() - t0
+
+    if "--flows" in sys.argv[1:]:
+        # attribution-overhead round (policyd-flows): ONE number, fast,
+        # instead of the full sweep — the round driver diffs
+        # attribution_overhead_pct across PRs
+        off_vps, on_vps, overhead = _bench_flows(
+            repo, reg, idents, np.random.default_rng(21)
+        )
+        print(json.dumps({
+            "metric": f"FlowAttribution overhead at {N_RULES} rules",
+            "value": round(overhead, 2),
+            "unit": "pct",
+            "attribution_overhead_pct": round(overhead, 2),
+            "flows_off_vps": round(off_vps),
+            "flows_on_vps": round(on_vps),
+            "pipeline_depth": 2,
+            "backend": backend,
+            "build_s": round(t_build, 2),
+        }))
+        return
 
     engine = PolicyEngine(repo, reg)
     t0 = time.time()

@@ -123,6 +123,23 @@ class APIClient:
     def traces_get(self, limit: int = 16):
         return self._request("GET", f"/traces?limit={limit}")
 
+    def flows_get(self, limit: int = 64, *, verdict=None,
+                  from_identity=None, reason=None):
+        params = [f"limit={limit}"]
+        if verdict is not None:
+            params.append(f"verdict={verdict}")
+        if from_identity is not None:
+            params.append(f"from_identity={from_identity}")
+        if reason is not None:
+            params.append(f"reason={reason}")
+        return self._request("GET", "/flows?" + "&".join(params))
+
+    def policy_explain(self, src, dst, dport="", *, ingress=True):
+        return self._request("POST", "/policy/explain", {
+            "src": list(src), "dst": list(dst), "dport": dport,
+            "ingress": ingress,
+        })
+
     def fqdn_poll(self):
         return self._request("POST", "/fqdn/poll")
 

@@ -162,6 +162,22 @@ class _Handler(UnixHandler):
         elif path == "/traces" and method == "GET":
             limit = int(q.get("limit", ["16"])[0])
             self._json(200, d.traces(limit=limit))
+        elif path == "/flows" and method == "GET":
+            def _opt(name):
+                return int(q[name][0]) if name in q else None
+            self._json(200, d.flows(
+                limit=int(q.get("limit", ["64"])[0]),
+                verdict=_opt("verdict"),
+                from_identity=_opt("from_identity"),
+                reason=_opt("reason"),
+            ))
+        elif path == "/policy/explain" and method == "POST":
+            body = self._body()
+            self._json(200, d.policy_explain(
+                body.get("src", []), body.get("dst", []),
+                body.get("dport", ""),
+                ingress=body.get("ingress", True),
+            ))
         elif path == "/fqdn/poll" and method == "POST":
             self._json(200, d.fqdn_poll())
         elif path == "/service" and method == "GET":

@@ -61,6 +61,10 @@ def collect_debuginfo(daemon) -> Dict:
         # policyd-trace ring (metrics.prom in the archive carries the
         # matching /metrics snapshot via write_archive_from)
         "traces": daemon.traces(limit=64),
+        # policyd-flows ring → flows.json in the archive: the sampled
+        # attributed flows an operator replays offline against
+        # policy.json to explain each verdict
+        "flows": daemon.flows(limit=64),
     }
 
 

@@ -574,6 +574,43 @@ class DirectionPacker:
             self.l7_list.append((subj, port, gid))
 
 
+# Sentinel for "no rule contributes here" in rule-origin arrays
+# (min-reduction identity; mirrored by ops.verdict.NO_RULE — program.py
+# cannot import ops.verdict, the dependency points the other way).
+NO_RULE = 2**31 - 1
+
+
+def rule_origin_arrays(
+    packer: DirectionPacker, rule_keys: Sequence[int]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Term→first-rule origin arrays for verdict attribution
+    (policyd-flows): for each deny subject-selector row, pure-L3-allow
+    subject-selector row, and K1 combo column, the LOWEST repository
+    rule index whose packed cells reference it (``rule_keys`` is
+    ``[id(r) for r in rules]`` in repository order — the same keys
+    ``write_rule`` attributed cells under). First-contributing-rule-wins
+    matches the reference's in-order rule walk; granularity is the
+    packed term (selector row / combo column), the same resolution the
+    kernel's reductions preserve. Entries no surviving rule references
+    hold ``NO_RULE``."""
+    p = packer.prog
+    deny_rule = np.full(packer.s_pad, NO_RULE, np.int32)
+    allow_rule = np.full(packer.s_pad, NO_RULE, np.int32)
+    combo_rule = np.full(p.s1_mat.shape[1], NO_RULE, np.int32)
+    for ri, key in enumerate(rule_keys):
+        for name, i, j in packer.rule_cells.get(key, ()):
+            if name == "deny":
+                if ri < deny_rule[i]:
+                    deny_rule[i] = ri
+            elif name == "allow":
+                if ri < allow_rule[i]:
+                    allow_rule[i] = ri
+            elif name == "s1":
+                if ri < combo_rule[j]:
+                    combo_rule[j] = ri
+    return deny_rule, allow_rule, combo_rule
+
+
 def _merge_raws(raws: Sequence[_RawDirection]) -> _RawDirection:
     """Concatenate per-rule raws into one batch raw, renumbering group
     ids globally (the shape the packer sizes its buckets from)."""

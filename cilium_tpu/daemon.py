@@ -142,6 +142,9 @@ class Daemon:
         # per-endpoint option resolution for event gating (`cilium
         # endpoint config` overrides, layered over the daemon map)
         self.pipeline.endpoint_options = self._endpoint_option
+        # policyd-flows: flow records carry label strings, resolved
+        # lazily for the sampled subset only (never per-flow-in-batch)
+        self.pipeline.identity_labels = self._identity_label_strings
         # xDS distribution (pkg/envoy xDS): NPDS per-endpoint L7
         # policy + NPHDS identity→addresses, served to external
         # proxies by an XDSServer the embedder/CLI attaches
@@ -354,6 +357,54 @@ class Daemon:
             "parity": oracle_allowed == device_allowed,
             "trace": ctx.log(),
         }
+
+    def policy_explain(
+        self,
+        src_labels: Sequence[str],
+        dst_labels: Sequence[str],
+        dport: str = "",
+        *,
+        ingress: bool = True,
+    ) -> Dict:
+        """GET /policy/explain (policyd-flows): replay ONE flow through
+        the verdict kernel with attribution on and name the deciding
+        repository rule + drop reason — `cilium policy trace` answered
+        by the device program instead of the host oracle."""
+        src = parse_label_array(src_labels)
+        dst = parse_label_array(dst_labels)
+        port = parse_dport(dport) if dport else None
+        # identity resolution mirrors policy_resolve: ref-counted
+        # temporaries for label sets without a live identity
+        src_id = self.registry.lookup_by_labels(src)
+        dst_id = self.registry.lookup_by_labels(dst)
+        tmp = []
+        for have, lbls in ((src_id, src), (dst_id, dst)):
+            if have is None:
+                tmp.append(self.allocate_identity(lbls))
+        src_id = src_id or self.registry.lookup_by_labels(src)
+        dst_id = dst_id or self.registry.lookup_by_labels(dst)
+        subj, peer = (dst_id, src_id) if ingress else (src_id, dst_id)
+        try:
+            if port is not None:
+                proto = (
+                    u8proto.from_name(port.protocol)
+                    if port.protocol not in ("ANY", "") else 6
+                )
+                out = self.engine.explain_one(
+                    subj.id, peer.id, port.port, proto,
+                    ingress=ingress, l4=True,
+                )
+            else:
+                out = self.engine.explain_one(
+                    subj.id, peer.id, 0, 6, ingress=ingress, l4=False,
+                )
+        finally:
+            for ident in tmp:
+                self.release_identity(ident)
+        out["direction"] = "ingress" if ingress else "egress"
+        out["src_identity"] = src_id.id
+        out["dst_identity"] = dst_id.id
+        return out
 
     # -- endpoints ------------------------------------------------------
     def endpoint_add(
@@ -688,6 +739,15 @@ class Daemon:
             return None
         return {"id": ident.id, "labels": list(ident.labels.to_strings())}
 
+    def _identity_label_strings(self, num: int) -> Tuple[str, ...]:
+        """Label strings for a numeric identity, () when unknown —
+        the pipeline's flow-record label resolver (sampled flows
+        only, so a registry miss is cheap and non-fatal)."""
+        ident = self.registry.get(num)
+        if ident is None:
+            return ()
+        return tuple(ident.labels.to_strings())
+
     # -- runtime config (pkg/option; PATCH /config) ----------------------
     # options whose runtime mutation actually changes behavior; the
     # rest are rejected so the surface never claims changes it cannot
@@ -695,7 +755,7 @@ class Daemon:
     _MUTABLE_OPTIONS = frozenset(
         {
             "Conntrack", "TraceNotification", "DropNotification", "Debug",
-            "PhaseTracing", "VerdictSharding",
+            "PhaseTracing", "VerdictSharding", "FlowAttribution",
         }
     )
 
@@ -724,6 +784,11 @@ class Daemon:
             # flow-sharded dispatch; placement changes on next rebuild
             # (a single-device node accepts the option as a no-op)
             self.pipeline.set_sharding(value)
+        elif name == "FlowAttribution":
+            # policyd-flows: per-flow rule attribution + flow-log ring;
+            # the verdict program recompiles with the origin tail on
+            # the next rebuild, the off path keeps today's program
+            self.pipeline.set_attribution(value)
         elif name == "Debug":
             import logging as _logging
 
@@ -947,7 +1012,31 @@ class Daemon:
             "capacity": tr.capacity,
             "pipeline_depth": self.pipeline.pipeline_depth,
             "in_flight": self.pipeline.inflight_depth,
+            # policyd-flows: attribution changes what the host_sync
+            # phase pulls (6 arrays, not 3) — trace readers should know
+            "flow_attribution": self.pipeline.flow_ring.active,
             "traces": tr.traces(limit),
+        }
+
+    def flows(
+        self,
+        limit: int = 64,
+        *,
+        verdict: Optional[int] = None,
+        from_identity: Optional[int] = None,
+        reason: Optional[int] = None,
+    ) -> Dict:
+        """GET /flows (policyd-flows ring buffer; the Hubble
+        `cilium monitor`/flow-API analog for attributed verdicts)."""
+        ring = self.pipeline.flow_ring
+        return {
+            "enabled": ring.active,
+            "capacity": ring.capacity,
+            "recorded": ring.recorded,
+            "flows": ring.query(
+                limit, verdict=verdict,
+                from_identity=from_identity, reason=reason,
+            ),
         }
 
     # -- status ---------------------------------------------------------

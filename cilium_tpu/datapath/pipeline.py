@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import metrics as _metrics
 from ..engine import PolicyEngine
 from ..identity.model import ID_WORLD
+from ..observe.flows import SAMPLE_CAP as _FLOW_SAMPLE_CAP, FlowRecord, FlowRing
 from ..observe.tracer import NOOP_BATCH as _NOOP_BATCH, Tracer
 from ..ipcache.ipcache import IPCache
 from ..ipcache.prefilter import PreFilter
@@ -188,11 +189,30 @@ def _verdict_tail(
     proto: jnp.ndarray,
     ep_count: int,
     block: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    attrib: bool = False,
+    rule_tab: Optional[jnp.ndarray] = None,
+    n_rules: int = 0,
+):
     """Shared post-LPM tail (policy lookup, prefilter override,
     counter matmul) — traced inside both jitted entry points so the
-    v4/v6 paths cannot diverge."""
-    dec, red = lookup_batch(policymap, ep_idx, peer_row, dport, proto, block=block)
+    v4/v6 paths cannot diverge.
+
+    ``attrib=True`` (static on the jitted callers; the off path keeps
+    its exact original program) appends per-flow attribution: the
+    deciding-rule index gathered from ``rule_tab`` (-1 = none; masked
+    for prefilter drops, which never reached the policymap), whether
+    any L4 column covered the flow (the no-L4 vs no-L3 drop
+    discriminator), and the on-device [R] rule-hit segment-sum —
+    pulled d2h only in the completion half, like the counters."""
+    if not attrib:
+        dec, red = lookup_batch(
+            policymap, ep_idx, peer_row, dport, proto, block=block
+        )
+    else:
+        dec, red, rule, l4x = lookup_batch(
+            policymap, ep_idx, peer_row, dport, proto, block=block,
+            attrib=True, rule_tab=rule_tab,
+        )
     verdict = jnp.where(denied_pf, jnp.int8(DROP_PREFILTER), dec)
     redirect = red & ~denied_pf
 
@@ -205,7 +225,16 @@ def _verdict_tail(
     counters = jax.lax.dot_general(
         ep_oh, cls, (((0,), (0,)), ((), ())), preferred_element_type=jnp.int32
     )
-    return verdict, redirect, counters
+    if not attrib:
+        return verdict, redirect, counters
+    rule = jnp.where(denied_pf, jnp.int32(-1), rule)
+    idx = jnp.clip(rule, 0, max(n_rules - 1, 0))
+    hits = (
+        jnp.zeros((max(n_rules, 1),), jnp.int32)
+        .at[idx]
+        .add((rule >= 0).astype(jnp.int32))
+    )
+    return verdict, redirect, counters, rule, l4x, hits
 
 
 def _v6_lpm_stage(t, peer_bytes, levels: int, prefilter: bool, fused: bool):
@@ -235,7 +264,10 @@ def _v6_lpm_stage(t, peer_bytes, levels: int, prefilter: bool, fused: bool):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("ep_count", "block", "levels", "prefilter", "fused"),
+    static_argnames=(
+        "ep_count", "block", "levels", "prefilter", "fused", "attrib",
+        "n_rules",
+    ),
 )
 def process_flows(
     t: DatapathTables,
@@ -249,8 +281,13 @@ def process_flows(
     prefilter: bool = True,
     fused: bool = False,
     row_override: Optional[jnp.ndarray] = None,  # [B] int32, -1 = LPM
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """→ (verdict[B] int8, redirect[B] bool, counters [EP, 3] int32).
+    attrib: bool = False,
+    rule_tab: Optional[jnp.ndarray] = None,  # [N, C_pad] int32
+    n_rules: int = 0,
+):
+    """→ (verdict[B] int8, redirect[B] bool, counters [EP, 3] int32);
+    with ``attrib=True`` additionally (rule[B] int32, l4_covered[B]
+    bool, hits[R] int32) — see _verdict_tail.
 
     ``peer_bytes`` is the remote address of each flow: the SOURCE for
     ingress traffic (bpf_netdev.c:376 resolves src identity), the
@@ -274,7 +311,9 @@ def process_flows(
         peer_row = jnp.where(trusted, row_override, peer_row)
         denied_pf = denied_pf & ~trusted
     return _verdict_tail(
-        t.policymap, denied_pf, peer_row, ep_idx, dport, proto, ep_count, block
+        t.policymap, denied_pf, peer_row, ep_idx, dport, proto, ep_count,
+        block, attrib=attrib, rule_tab=rule_tab if attrib else None,
+        n_rules=n_rules,
     )
 
 
@@ -283,7 +322,8 @@ process_ipv4 = process_flows
 
 
 @functools.partial(
-    jax.jit, static_argnames=("ep_count", "block", "prefilter")
+    jax.jit, static_argnames=("ep_count", "block", "prefilter", "attrib",
+                              "n_rules")
 )
 def process_flows_wide(
     t: WideDatapathTables,
@@ -295,9 +335,13 @@ def process_flows_wide(
     block: int = 16384,
     prefilter: bool = True,
     row_override: Optional[jnp.ndarray] = None,  # [B] int32, -1 = LPM
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    attrib: bool = False,
+    rule_tab: Optional[jnp.ndarray] = None,  # [N, C_pad] int32
+    n_rules: int = 0,
+):
     """IPv4 fast path over the wide tries — semantics identical to
-    process_flows(levels=4), including the overlay row_override."""
+    process_flows(levels=4), including the overlay row_override and
+    the attrib variant."""
     denied_pf, hit = _v4_lpm_stage(t, peer_u32, prefilter)
     peer_row = jnp.where(hit > 0, hit - 1, t.world_row)
     if row_override is not None:
@@ -305,7 +349,9 @@ def process_flows_wide(
         peer_row = jnp.where(trusted, row_override, peer_row)
         denied_pf = denied_pf & ~trusted
     return _verdict_tail(
-        t.policymap, denied_pf, peer_row, ep_idx, dport, proto, ep_count, block
+        t.policymap, denied_pf, peer_row, ep_idx, dport, proto, ep_count,
+        block, attrib=attrib, rule_tab=rule_tab if attrib else None,
+        n_rules=n_rules,
     )
 
 
@@ -481,17 +527,19 @@ class _InFlight:
 class _Enqueued:
     """Un-pulled device results of one dispatch: per-chunk (verdict,
     redirect, counters) device arrays plus the spans that produced
-    them. ``exact`` marks device counters usable as-is (no padded
-    lanes polluted them)."""
+    them — (…, rule, l4_covered, hits) 6-tuples when ``attrib``.
+    ``exact`` marks device counters (and rule-hit sums) usable as-is
+    (no padded lanes polluted them)."""
 
-    __slots__ = ("chunks", "spans", "b", "exact", "ndev")
+    __slots__ = ("chunks", "spans", "b", "exact", "ndev", "attrib")
 
-    def __init__(self, chunks, spans, b, exact, ndev) -> None:
+    def __init__(self, chunks, spans, b, exact, ndev, attrib=False) -> None:
         self.chunks = chunks
         self.spans = spans
         self.b = b
         self.exact = exact
         self.ndev = ndev
+        self.attrib = attrib
 
 
 class DatapathPipeline:
@@ -512,6 +560,7 @@ class DatapathPipeline:
         tracer: Optional[Tracer] = None,
         pipeline_depth: int = 2,
         sharding: bool = False,
+        flow_ring: Optional[FlowRing] = None,
     ) -> None:
         self.engine = engine
         self.ipcache = ipcache
@@ -536,6 +585,14 @@ class DatapathPipeline:
         # verdict path pays one `tracer.active` attribute read per
         # batch (the hub's `active` pattern) until enabled
         self.tracer = tracer if tracer is not None else Tracer()
+        # policyd-flows ring (observe/flows.py): sampled FlowRecords
+        # from the completion half while FlowAttribution is on. Same
+        # cost model as the tracer: one `ring.active` read per batch.
+        self.flow_ring = flow_ring if flow_ring is not None else FlowRing()
+        # optional identity → labels resolver for flow records:
+        # fn(identity_id) -> tuple of label strings (the daemon points
+        # this at its IdentityRegistry)
+        self.identity_labels = None
         # jit-cache key shapes already dispatched (tracing telemetry:
         # a new member ≈ one XLA recompile)
         self._seen_shapes: set = set()
@@ -579,13 +636,15 @@ class DatapathPipeline:
         self._pf_empty: Tuple[bool, bool] = (True, True)
         self._v6_fused = False  # v6 merged deny+identity trie present
         # ATOMIC read snapshot for the lock-free dispatch paths:
-        # (tables, pf_empty, v6_fused, flow_sharding, ndev) swap
-        # together — reading them as separate attributes could pair a
-        # new flag with old tables (e.g. fused=True against placeholder
-        # merged arrays, which would resolve every v6 flow to world
-        # with no denies, or a flow sharding against tables placed for
-        # a different mesh)
-        self._dp_state: Tuple = ({}, (True, True), False, None, 1)
+        # (tables, pf_empty, v6_fused, flow_sharding, ndev, attrib)
+        # swap together — reading them as separate attributes could
+        # pair a new flag with old tables (e.g. fused=True against
+        # placeholder merged arrays, which would resolve every v6 flow
+        # to world with no denies, or a flow sharding against tables
+        # placed for a different mesh, or a rule table from an older
+        # rule set against newer policymaps). ``attrib`` is None (off)
+        # or ({direction: rule_tab [N, C_pad]}, n_rules).
+        self._dp_state: Tuple = ({}, (True, True), False, None, 1, None)
         self._tries: Optional[Tuple] = None  # ((pf4, ip4), (pf6, ip6), world_row)
         self.counters = np.zeros((0, 3), np.int64)
         # -- bounded in-flight dispatch queue -------------------------
@@ -617,6 +676,18 @@ class DatapathPipeline:
         # direction → (source policymap, replicated copy): re-place
         # only when materialization swaps the source object
         self._placed_pm: Dict[int, Tuple[object, object]] = {}
+        # -- verdict attribution (FlowAttribution) --------------------
+        # requested state; takes effect on the next rebuild (the sweep
+        # must re-run with the attribution kernel variant to populate
+        # the per-(row, column) rule table)
+        self._attrib_requested = False
+        self._attrib_n_rules = 0
+        # rule index → origin label (repo.origin_names()), refreshed
+        # with the rule tables; read lock-free in the completion half
+        self._attrib_names: List[str] = []
+        # direction → (source rule_tab, replicated copy) — the
+        # _placed_pm pattern for the attribution gather table
+        self._placed_rt: Dict[int, Tuple[object, object]] = {}
 
     def set_endpoints(self, endpoints: Sequence) -> None:
         """Accepts identity ids (endpoint id == identity id) or
@@ -662,9 +733,35 @@ class DatapathPipeline:
             self._tables = {}
             self._tries = None
             self._placed_pm.clear()
+            self._placed_rt.clear()
         # telemetry/warm caches: best-effort sets the lock-free dispatch
         # paths also mutate bare (GIL-atomic; a racing add only costs
         # one redundant compile or a miscounted cache-hit metric)
+        self._seen_shapes.clear()
+        self._warm_buckets.clear()
+
+    def set_attribution(self, on: bool) -> None:
+        """Toggle per-flow verdict attribution (the FlowAttribution
+        runtime option). Takes effect on the next rebuild: the
+        materializer sweep re-runs with the attribution kernel variant
+        to populate the per-(identity row, column) deciding-rule table,
+        and dispatches switch to the attrib program variant (rule
+        gather + on-device rule-hit segment-sum; d2h pulls stay in the
+        completion half). Off keeps the exact pre-attribution programs
+        — the rule table contributes no leaves to the off-path trace.
+        The device-CT fused path is NOT attributed; its drops keep the
+        generic policy reason. Clears the shape/warm caches —
+        attributed and plain dispatches compile different programs."""
+        with self._lock:
+            if bool(on) == self._attrib_requested:
+                return
+            self._attrib_requested = bool(on)
+            # force re-materialization: the rule table only exists when
+            # the sweep ran with attribution (and is dropped when off)
+            self._mat.clear()
+            self._mat_sig = ()
+            self._placed_rt.clear()
+        self.flow_ring.active = bool(on)
         self._seen_shapes.clear()
         self._warm_buckets.clear()
 
@@ -721,9 +818,16 @@ class DatapathPipeline:
                     self._materialize_both(compiled, device)
                     mat_fresh = True
                 else:
+                    ao, nr = self._attrib_origins(compiled)
                     for _seq, _kind, events in deltas:
-                        for mat in self._mat.values():
-                            patch_identity_rows(mat, compiled, device, events)
+                        for direction, mat in self._mat.items():
+                            patch_identity_rows(
+                                mat, compiled, device, events,
+                                attrib_origin=ao[
+                                    direction == TRAFFIC_INGRESS
+                                ],
+                                n_rules=nr,
+                            )
                         # Any row event (add OR release) can change what an
                         # ipcache entry resolves to — e.g. a released id
                         # being re-allocated onto a tombstoned row, or an
@@ -912,9 +1016,25 @@ class DatapathPipeline:
                 )
             self._tables = tables
             ndev = 1 if self._mesh is None else int(self._mesh.size)
+            # attribution element: present only when EVERY direction's
+            # state carries a rule table (a race with a rule mutation
+            # can leave one direction plain for a cycle — the racing
+            # delta re-materializes on the next rebuild)
+            attrib_el = None
+            if self._attrib_requested:
+                rtabs = {}
+                for direction, mat in self._mat.items():
+                    if mat.rule_tab is None:
+                        rtabs = None
+                        break
+                    rtabs[direction] = self._replicated_rule_tab(
+                        direction, mat.rule_tab
+                    )
+                if rtabs:
+                    attrib_el = (rtabs, self._attrib_n_rules)
             self._dp_state = (
                 tables, self._pf_empty, self._v6_fused,
-                self._flow_sharding, ndev,
+                self._flow_sharding, ndev, attrib_el,
             )
             if self.counters.shape[0] != len(self._endpoints):
                 self.counters = np.zeros((len(self._endpoints), 3), np.int64)
@@ -933,13 +1053,52 @@ class DatapathPipeline:
         self._placed_pm[direction] = (pm, placed)
         return placed
 
+    def _replicated_rule_tab(self, direction: int, rt):
+        """Mesh-replicated copy of one direction's attribution rule
+        table — the _replicated_policymap pattern (the rule gather
+        reads arbitrary identity rows per flow, so the table must be
+        whole on every device a flow shard lands on)."""
+        if self._table_sharding is None:
+            return rt
+        src, placed = self._placed_rt.get(direction, (None, None))
+        if src is rt:
+            return placed
+        # identity-cached: the transfer fires only when a rebuild
+        # swapped the rule table (same cadence + same _lock as the
+        # sibling _replicated_policymap's replicate_tables placement)
+        placed = jax.device_put(rt, self._table_sharding)  # policyd-lint: disable=LOCK002
+        self._placed_rt[direction] = (rt, placed)
+        return placed
+
+    def _attrib_origins(self, compiled):
+        """({ingress_bool: AttribTables|None}, n_rules) for the current
+        rebuild — all-None when attribution is off, the engine carries
+        no compile state (snapshot-restored), or a rule mutation raced
+        the (compiled, device) snapshot (the racing delta forces
+        re-materialization on the next rebuild, which self-heals)."""
+        off = {True: None, False: None}
+        if not self._attrib_requested:
+            return off, 0
+        ai = self.engine.attribution(True, expect_revision=compiled.revision)
+        ae = self.engine.attribution(False, expect_revision=compiled.revision)
+        if ai is None or ae is None:
+            return off, 0
+        return {True: ai[0], False: ae[0]}, ai[1]
+
     def _materialize_both(self, compiled, device) -> None:
+        ao, nr = self._attrib_origins(compiled)
+        self._attrib_n_rules = nr
+        self._attrib_names = (
+            self.engine.repo.origin_names() if nr else []
+        )
         self._mat = {
             TRAFFIC_INGRESS: materialize_endpoints_state(
-                compiled, device, self._endpoints, ingress=True
+                compiled, device, self._endpoints, ingress=True,
+                attrib_origin=ao[True], n_rules=nr,
             ),
             TRAFFIC_EGRESS: materialize_endpoints_state(
-                compiled, device, self._endpoints, ingress=False
+                compiled, device, self._endpoints, ingress=False,
+                attrib_origin=ao[False], n_rules=nr,
             ),
         }
 
@@ -972,19 +1131,29 @@ class DatapathPipeline:
         ingress: bool,
         family: int,
         redirect: Optional[np.ndarray] = None,
+        rule: Optional[np.ndarray] = None,
+        l4_covered: Optional[np.ndarray] = None,
     ) -> None:
         """DropNotify per dropped flow (+ TraceNotify per forwarded
         flow when trace_enabled). Cold path: runs only while a monitor
         listener is attached (hub.active), and drops are normally the
         small tail of a batch. Peer identity is resolved host-side via
         the ipcache (the event consumer wants labels/identity, the
-        datapath only knows rows)."""
+        datapath only knows rows).
+
+        With attribution arrays (``rule``/``l4_covered``, FlowAttribution
+        on) policy drops carry the REAL reason from the policyd-flows
+        taxonomy — deny-rule vs no-L3-match vs no-L4-match — instead of
+        the generic REASON_POLICY."""
         hub = self.monitor
         if hub is None or not hub.active:
             return
         from ..monitor.events import (
             REASON_NO_SERVICE,
             REASON_POLICY,
+            REASON_POLICY_DENY,
+            REASON_POLICY_NO_L3,
+            REASON_POLICY_NO_L4,
             REASON_PREFILTER,
             TRACE_TO_ENDPOINT,
             TRACE_TO_PROXY,
@@ -998,6 +1167,17 @@ class DatapathPipeline:
             DROP_PREFILTER: REASON_PREFILTER,
             DROP_NO_SERVICE: REASON_NO_SERVICE,
         }
+
+        def _reason(i: int) -> int:
+            code = int(verdict[i])
+            if code == DROP_POLICY and rule is not None:
+                if int(rule[i]) >= 0:
+                    return REASON_POLICY_DENY
+                if l4_covered is not None and bool(l4_covered[i]):
+                    return REASON_POLICY_NO_L4
+                return REASON_POLICY_NO_L3
+            return reason_of.get(code, 0)
+
         events = []
 
         def _identity(addr: bytes) -> int:
@@ -1026,7 +1206,7 @@ class DatapathPipeline:
             addr = bytes(int(b) & 0xFF for b in peer_bytes[i])
             events.append(
                 DropNotify(
-                    reason=reason_of.get(int(verdict[i]), 0),
+                    reason=_reason(i),
                     endpoint=_ep(i),
                     src_identity=_identity(addr),
                     family=family,
@@ -1086,6 +1266,159 @@ class DatapathPipeline:
                         {"outcome": outcome, "device": str(int(d))}, float(n)
                     )
 
+    def _account_attribution(
+        self,
+        verdict: np.ndarray,
+        rule: np.ndarray,
+        l4x: np.ndarray,
+        hits: Optional[np.ndarray],
+        *,
+        ingress: bool,
+    ) -> None:
+        """rule_hits_total / drop_reasons_total accounting for one
+        attributed batch. Post-host-sync by construction (pulled numpy
+        arrays in, no device syncs). ``hits=None`` means padded lanes
+        polluted the device segment-sum — fall back to a host bincount
+        over the (already trimmed) rule array."""
+        names = self._attrib_names
+        if hits is None:
+            matched = rule[rule >= 0]
+            hits = np.bincount(matched, minlength=len(names))
+        direction = "ingress" if ingress else "egress"
+        for r in np.nonzero(hits)[0]:
+            origin = names[r] if r < len(names) else f"rule-{r}"
+            _metrics.rule_hits_total.inc(
+                {"origin": origin, "direction": direction}, float(hits[r])
+            )
+        pol = verdict == DROP_POLICY
+        deny = pol & (rule >= 0)
+        for reason, mask in (
+            ("deny-rule", deny),
+            ("no-l4-match", pol & ~deny & l4x),
+            ("no-l3-match", pol & ~deny & ~l4x),
+            ("prefilter", verdict == DROP_PREFILTER),
+            ("no-service", verdict == DROP_NO_SERVICE),
+        ):
+            n = int(np.count_nonzero(mask))
+            if n:
+                _metrics.drop_reasons_total.inc({"reason": reason}, float(n))
+
+    def _record_flows(
+        self,
+        peer_bytes: np.ndarray,
+        ep_idx: np.ndarray,
+        dports: np.ndarray,
+        protos: np.ndarray,
+        verdict: np.ndarray,
+        rule: np.ndarray,
+        l4x: np.ndarray,
+        redirect: Optional[np.ndarray],
+        *,
+        ingress: bool,
+    ) -> None:
+        """Sampled FlowRecord feed for the flow-log ring: at most
+        SAMPLE_CAP records per batch, drops first (they are the rare,
+        interesting tail), then forwarded flows for the remainder —
+        per-record host cost is bounded regardless of batch size."""
+        ring = self.flow_ring
+        if not ring.active:
+            return
+        import ipaddress as _ipa
+
+        from ..monitor.events import (
+            REASON_NO_SERVICE,
+            REASON_POLICY_DENY,
+            REASON_POLICY_NO_L3,
+            REASON_POLICY_NO_L4,
+            REASON_PREFILTER,
+            REASON_PROXY_REDIRECT,
+            reason_name,
+        )
+        from ..observe.flows import now as _flow_now
+
+        take = list(np.nonzero(verdict >= DROP_POLICY)[0][:_FLOW_SAMPLE_CAP])
+        if len(take) < _FLOW_SAMPLE_CAP:
+            take.extend(
+                np.nonzero(verdict == FORWARD)[0][
+                    : _FLOW_SAMPLE_CAP - len(take)
+                ]
+            )
+        if not take:
+            return
+        origins = self.engine.repo.rule_origins()
+        outcome = dict(_OUTCOME_NAMES)
+        labels_of = self.identity_labels
+        ts = _flow_now()
+        recs = []
+        for i in take:
+            code = int(verdict[i])
+            ri = int(rule[i])
+            if code == DROP_PREFILTER:
+                reason = REASON_PREFILTER
+            elif code == DROP_NO_SERVICE:
+                reason = REASON_NO_SERVICE
+            elif code == DROP_POLICY:
+                if ri >= 0:
+                    reason = REASON_POLICY_DENY
+                elif bool(l4x[i]):
+                    reason = REASON_POLICY_NO_L4
+                else:
+                    reason = REASON_POLICY_NO_L3
+            elif redirect is not None and bool(redirect[i]):
+                reason = REASON_PROXY_REDIRECT
+            else:
+                reason = 0
+            addr = bytes(int(b) & 0xFF for b in peer_bytes[i])
+            peer_ip = str(_ipa.ip_address(addr))
+            e = self.ipcache.lookup_by_ip(peer_ip)
+            peer_ident = 0 if e is None else e.identity
+            idx = int(ep_idx[i])
+            ep_ident = (
+                self._endpoints[idx]
+                if 0 <= idx < len(self._endpoints)
+                else 0
+            )
+
+            def _labels(ident: int) -> Tuple[str, ...]:
+                if labels_of is None:
+                    return ()
+                try:
+                    return tuple(labels_of(ident))
+                except Exception:
+                    return ()
+
+            # flow orientation: ingress = peer → endpoint, egress =
+            # endpoint → peer (the endpoint's own address is not known
+            # to the datapath — only the peer side carries an IP)
+            src_id, dst_id = (
+                (peer_ident, ep_ident) if ingress else (ep_ident, peer_ident)
+            )
+            recs.append(
+                FlowRecord(
+                    ts=ts,
+                    direction="ingress" if ingress else "egress",
+                    src_identity=src_id,
+                    dst_identity=dst_id,
+                    src_labels=_labels(src_id),
+                    dst_labels=_labels(dst_id),
+                    src_ip=peer_ip if ingress else "",
+                    dst_ip="" if ingress else peer_ip,
+                    dport=int(dports[i]),
+                    proto=int(protos[i]),
+                    verdict=code,
+                    verdict_name=outcome.get(code, str(code)),
+                    reason=reason,
+                    reason_name=(
+                        "allowed" if reason == 0 else reason_name(reason)
+                    ),
+                    rule_index=ri,
+                    rule_origin=(
+                        origins[ri] if 0 <= ri < len(origins) else None
+                    ),
+                )
+            )
+        ring.push_many(recs)
+
     @staticmethod
     def _shard_map(spans, ndev: int, b: int) -> np.ndarray:
         """[B] device index per flow: P("flows") splits each padded
@@ -1124,7 +1457,7 @@ class DatapathPipeline:
     def _enqueue_one(
         self, t, peer_bytes, ep_idx, dports, protos, row_override,
         lo, hi, padded, *, family, pf_stage, ep_count, v6_fused,
-        flow_sharding,
+        flow_sharding, rule_tab=None, n_rules=0,
     ):
         """Pad + upload + enqueue ONE chunk; returns the UN-PULLED
         device (verdict, redirect, counters) triple. Under sharding
@@ -1152,10 +1485,14 @@ class DatapathPipeline:
             return process_flows_wide(
                 t, peer, ei, dp, pr, ep_count=ep_count,
                 prefilter=pf_stage, row_override=ro,
+                attrib=rule_tab is not None, rule_tab=rule_tab,
+                n_rules=n_rules,
             )
         return process_flows(
             t, peer, ei, dp, pr, ep_count=ep_count, levels=16,
             prefilter=pf_stage, fused=v6_fused, row_override=ro,
+            attrib=rule_tab is not None, rule_tab=rule_tab,
+            n_rules=n_rules,
         )
 
     def _dispatch_enqueue(
@@ -1177,11 +1514,19 @@ class DatapathPipeline:
         runs after successor batches were enqueued, so device execution
         hides behind their host prep."""
         direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
-        # ONE atomic snapshot read: tables + flags + sharding swap
-        # together in rebuild(), so fused-ness and placement always
-        # match the tables they describe
-        tables_map, pf_empty, v6_fused, flow_sharding, ndev = self._dp_state
+        # ONE atomic snapshot read: tables + flags + sharding +
+        # attribution swap together in rebuild(), so fused-ness,
+        # placement, and the rule table always match the tables they
+        # describe
+        (
+            tables_map, pf_empty, v6_fused, flow_sharding, ndev, attrib_el,
+        ) = self._dp_state
         t = tables_map[(direction, family)]
+        rule_tab = None
+        n_rules = 0
+        if attrib_el is not None:
+            rule_tab = attrib_el[0][direction]
+            n_rules = attrib_el[1]
         b = peer_bytes.shape[0]
         # XDP prefilter guards traffic entering the node only, and an
         # empty deny set skips the walk entirely (it's one of the two
@@ -1198,6 +1543,7 @@ class DatapathPipeline:
                 key = (
                     direction, family, padded, pf_stage, ep_count,
                     row_override is not None, v6_fused, ndev > 1,
+                    rule_tab is not None,
                 )
                 if key in self._seen_shapes:
                     _metrics.jit_shape_buckets_total.inc(
@@ -1228,7 +1574,8 @@ class DatapathPipeline:
                     t, peer_bytes, ep_idx, dports, protos, row_override,
                     lo, hi, padded, family=family, pf_stage=pf_stage,
                     ep_count=ep_count, v6_fused=v6_fused,
-                    flow_sharding=flow_sharding,
+                    flow_sharding=flow_sharding, rule_tab=rule_tab,
+                    n_rules=n_rules,
                 )
                 for lo, hi, padded in spans
             ]
@@ -1236,7 +1583,8 @@ class DatapathPipeline:
             for _lo, _hi, padded in spans:
                 self._warm_buckets.add(padded)
         exact = all(hi - lo == padded for lo, hi, padded in spans)
-        return _Enqueued(chunks, spans, b, exact, ndev)
+        return _Enqueued(chunks, spans, b, exact, ndev,
+                         attrib=rule_tab is not None)
 
     def _dispatch_complete(
         self, enq: _Enqueued, bt=_NOOP_BATCH
@@ -1245,32 +1593,53 @@ class DatapathPipeline:
         device worked through this batch while the host prepared its
         successors, so "host_sync" here measures the RESIDUAL wait.
         Counters come back None when padded lanes polluted the device
-        accumulation (callers fall back to host-side np.add.at)."""
+        accumulation (callers fall back to host-side np.add.at); the
+        attribution rule-hit sums follow the same exact/fallback rule
+        (None → host bincount over the pulled rule array). Attributed
+        dispatches return (verdict, redirect, counters, rule,
+        l4_covered, hits) — the attribution d2h pulls live HERE, in
+        the completion half, so PR 3's enqueue/complete overlap is
+        preserved."""
         if self.tracer.active:
             _metrics.device_transfers_total.inc(
-                {"direction": "d2h"}, 3.0 * len(enq.chunks) * enq.ndev
+                {"direction": "d2h"},
+                (6.0 if enq.attrib else 3.0) * len(enq.chunks) * enq.ndev,
             )
         with bt.phase("host_sync"):
             b = enq.b
+            rule = l4x = hits = None
             if len(enq.chunks) == 1:
-                v, red, c = enq.chunks[0]
-                verdict = np.asarray(v)[:b]
-                redirect = np.asarray(red)[:b]
+                ch = enq.chunks[0]
+                verdict = np.asarray(ch[0])[:b]
+                redirect = np.asarray(ch[1])[:b]
+                if enq.attrib:
+                    rule = np.asarray(ch[3])[:b]
+                    l4x = np.asarray(ch[4])[:b]
             else:
                 verdict = np.empty(b, np.int8)
                 redirect = np.empty(b, bool)
-                for (lo, hi, _padded), (v, red, _c) in zip(
-                    enq.spans, enq.chunks
-                ):
-                    verdict[lo:hi] = np.asarray(v)[: hi - lo]
-                    redirect[lo:hi] = np.asarray(red)[: hi - lo]
+                if enq.attrib:
+                    rule = np.empty(b, np.int32)
+                    l4x = np.empty(b, bool)
+                for (lo, hi, _padded), ch in zip(enq.spans, enq.chunks):
+                    verdict[lo:hi] = np.asarray(ch[0])[: hi - lo]
+                    redirect[lo:hi] = np.asarray(ch[1])[: hi - lo]
+                    if enq.attrib:
+                        rule[lo:hi] = np.asarray(ch[3])[: hi - lo]
+                        l4x[lo:hi] = np.asarray(ch[4])[: hi - lo]
             if enq.exact:
                 counters = np.asarray(enq.chunks[0][2])
-                for _v, _red, c in enq.chunks[1:]:
-                    counters = counters + np.asarray(c)
+                for ch in enq.chunks[1:]:
+                    counters = counters + np.asarray(ch[2])
+                if enq.attrib:
+                    hits = np.asarray(enq.chunks[0][5])
+                    for ch in enq.chunks[1:]:
+                        hits = hits + np.asarray(ch[5])
             else:
                 counters = None
-        return verdict, redirect, counters
+        if not enq.attrib:
+            return verdict, redirect, counters
+        return verdict, redirect, counters, rule, l4x, hits
 
     def _dispatch(
         self,
@@ -1499,7 +1868,11 @@ class DatapathPipeline:
             pending = PendingBatch(self)
 
             def finish():
-                v, red, counters = self._dispatch_complete(enq, bt)
+                out = self._dispatch_complete(enq, bt)
+                v, red, counters = out[:3]
+                rule = l4x = hits = None
+                if enq.attrib:
+                    rule, l4x, hits = out[3:]
                 with bt.phase("counters"):
                     if svc_drop is not None and svc_drop.any():
                         v = v.copy()
@@ -1510,6 +1883,13 @@ class DatapathPipeline:
                         # pre-override — accumulate host-side instead
                         # for this batch
                         counters = None
+                        if rule is not None:
+                            # no-backend flows never reached a rule —
+                            # drop their attribution and re-derive the
+                            # hit sums host-side
+                            rule = rule.copy()
+                            rule[svc_drop] = -1
+                            hits = None
                     if counters is None:
                         with self._lock:
                             if self.counters.shape[0] == max(
@@ -1532,11 +1912,21 @@ class DatapathPipeline:
                             else None
                         ),
                     )
+                    if rule is not None:
+                        self._account_attribution(
+                            v, rule, l4x, hits, ingress=ingress
+                        )
                 with bt.phase("emit_events"):
                     self._emit_flow_events(
                         peer_bytes, ep_idx, dports, protos, v,
                         ingress=ingress, family=family, redirect=red,
+                        rule=rule, l4_covered=l4x,
                     )
+                    if rule is not None:
+                        self._record_flows(
+                            peer_bytes, ep_idx, dports, protos, v,
+                            rule, l4x, red, ingress=ingress,
+                        )
                 if want_rev_nat:
                     # no CT → replies can't be recognized → no restore
                     return v, red, np.zeros(b, np.uint16)
@@ -1604,14 +1994,34 @@ class DatapathPipeline:
         pending = PendingBatch(self)
 
         def finish():
+            rule_full = l4x_full = None
             if enq is not None:
-                v, red, _c = self._dispatch_complete(enq, bt)
+                out = self._dispatch_complete(enq, bt)
+                v, red = out[0], out[1]
+                at_rule = at_l4x = at_hits = None
+                if enq.attrib:
+                    at_rule, at_l4x, at_hits = out[3:]
                 if svc_drop is not None:
                     sd = svc_drop[midx]
                     v = np.where(sd, np.int8(DROP_NO_SERVICE), v)
                     red = red & ~sd
+                    if at_rule is not None and sd.any():
+                        # no-backend flows never reached a rule
+                        at_rule = np.where(sd, np.int32(-1), at_rule)
+                        at_hits = None
                 verdict[midx] = v
                 redirect[midx] = red
+                if at_rule is not None:
+                    # CT-bypassed established flows took no policy
+                    # decision this batch: rule -1, reason "allowed"
+                    # (rule_hits_total counts decisions, not packets)
+                    rule_full = np.full(b, -1, np.int32)
+                    l4x_full = np.zeros(b, bool)
+                    rule_full[midx] = at_rule
+                    l4x_full[midx] = at_l4x
+                    self._account_attribution(
+                        v, at_rule, at_l4x, at_hits, ingress=ingress
+                    )
                 # CT entries for newly-allowed flows (ct_create4,
                 # bpf_lxc.c:~560: only successful verdicts create
                 # state). L7-redirect flows are EXCLUDED: a CT bypass
@@ -1663,7 +2073,13 @@ class DatapathPipeline:
                 self._emit_flow_events(
                     peer_bytes, ep_idx, dports, protos, verdict,
                     ingress=ingress, family=family, redirect=redirect,
+                    rule=rule_full, l4_covered=l4x_full,
                 )
+                if rule_full is not None:
+                    self._record_flows(
+                        peer_bytes, ep_idx, dports, protos, verdict,
+                        rule_full, l4x_full, redirect, ingress=ingress,
+                    )
             if want_rev_nat:
                 # revNAT restore (bpf/lib/lb.h lb4_rev_nat via the CT
                 # entry's rev_nat_index): flows whose CT hit is in the
@@ -1697,8 +2113,9 @@ class DatapathPipeline:
         bt = tr.current() if tr.active else _NOOP_BATCH
         direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
         # same atomic snapshot rule as _dispatch (fused flag must match
-        # the tables it was computed with)
-        tables_map, pf_empty, v6_fused, _fs, _ndev = self._dp_state
+        # the tables it was computed with); the fused CT program is not
+        # attributed — its drops keep the generic policy reason
+        tables_map, pf_empty, v6_fused, _fs, _ndev, _at = self._dp_state
         t = tables_map[(direction, family)]
         b = peer_bytes.shape[0]
         pad = _bucket(b) - b

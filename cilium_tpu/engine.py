@@ -37,11 +37,19 @@ from .compiler import (
     host_selector_matches,
     try_append_rules,
 )
-from .compiler.program import unpack_conjuncts
+from .compiler.program import rule_origin_arrays, unpack_conjuncts
 from .identity import IdentityRegistry
 from .identity.model import MAX_USER_IDENTITY
 from .ops.bitmap import compute_selector_matches
-from .ops.verdict import DevicePolicy, DeviceTables, Verdict, verdict_batch
+from .ops.verdict import (
+    ALLOW,
+    ATTR_NAMES,
+    AttribTables,
+    DevicePolicy,
+    DeviceTables,
+    Verdict,
+    verdict_batch,
+)
 from .policy.repository import Repository
 
 PROTO_TCP = u8proto.TCP
@@ -103,6 +111,9 @@ class PolicyEngine:
         self._delta_log: List[Tuple[int, str, tuple]] = []
         self._bg_refresh: Optional[threading.Thread] = None
         self._install_gen = 0  # bumps on every _install_compiled
+        # (key, {ingress: AttribTables}, n_rules) — rule-origin tables
+        # for verdict attribution, rebuilt when the compile moves
+        self._attrib_cache: Optional[tuple] = None
 
     # ------------------------------------------------------------------
     def _log_delta(self, kind: str, payload: tuple) -> None:
@@ -689,6 +700,51 @@ class PolicyEngine:
             rows[hi] = vals[inv]
         return rows
 
+    # -- verdict attribution (policyd-flows) ---------------------------
+    def attribution(
+        self, ingress: bool = True, expect_revision: Optional[int] = None
+    ):
+        """(AttribTables, n_rules) for the attribution kernel variant,
+        or None when unavailable — a snapshot-restored engine carries no
+        CompileState (no per-rule cell attribution) until its first full
+        recompile lands. Cached per (install_gen, revision): identity
+        churn keeps the cache, any rule movement (append, delete, full
+        rebuild) rebuilds it from the packers' rule_cells refcounts.
+
+        ``expect_revision`` lets a caller that already holds a
+        (compiled, device) snapshot demand tables consistent with it: a
+        rule mutation racing the two reads returns None (the caller's
+        next rebuild re-materializes with matching tables) instead of
+        shape-mismatched origin arrays."""
+        self.refresh()
+        with self._lock:
+            state, c = self._state, self._compiled
+            if state is None or c is None:
+                return None
+            if expect_revision is not None and c.revision != expect_revision:
+                return None
+            key = (self._install_gen, c.revision)
+            cache = self._attrib_cache
+            if cache is None or cache[0] != key:
+                with self.repo._lock:
+                    rules = list(self.repo.rules)
+                keys = [id(r) for r in rules]
+                tabs = {}
+                for ing, packer in (
+                    (True, state.ingress),
+                    (False, state.egress),
+                ):
+                    d, a, k = rule_origin_arrays(packer, keys)
+                    tabs[ing] = AttribTables(
+                        # bounded static unroll (exactly 2 directions),
+                        # control-plane cache build — not per-flow
+                        deny_rule=jnp.asarray(d),  # policyd-lint: disable=TPU002
+                        allow_rule=jnp.asarray(a),  # policyd-lint: disable=TPU002
+                        combo_rule=jnp.asarray(k),  # policyd-lint: disable=TPU002
+                    )
+                cache = self._attrib_cache = (key, tabs, len(rules))
+            return cache[1][ingress], cache[2]
+
     # ------------------------------------------------------------------
     def verdicts(
         self,
@@ -699,9 +755,22 @@ class PolicyEngine:
         *,
         ingress: bool = True,
         has_l4: Optional[Sequence[bool]] = None,
-    ) -> Verdict:
+        attrib: bool = False,
+    ):
         """Batched verdicts by identity number. ``subj`` is the endpoint
-        whose policy applies (dst for ingress, src for egress)."""
+        whose policy applies (dst for ingress, src for egress). With
+        ``attrib=True`` → (Verdict, Attribution, hits[R]); raises
+        RuntimeError when rule-origin tables are unavailable
+        (snapshot-restored engine before its first recompile)."""
+        origin = n_rules = None
+        if attrib:
+            at = self.attribution(ingress)
+            if at is None:
+                raise RuntimeError(
+                    "verdict attribution unavailable: engine has no "
+                    "compile state (snapshot-restored?)"
+                )
+            origin, n_rules = at
         # Snapshot device + row tables under one lock acquisition so a
         # concurrent repo/registry mutation can't mix row indices from a
         # newer compilation into older device tables.
@@ -714,15 +783,50 @@ class PolicyEngine:
         _metrics.verdict_batches.inc({"path": "engine"})
         n = len(subj_ids)
         hl4 = np.ones(n, dtype=bool) if has_l4 is None else np.asarray(has_l4, bool)
-        return verdict_batch(
+        args = (
             device,
             jnp.asarray(self._rows_snapshot(low, high, subj_ids)),
             jnp.asarray(self._rows_snapshot(low, high, peer_ids)),
             jnp.asarray(np.asarray(dports, np.int32)),
             jnp.asarray(np.asarray(protos, np.int32)),
             jnp.asarray(hl4),
-            ingress=ingress,
         )
+        if not attrib:
+            return verdict_batch(*args, ingress=ingress)
+        return verdict_batch(
+            *args, ingress=ingress, attrib=True, origin=origin, n_rules=n_rules
+        )
+
+    def explain_one(
+        self,
+        subj_id: int,
+        peer_id: int,
+        dport: int = 0,
+        proto: int = PROTO_TCP,
+        *,
+        ingress: bool = True,
+        l4: bool = True,
+    ) -> dict:
+        """Replay ONE flow through the verdict kernel with attribution
+        on and name the deciding rule — the `cilium policy trace`-style
+        explain backend."""
+        verdict, at, _hits = self.verdicts(
+            [subj_id], [peer_id], [dport], [proto],
+            ingress=ingress, has_l4=[l4], attrib=True,
+        )
+        rule_idx = int(at.rule[0])
+        reason = int(at.reason[0])
+        origins = self.repo.rule_origins()
+        return {
+            "decision": int(verdict.decision[0]),
+            "allowed": int(verdict.decision[0]) == ALLOW,
+            "l3": int(verdict.l3[0]),
+            "l7_redirect": bool(verdict.l7_redirect[0]),
+            "reason_code": reason,
+            "reason": ATTR_NAMES.get(reason, str(reason)),
+            "rule_index": rule_idx,
+            "rule": origins[rule_idx] if 0 <= rule_idx < len(origins) else None,
+        }
 
     def verdict_one(
         self,

@@ -230,3 +230,18 @@ pipeline_inflight_depth = registry.gauge(
     "Verdict batches enqueued on device but not yet pulled to host "
     "(bounded by VerdictPipelineDepth)",
 )
+
+# -- policyd-flows (verdict attribution) families -------------------------
+rule_hits_total = registry.counter(
+    "cilium_tpu_rule_hits_total",
+    "Verdicts attributed to a repository rule (labels: origin = the "
+    "rule's label set or rule-<index>, direction = ingress|egress; "
+    "only incremented while FlowAttribution is on — the [R] hit tensor "
+    "is segment-summed on device and pulled at batch completion)",
+)
+drop_reasons_total = registry.counter(
+    "cilium_tpu_drop_reasons_total",
+    "Dropped flows by attribution reason (label: reason — the stable "
+    "policyd-flows taxonomy in monitor/events.py; generic codes when "
+    "FlowAttribution is off)",
+)

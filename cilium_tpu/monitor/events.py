@@ -26,11 +26,20 @@ EVENT_CAPTURE = 5  # DebugCapture (datapath_debug.go:368)
 EVENT_TRACE_SUMMARY = 6  # policyd-trace per-batch phase breakdown
 
 # drop reasons (bpf/lib/common.h DROP_* / pkg/monitor/api errors)
-REASON_POLICY = 133  # DROP_POLICY
+REASON_POLICY = 133  # DROP_POLICY (generic / attribution off)
 REASON_PREFILTER = 144  # prefilter deny (XDP)
 REASON_NO_SERVICE = 146  # lb4_local: frontend without backends
 REASON_CT_MAP_FULL = 135
 REASON_UNKNOWN = 0
+# policyd-flows attribution taxonomy (FlowAttribution=true): DROP_POLICY
+# refined by WHICH term decided the flow. Codes picked from the unused
+# 150s of the u8 reason space (the codec carries reasons in the u8
+# "sub" field). STABLE API — ROADMAP lists them; renumbering breaks
+# stored flow logs and monitor consumers.
+REASON_POLICY_DENY = 151  # an explicit deny rule matched
+REASON_POLICY_NO_L3 = 152  # no L3 allow covered the peer
+REASON_POLICY_NO_L4 = 153  # L4 coverage existed, peer not allowed
+REASON_PROXY_REDIRECT = 154  # allowed, but diverted to the L7 proxy
 
 _REASON_NAMES = {
     REASON_POLICY: "Policy denied",
@@ -38,6 +47,10 @@ _REASON_NAMES = {
     REASON_NO_SERVICE: "No service backend",
     REASON_CT_MAP_FULL: "CT map insertion failed",
     REASON_UNKNOWN: "Unknown",
+    REASON_POLICY_DENY: "Policy denied (deny rule)",
+    REASON_POLICY_NO_L3: "Policy denied (no L3 allow)",
+    REASON_POLICY_NO_L4: "Policy denied (no L4 allow)",
+    REASON_PROXY_REDIRECT: "Proxy redirect (L7)",
 }
 
 # trace observation points (pkg/monitor/datapath_trace.go TraceTo*)

@@ -5,6 +5,14 @@ design (stdlib only) — the CLI and the analysis tooling import this
 without pulling JAX.
 """
 
+from .flows import FlowRecord, FlowRing, SAMPLE_CAP
 from .tracer import BatchTrace, NOOP_BATCH, Tracer
 
-__all__ = ["BatchTrace", "NOOP_BATCH", "Tracer"]
+__all__ = [
+    "BatchTrace",
+    "FlowRecord",
+    "FlowRing",
+    "NOOP_BATCH",
+    "SAMPLE_CAP",
+    "Tracer",
+]

@@ -68,7 +68,7 @@ def replicate_tables(t: PolicymapTables, sharding=None) -> PolicymapTables:
     return jax.device_put(t, sharding)
 
 
-@functools.partial(jax.jit, static_argnames=("block",))
+@functools.partial(jax.jit, static_argnames=("block", "attrib"))
 def lookup_batch(
     t: PolicymapTables,
     ep_idx: jnp.ndarray,  # [B] int32 local endpoint index
@@ -76,8 +76,21 @@ def lookup_batch(
     dport: jnp.ndarray,  # [B] int32
     proto: jnp.ndarray,  # [B] int32
     block: int = 16384,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """→ (decision[B] int8, redirect[B] bool)."""
+    attrib: bool = False,
+    rule_tab: jnp.ndarray = None,  # [N, C_pad] int32 (attrib only)
+):
+    """→ (decision[B] int8, redirect[B] bool).
+
+    ``attrib=True`` (static; the off path keeps its exact original
+    program — ``rule_tab=None`` contributes no leaves) additionally
+    returns ``(rule[B] int32, l4_exists[B] bool)``: the deciding-rule
+    index gathered from the materializer's per-(row, column) rule table
+    (exact per-peer attribution; -1 = no rule decided), and whether an
+    L4 column covered the flow's (endpoint, port, proto) at all —
+    the no-L4-match vs no-L3-match drop discriminator. Attribution
+    columns prefer the exact L4 column over L3-only, mirroring the
+    bpf lookup order; for drops the same preference points at the
+    column whose sweep recorded the deny rule (or -1 for no-match)."""
     b = ep_idx.shape[0]
     pad = (-b) % block
     w = t.id_bits.shape[1] // 2
@@ -103,9 +116,38 @@ def lookup_batch(
         # so a redirecting L4 hit redirects even when L3 also allows.
         red = (hit & red_bits).any(axis=1)
         dec = jnp.where(allow, jnp.int8(ALLOW), jnp.int8(DENY))
-        return dec, red
+        if not attrib:
+            return dec, red
 
-    dec, red = jax.lax.map(
+        not_l3 = ~t.col_is_l3[None, :]
+        l4sel = colsel & not_l3
+        l4_hit = hit & not_l3
+        # attribution column: allowed-L4 > allowed-L3 > covering-L4 >
+        # covering-L3 (the drop fallbacks read the deny rule the sweep
+        # recorded on the column that rejected the flow)
+        col = jnp.where(
+            l4_hit.any(axis=1),
+            jnp.argmax(l4_hit, axis=1),
+            jnp.where(
+                allow,
+                jnp.argmax(hit, axis=1),
+                jnp.where(
+                    l4sel.any(axis=1),
+                    jnp.argmax(l4sel, axis=1),
+                    jnp.where(
+                        colsel.any(axis=1), jnp.argmax(colsel, axis=1), -1
+                    ),
+                ),
+            ),
+        )
+        rule_rows = jnp.take(rule_tab, src, axis=0)  # [b, C_pad]
+        rule_at = jnp.take_along_axis(
+            rule_rows, jnp.clip(col, 0, None)[:, None], axis=1
+        )[:, 0]
+        rule = jnp.where(col >= 0, rule_at, jnp.int32(-1))
+        return dec, red, rule, l4sel.any(axis=1)
+
+    out = jax.lax.map(
         one, (pad1(ep_idx, -1), pad1(dport), pad1(proto), pad1(src_rows))
     )
-    return dec.reshape(-1)[:b], red.reshape(-1)[:b]
+    return tuple(x.reshape(-1)[:b] for x in out)

@@ -24,10 +24,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from typing import Optional
+
 from ..compiler.program import CompiledPolicy, PROTO_TCP_N
 from .bitmap import pack_bool_bits
 from .lookup import PolicymapTables
-from .verdict import ALLOW, DevicePolicy, verdict_batch
+from .verdict import ALLOW, AttribTables, DevicePolicy, verdict_batch
 
 TRAFFIC_INGRESS = 0
 TRAFFIC_EGRESS = 1
@@ -91,6 +93,14 @@ class MaterializedState:
     allow_nc: np.ndarray  # [N, C_pad] bool (host, mutable)
     red_nc: np.ndarray  # [N, C_pad] bool
     n_cols: int
+    # Verdict attribution (policyd-flows): per-(identity row, column)
+    # deciding-rule index from an attrib=True sweep — EXACT per-peer
+    # attribution for the pipeline's lookup path (-1 = no rule; deny
+    # drops carry the deny rule even though their allow bit is 0).
+    # None when the sweep ran without attribution (FlowAttribution off
+    # or snapshot-restored compile with no rule-origin state).
+    rule_nc: Optional[np.ndarray] = None  # [N, C_pad] int32 (host)
+    rule_tab: Optional[jnp.ndarray] = None  # [N, C_pad] int32 (device)
 
 
 def materialize_endpoints(
@@ -150,6 +160,47 @@ def _sweep_device(
     return allow, l3a, red
 
 
+@functools.partial(
+    jax.jit, static_argnames=("n", "ingress", "block", "n_rules")
+)
+def _sweep_device_attrib(
+    policy: DevicePolicy,
+    seg_row: jnp.ndarray,
+    seg_port: jnp.ndarray,
+    seg_proto: jnp.ndarray,
+    seg_l4: jnp.ndarray,
+    origin: AttribTables,
+    n: int,
+    ingress: bool,
+    block: int,
+    n_rules: int,
+):
+    """_sweep_device plus the attribution tail: also returns the
+    [n_seg, n] int32 deciding-rule index per (segment, identity row) —
+    the source of MaterializedState.rule_tab. A SEPARATE jitted entry
+    so the attribution-off sweep keeps its exact original program."""
+    n_seg = seg_row.shape[0]
+    subj = jnp.repeat(seg_row, n)
+    peer = jnp.tile(jnp.arange(n, dtype=jnp.int32), n_seg)
+    v, at, _hits = verdict_batch(
+        policy,
+        subj,
+        peer,
+        jnp.repeat(seg_port, n),
+        jnp.repeat(seg_proto, n),
+        jnp.repeat(seg_l4, n),
+        ingress=ingress,
+        block=block,
+        attrib=True,
+        origin=origin,
+        n_rules=n_rules,
+    )
+    allow = pack_bool_bits((v.decision == ALLOW).reshape(n_seg, n))
+    l3a = pack_bool_bits((v.l3 == 1).reshape(n_seg, n))
+    red = pack_bool_bits(v.l7_redirect.reshape(n_seg, n))
+    return allow, l3a, red, at.rule.reshape(n_seg, n)
+
+
 def _unpack_rows(words: np.ndarray, n: int) -> np.ndarray:
     """[n_seg, ceil(n/32)] uint32 → [n_seg, n] bool (pack_bool_bits
     inverse, host-side)."""
@@ -169,7 +220,15 @@ def materialize_endpoints_state(
     *,
     ingress: bool = True,
     block: int = 8192,
+    attrib_origin: Optional[AttribTables] = None,
+    n_rules: int = 0,
 ) -> MaterializedState:
+    """``attrib_origin`` (with ``n_rules``) switches the sweep to the
+    attribution kernel variant: the result additionally carries
+    rule_nc/rule_tab, the exact per-(identity row, column) deciding-rule
+    index the pipeline's lookup path gathers from under
+    FlowAttribution. Off (None), the sweep and its jit program are
+    untouched."""
     n = compiled.id_bits.shape[0]
     ep_rows = compiled.rows_for(endpoint_identity_ids)
     sel_match_host = np.asarray(device.sel_match)
@@ -207,6 +266,7 @@ def materialize_endpoints_state(
     aw_parts: List[np.ndarray] = []
     l3_parts: List[np.ndarray] = []
     rw_parts: List[np.ndarray] = []
+    rl_parts: List[np.ndarray] = []
     sr = np.asarray(seg_row, np.int32)
     sp = np.asarray(seg_port, np.int32)
     spr = np.asarray(seg_proto, np.int32)
@@ -214,8 +274,7 @@ def materialize_endpoints_state(
     for lo in range(0, n_seg, seg_chunk):
         hi = min(lo + seg_chunk, n_seg)
         pad = min(_seg_bucket(hi - lo), seg_chunk) - (hi - lo)
-        aw, l3w, rw = _sweep_device(
-            device,
+        chunk = (
             # control-plane rebuild: VRAM-bounded chunking over the
             # segment sweep — a handful of large device calls, not a
             # per-flow dispatch loop (the serving path never runs this)
@@ -223,10 +282,16 @@ def materialize_endpoints_state(
             jnp.asarray(np.pad(sp[lo:hi], (0, pad))),
             jnp.asarray(np.pad(spr[lo:hi], (0, pad))),
             jnp.asarray(np.pad(sl[lo:hi], (0, pad))),
-            n,
-            ingress,
-            block,
         )
+        if attrib_origin is None:
+            aw, l3w, rw = _sweep_device(device, *chunk, n, ingress, block)
+        else:
+            aw, l3w, rw, rl = _sweep_device_attrib(
+                device, *chunk, attrib_origin, n, ingress, block, n_rules
+            )
+            # control-plane rebuild pull, same cadence as the aw/l3w
+            # pulls below (baselined) — never on the serving path
+            rl_parts.append(np.asarray(rl)[: hi - lo])  # policyd-lint: disable=TPU001
         aw_parts.append(np.asarray(aw)[: hi - lo])
         l3_parts.append(np.asarray(l3w)[: hi - lo])
         rw_parts.append(np.asarray(rw)[: hi - lo])
@@ -236,6 +301,11 @@ def materialize_endpoints_state(
         red_sn = _unpack_rows(np.concatenate(rw_parts), n)
     else:  # zero endpoints: nothing to sweep
         allow_sn = l3_sn = red_sn = np.zeros((0, n), bool)
+    rule_sn = (
+        np.concatenate(rl_parts)
+        if rl_parts
+        else np.full((n_seg, n), -1, np.int32)
+    )
 
     # Column layout: one column per (endpoint, L3) + (endpoint, slot).
     col_ep: List[int] = []
@@ -244,11 +314,13 @@ def materialize_endpoints_state(
     col_is_l3: List[bool] = []
     col_allow: List[np.ndarray] = []
     col_red: List[np.ndarray] = []
+    col_rule: List[np.ndarray] = []
     snapshots: List[EndpointPolicySnapshot] = []
 
     seg = 0
     for e, row in enumerate(ep_rows):
         l3_allow = l3_sn[seg] & live
+        col_rule.append(rule_sn[seg])
         seg += 1
         col_ep.append(e)
         col_port.append(0)
@@ -262,6 +334,7 @@ def materialize_endpoints_state(
         for port, proto_n in ep_slots[e]:
             allow = allow_sn[seg] & live
             redirect = red_sn[seg] & live
+            col_rule.append(rule_sn[seg])
             seg += 1
             col_ep.append(e)
             col_port.append(port)
@@ -282,9 +355,14 @@ def materialize_endpoints_state(
     pad = c_pad - c
     allow_nc = np.zeros((n, c_pad), bool)
     red_nc = np.zeros((n, c_pad), bool)
+    rule_nc = None
+    if attrib_origin is not None:
+        rule_nc = np.full((n, c_pad), -1, np.int32)
     if c:
         allow_nc[:, :c] = np.stack(col_allow, axis=1)
         red_nc[:, :c] = np.stack(col_red, axis=1)
+        if rule_nc is not None:
+            rule_nc[:, :c] = np.stack(col_rule, axis=1)
 
     tables = PolicymapTables(
         col_ep=jnp.asarray(np.pad(np.asarray(col_ep, np.int32), (0, pad), constant_values=-1)),
@@ -307,6 +385,8 @@ def materialize_endpoints_state(
         allow_nc=allow_nc,
         red_nc=red_nc,
         n_cols=c,
+        rule_nc=rule_nc,
+        rule_tab=jnp.asarray(rule_nc) if rule_nc is not None else None,
     )
 
 
@@ -381,6 +461,8 @@ def patch_identity_rows(
     row_events: Sequence[Tuple[int, int, bool]],
     *,
     block: int = 8192,
+    attrib_origin: Optional[AttribTables] = None,
+    n_rules: int = 0,
 ) -> None:
     """Apply identity-churn row updates to a materialized policymap.
 
@@ -388,7 +470,12 @@ def patch_identity_rows(
     out; live rows get a fresh verdict sweep over every column segment
     of every endpoint — n_seg × k flows instead of the full n_seg × N
     re-materialization. Snapshots (host policymap dicts) are patched in
-    place, so fastpath caches holding references see the update."""
+    place, so fastpath caches holding references see the update.
+
+    When the state carries attribution (rule_nc/rule_tab) the patch
+    sweep runs the attrib kernel variant too (pass ``attrib_origin``/
+    ``n_rules`` from the engine); without an origin the patched rows'
+    rule entries degrade to -1 (unattributed) rather than going stale."""
     if not row_events:
         return
     direction = TRAFFIC_INGRESS if state.ingress else TRAFFIC_EGRESS
@@ -432,16 +519,29 @@ def patch_identity_rows(
         n_seg = len(seg_subj)
         k = len(live_rows)
         peer = np.tile(np.asarray(live_rows, np.int32), n_seg)
-        v = verdict_batch(
+        sweep_args = (
             device,
             jnp.asarray(np.repeat(np.asarray(seg_subj, np.int32), k)),
             jnp.asarray(peer),
             jnp.asarray(np.repeat(np.asarray(seg_port, np.int32), k)),
             jnp.asarray(np.repeat(np.asarray(seg_proto, np.int32), k)),
             jnp.asarray(np.repeat(np.asarray(seg_l4, bool), k)),
-            ingress=state.ingress,
-            block=block,
         )
+        rl = None
+        if state.rule_nc is not None and attrib_origin is not None:
+            v, at, _hits = verdict_batch(
+                *sweep_args,
+                ingress=state.ingress,
+                block=block,
+                attrib=True,
+                origin=attrib_origin,
+                n_rules=n_rules,
+            )
+            # patch-path pull, same cadence as the dec/l3d/red pulls
+            # below (baselined) — control plane, never per-flow
+            rl = np.asarray(at.rule).reshape(n_seg, k)  # policyd-lint: disable=TPU001
+        else:
+            v = verdict_batch(*sweep_args, ingress=state.ingress, block=block)
         dec = np.asarray(v.decision).reshape(n_seg, k)
         l3d = np.asarray(v.l3).reshape(n_seg, k)
         red = np.asarray(v.l7_redirect).reshape(n_seg, k)
@@ -449,6 +549,8 @@ def patch_identity_rows(
     for r in rows:
         state.allow_nc[r] = False
         state.red_nc[r] = False
+        if state.rule_nc is not None:
+            state.rule_nc[r] = -1
 
     if live_rows:
         row_pos = {r: i for i, r in enumerate(live_rows)}
@@ -468,6 +570,8 @@ def patch_identity_rows(
                 i = row_pos[r]
                 allowed = l3_allow[i]
                 state.allow_nc[r, ci] = allowed
+                if rl is not None:
+                    state.rule_nc[r, ci] = rl[seg_i, i]
                 if allowed:
                     ident = final[r][0]
                     snap.entries[PolicyKey(ident, 0, 0, direction)] = 0
@@ -480,6 +584,8 @@ def patch_identity_rows(
                     redir = bool(red[seg_i, i])
                     state.allow_nc[r, ci] = allowed
                     state.red_nc[r, ci] = allowed and redir
+                    if rl is not None:
+                        state.rule_nc[r, ci] = rl[seg_i, i]
                     if allowed and (not l3_allow[i] or redir):
                         ident = final[r][0]
                         snap.entries[PolicyKey(ident, port, proto, direction)] = int(redir)
@@ -493,6 +599,10 @@ def patch_identity_rows(
         state.tables.id_bits, jnp.asarray(idx), jnp.asarray(comb_rows)
     )
     state.tables = state.tables.replace(id_bits=new_bits)
+    if state.rule_nc is not None and state.rule_tab is not None:
+        state.rule_tab = _patch_bitmap_rows(
+            state.rule_tab, jnp.asarray(idx), jnp.asarray(state.rule_nc[idx])
+        )
 
 
 def _pack_rows(rows_bool: np.ndarray) -> np.ndarray:

@@ -37,6 +37,53 @@ from .bitmap import unpack_bits_u32
 ALLOW = int(Decision.ALLOWED)
 DENY = int(Decision.DENIED)
 
+# -- verdict attribution (policyd-flows) ---------------------------------
+# Per-flow attribution reason codes emitted by the attrib=True kernel
+# variant. These classify WHICH term decided the flow; the pipeline maps
+# them onto the monitor's DropNotify reason taxonomy
+# (monitor/events.py REASON_POLICY_*).
+ATTR_ALLOW = 0  # allowed (rule = the first-match allowing rule)
+ATTR_DENY_RULE = 1  # an explicit deny (FromRequires) rule matched
+ATTR_NO_L3 = 2  # dropped: no L3 allow covered the peer
+ATTR_NO_L4 = 3  # dropped: L4 coverage existed, peer not allowed
+ATTR_L7 = 4  # allowed via a parser-bearing filter (proxy redirect)
+
+ATTR_NAMES = {
+    ATTR_ALLOW: "allowed",
+    ATTR_DENY_RULE: "deny-rule",
+    ATTR_NO_L3: "no-l3-match",
+    ATTR_NO_L4: "no-l4-match",
+    ATTR_L7: "l7-redirect",
+}
+
+# Sentinel for "no rule contributes to this term" in the origin arrays
+# (min-reduction identity; converted to -1 in the per-flow output).
+NO_RULE = 2**31 - 1
+
+
+@chex.dataclass(frozen=True)
+class AttribTables:
+    """Term→rule origin arrays for the attribution kernel variant:
+    the FIRST (lowest-index) repository rule contributing each deny
+    subject-selector, pure-L3-allow subject-selector, and L4 combo —
+    first-contributing-rule-wins mirrors the reference's in-order rule
+    walk. Entries with no contributing rule hold ``NO_RULE``. Built by
+    ``compiler.program.build_attrib_tables``."""
+
+    deny_rule: jnp.ndarray  # [S] int32
+    allow_rule: jnp.ndarray  # [S] int32
+    combo_rule: jnp.ndarray  # [K1] int32
+
+
+@chex.dataclass(frozen=True)
+class Attribution:
+    """Per-flow attribution (attrib=True only). ``rule``: repository
+    rule index that decided the flow (-1 = no rule — a no-match drop).
+    ``reason``: ATTR_* code."""
+
+    rule: jnp.ndarray  # [B] int32
+    reason: jnp.ndarray  # [B] int8
+
 
 @chex.dataclass(frozen=True)
 class Verdict:
@@ -119,13 +166,16 @@ def _verdict_block(
     dport: jnp.ndarray,
     proto: jnp.ndarray,
     has_l4: jnp.ndarray,
-) -> Verdict:
+    origin: "AttribTables" = None,
+):
     subj8 = unpack_bits_u32(jnp.take(sel_match, subj_rows, axis=0))  # [b, S]
     peer8 = unpack_bits_u32(jnp.take(sel_match, peer_rows, axis=0))
     subj_b = subj8.astype(bool)
 
-    deny = (subj_b & _mm(jnp.int8(1) - peer8, t.deny_t)).any(axis=1)
-    l3_allow = (subj_b & _mm(peer8, t.allow_t)).any(axis=1)
+    deny_vec = subj_b & _mm(jnp.int8(1) - peer8, t.deny_t)  # [b, S]
+    allow_vec = subj_b & _mm(peer8, t.allow_t)  # [b, S]
+    deny = deny_vec.any(axis=1)
+    l3_allow = allow_vec.any(axis=1)
     req_ok = ~deny
 
     pp = (
@@ -135,9 +185,9 @@ def _verdict_block(
     ).astype(jnp.int8)
 
     combo = _mm(subj8, t.s1_mat) & _mm(pp, t.p1_mat)  # [b, K1]
-    l4_allow = (combo & _mm(peer8, t.en_t)).any(axis=1) | (
-        req_ok & (combo & _mm(peer8, t.ee_t)).any(axis=1)
-    )
+    en_hit = combo & _mm(peer8, t.en_t)  # [b, K1]
+    ee_hit = combo & _mm(peer8, t.ee_t)  # [b, K1]
+    l4_allow = en_hit.any(axis=1) | (req_ok & ee_hit.any(axis=1))
 
     group_ok = (
         _mm(peer8, t.gpn_mat)
@@ -161,10 +211,58 @@ def _verdict_block(
     # allowed at L4 through a parser-bearing filter redirects even when
     # L3 also allows it.
     l7_redirect = has_l4 & l4_allow & l7_present
-    return Verdict(decision=decision, l3=l3, l7_redirect=l7_redirect)
+    verdict = Verdict(decision=decision, l3=l3, l7_redirect=l7_redirect)
+    if origin is None:
+        return verdict
+
+    # -- attribution (policyd-flows): first-match rule + reason ----------
+    # Masked min over the pre-reduction term vectors picks the LOWEST
+    # repository rule index whose cell fired — the reference's in-order
+    # rule walk stops at the first decider. All [b, S]/[b, K1] operands
+    # already exist above; this adds three where+min reductions and a
+    # select chain, no extra matmuls or gathers.
+    def _first(mask, rule_of):
+        return jnp.min(
+            jnp.where(mask, rule_of[None, :], jnp.int32(NO_RULE)), axis=1
+        )
+
+    deny_rule = _first(deny_vec, origin.deny_rule)
+    allow_rule = _first(allow_vec, origin.allow_rule)
+    combo_fired = en_hit | (req_ok[:, None] & ee_hit)  # [b, K1]
+    l4_rule = _first(combo_fired, origin.combo_rule)
+
+    # Attribute by what actually DECIDED: pure-L3 allow wins over the
+    # L4 path (repository walk order); a deny only decides when the
+    # flow really dropped (an en-side L4 entry can allow past a deny).
+    allowed = decision == jnp.int8(ALLOW)
+    l3_decides = l3_allow & ~deny
+    rule = jnp.where(
+        allowed,
+        jnp.where(l3_decides, allow_rule, l4_rule),
+        jnp.where(deny, deny_rule, jnp.int32(NO_RULE)),
+    )
+    rule = jnp.where(rule == NO_RULE, jnp.int32(-1), rule)
+
+    # Drop refinement: with L4 context and any combo covering the
+    # subject at this port, the peer was the missing half (no-L4);
+    # otherwise nothing covered the flow at all (no-L3).
+    l4_covered = has_l4 & combo.any(axis=1)
+    dropped = decision == jnp.int8(DENY)
+    reason = jnp.where(
+        dropped,
+        jnp.where(
+            deny,
+            jnp.int8(ATTR_DENY_RULE),
+            jnp.where(l4_covered, jnp.int8(ATTR_NO_L4), jnp.int8(ATTR_NO_L3)),
+        ),
+        jnp.where(l7_redirect, jnp.int8(ATTR_L7), jnp.int8(ATTR_ALLOW)),
+    )
+    return verdict, Attribution(rule=rule, reason=reason)
 
 
-@functools.partial(jax.jit, static_argnames=("ingress", "block"))
+@functools.partial(
+    jax.jit, static_argnames=("ingress", "block", "attrib", "n_rules")
+)
 def verdict_batch(
     policy: DevicePolicy,
     subj_rows: jnp.ndarray,  # [B] int32 identity rows
@@ -174,9 +272,20 @@ def verdict_batch(
     has_l4: jnp.ndarray,  # [B] bool — False = pure-L3 query
     ingress: bool = True,
     block: int = 8192,
-) -> Verdict:
+    attrib: bool = False,
+    origin: AttribTables = None,
+    n_rules: int = 0,
+):
     """Batch verdicts; blocks the batch with lax.map to bound the
-    [block, S] activation footprint."""
+    [block, S] activation footprint.
+
+    With ``attrib=False`` (default) this traces exactly the program it
+    always has — ``origin=None`` contributes no leaves to the jaxpr and
+    the attribution tail is never staged. With ``attrib=True`` (static,
+    so the off path keeps its own executable) returns
+    ``(Verdict, Attribution, hits)`` where ``hits`` is the [n_rules]
+    int32 per-rule hit counter, segment-summed on device so the host
+    pulls R scalars instead of B."""
     t = policy.ingress if ingress else policy.egress
     b = subj_rows.shape[0]
     pad = (-b) % block
@@ -186,6 +295,18 @@ def verdict_batch(
 
     args = (pad1(subj_rows), pad1(peer_rows), pad1(dport), pad1(proto), pad1(has_l4))
     out = jax.lax.map(
-        lambda xs: _verdict_block(policy.sel_match, t, *xs), args
+        lambda xs: _verdict_block(
+            policy.sel_match, t, *xs, origin=origin if attrib else None
+        ),
+        args,
     )
-    return jax.tree_util.tree_map(lambda x: x.reshape(-1)[:b], out)
+    out = jax.tree_util.tree_map(lambda x: x.reshape(-1)[:b], out)
+    if not attrib:
+        return out
+    verdict, attribution = out
+    valid = attribution.rule >= 0
+    idx = jnp.clip(attribution.rule, 0, max(n_rules - 1, 0))
+    hits = jnp.zeros((max(n_rules, 1),), jnp.int32).at[idx].add(
+        valid.astype(jnp.int32)
+    )[:n_rules]
+    return verdict, attribution, hits

@@ -102,6 +102,12 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
             "Flow-sharded verdict dispatch across jax.devices() "
             "(tables replicated, batches split; needs >1 device)",
         ),
+        OptionSpec(
+            "FlowAttribution",
+            "On-device verdict attribution (policyd-flows): matched-rule "
+            "index, drop-reason codes, per-rule hit counters, and the "
+            "sampled flow-log ring",
+        ),
     )
 }
 

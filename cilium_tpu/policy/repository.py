@@ -220,6 +220,35 @@ class Repository:
             matched = [r for r in self.rules if r.endpoint_selector.matches(labels)]
         return matched, bool(matched)
 
+    def rule_origins(self) -> List[dict]:
+        """Stable rule-origin table for verdict attribution
+        (policyd-flows): one entry per rule IN REPOSITORY ORDER, so a
+        matched-rule index from the device kernel maps back to the rule
+        a human can recognize. The index is only stable for a fixed
+        (revision) — consumers pair it with ``revision`` and re-fetch
+        when the repository moves."""
+        with self._lock:
+            return [
+                {
+                    "index": i,
+                    "labels": list(r.labels.to_strings()),
+                    "description": getattr(r, "description", "") or "",
+                }
+                for i, r in enumerate(self.rules)
+            ]
+
+    def origin_names(self) -> List[str]:
+        """Compact per-rule origin strings (metrics label values for
+        ``rule_hits_total{origin=...}``): the rule's first label, else
+        its description, else ``rule-<index>``."""
+        with self._lock:
+            out = []
+            for i, r in enumerate(self.rules):
+                labels = list(r.labels.to_strings())
+                desc = getattr(r, "description", "") or ""
+                out.append(labels[0] if labels else (desc or f"rule-{i}"))
+            return out
+
     def __len__(self) -> int:
         return len(self.rules)
 

@@ -1,0 +1,230 @@
+"""policyd-flows: attribution must be a pure observer.
+
+The FlowAttribution program adds a rule-origin tail to the verdict
+kernel, an [R] hit segment-sum, and a wider completion pull — but it
+must never change a verdict, a counter, or (when off) the compiled
+program. These tests pin all three, plus the explain path's agreement
+with the batch kernel on fuzzed worlds (reusing the policygen
+generators) and the metric/ring count invariants.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from __graft_entry__ import _build_datapath_world, _make_ip_flows
+from test_policygen_fuzz import World
+
+from cilium_tpu import metrics as M
+from cilium_tpu.datapath.conntrack import FlowConntrack
+from cilium_tpu.datapath.pipeline import (
+    DROP_POLICY,
+    DROP_PREFILTER,
+    FORWARD,
+    DatapathPipeline,
+)
+from cilium_tpu.ops.lpm import ip_strings_to_u32
+
+
+def _batches(idents, k: int, b: int, seed0: int):
+    return [_make_ip_flows(idents, b, seed=seed0 + i) for i in range(k)]
+
+
+def _fam_total(fam) -> float:
+    return float(sum(fam._values.values()))
+
+
+class TestOnOffBitIdentical:
+    def test_plain_pipeline(self):
+        """Same seed, same batches: attribution ON tracks OFF verdict-,
+        redirect-, and counter-exactly (no-CT, depth 1)."""
+        pipe_off, _, idents = _build_datapath_world(seed=3)
+        pipe_on, _, _ = _build_datapath_world(seed=3)
+        pipe_on.set_attribution(True)
+        batches = _batches(idents, 3, 384, seed0=40)
+        for p, e, d, pr in batches:
+            v0, r0 = pipe_off.process(p, e, d, pr)
+            v1, r1 = pipe_on.process(p, e, d, pr)
+            np.testing.assert_array_equal(v0, v1)
+            np.testing.assert_array_equal(r0, r1)
+        np.testing.assert_array_equal(pipe_off.counters, pipe_on.counters)
+
+    def test_sharded_and_pipelined(self):
+        """VerdictSharding on the 8-device test mesh + depth-2 submit
+        with a conntrack attached: the widest program variant must
+        still match the plain synchronous one flow-for-flow."""
+        _, engine, idents = _build_datapath_world(seed=5)
+        base, _, _ = _build_datapath_world(seed=5)
+
+        wide = DatapathPipeline(
+            engine, base.ipcache, base.prefilter,
+            conntrack=FlowConntrack(capacity_bits=12), pipeline_depth=2,
+        )
+        wide.set_endpoints([i.id for i in idents[:4]])
+        wide.set_sharding(True)
+        wide.set_attribution(True)
+        wide.rebuild()
+
+        plain = DatapathPipeline(
+            engine, base.ipcache, base.prefilter,
+            conntrack=FlowConntrack(capacity_bits=12), pipeline_depth=1,
+        )
+        plain.set_endpoints([i.id for i in idents[:4]])
+        plain.rebuild()
+
+        rng = np.random.default_rng(7)
+        batches = _batches(idents, 4, 512, seed0=60)
+        # replay the first batch so the CT-hit path runs attributed too
+        batches.append(batches[0])
+        sports = [rng.integers(1024, 4096, 512).astype(np.int32)
+                  for _ in batches]
+        sports[-1] = sports[0]
+
+        pend = [wide.submit(p, e, d, pr, sports=s)
+                for (p, e, d, pr), s in zip(batches, sports)]
+        got = [pb.result() for pb in pend]
+        for (p, e, d, pr), s, (v1, r1) in zip(batches, sports, got):
+            v0, r0 = plain.process(p, e, d, pr, sports=s)
+            np.testing.assert_array_equal(v0, v1)
+            np.testing.assert_array_equal(r0, r1)
+        assert wide.flow_ring.recorded > 0
+
+    def test_toggle_off_restores_parity(self):
+        pipe, _, idents = _build_datapath_world(seed=3)
+        ref, _, _ = _build_datapath_world(seed=3)
+        batches = _batches(idents, 2, 256, seed0=80)
+        pipe.set_attribution(True)
+        pipe.set_attribution(False)
+        for p, e, d, pr in batches:
+            v0, r0 = ref.process(p, e, d, pr)
+            v1, r1 = pipe.process(p, e, d, pr)
+            np.testing.assert_array_equal(v0, v1)
+            np.testing.assert_array_equal(r0, r1)
+        assert not pipe.flow_ring.active
+
+
+class TestCountInvariants:
+    def test_rule_hits_and_drop_reasons_account_every_verdict(self):
+        """Per policyd-flows semantics: every flow whose verdict was
+        decided by a repository rule increments rule_hits_total exactly
+        once, and every policy/prefilter drop lands in exactly one
+        drop_reasons_total reason. Graft worlds carry no deny rules, so
+        rule hits == forwarded flows."""
+        hits0 = _fam_total(M.rule_hits_total)
+        drops0 = _fam_total(M.drop_reasons_total)
+        pipe, _, idents = _build_datapath_world(seed=3)
+        pipe.set_attribution(True)
+        n_fwd = n_drop = 0
+        for p, e, d, pr in _batches(idents, 3, 512, seed0=40):
+            v, _r = pipe.process(p, e, d, pr)
+            n_fwd += int((v == FORWARD).sum())
+            n_drop += int(
+                ((v == DROP_POLICY) | (v == DROP_PREFILTER)).sum()
+            )
+        assert _fam_total(M.rule_hits_total) - hits0 == n_fwd
+        assert _fam_total(M.drop_reasons_total) - drops0 == n_drop
+
+    def test_ring_records_agree_with_verdicts(self):
+        pipe, _, idents = _build_datapath_world(seed=3)
+        pipe.set_attribution(True)
+        p, e, d, pr = _make_ip_flows(idents, 512, seed=40)
+        v, _r = pipe.process(p, e, d, pr)
+        recs = pipe.flow_ring.query(limit=None)
+        assert recs
+        for f in recs:
+            # each sampled record must restate its batch verdict
+            assert f["verdict_name"].startswith(
+                "forwarded" if f["verdict"] == FORWARD else "dropped"
+            )
+            if f["verdict"] == FORWARD:
+                assert f["rule_index"] >= 0
+                assert f["rule_origin"] is not None
+            elif f["verdict"] == DROP_POLICY:
+                assert f["reason"] in (151, 152, 153)
+        n_drops_rec = sum(
+            1 for f in recs if f["verdict_name"].startswith("dropped")
+        )
+        n_drops = int((v != FORWARD).sum())
+        # drops are sampled first: all of them land until the cap
+        assert n_drops_rec == min(n_drops, 64)
+
+
+class TestExplainParity:
+    @pytest.mark.parametrize("seed", [11, 23, 59])
+    def test_explain_matches_batch_verdict(self, seed):
+        """engine.explain_one on each fuzzed flow must agree with the
+        batched pipeline verdict for that same flow, and its reason
+        must come from the stable taxonomy."""
+        w = World(seed)
+        flows = [
+            f for f in w.random_flows(120)
+            if f[1] is not None and not w.pf_denied(f[2], f[5])
+        ]
+        for direction in (True, False):
+            batch = [f for f in flows if f[5] == direction]
+            if not batch:
+                continue
+            ips = ip_strings_to_u32([f[2] for f in batch])
+            eps = np.array([f[0] for f in batch], np.int32)
+            dports = np.array([f[3] for f in batch], np.int32)
+            protos = np.array([f[4] for f in batch], np.int32)
+            v, red = w.pipe.process(
+                ips, eps, dports, protos, ingress=direction
+            )
+            for i, (ep_i, peer, _ip, port, proto, ing) in enumerate(batch):
+                ex = w.engine.explain_one(
+                    w.ep_idents[ep_i].id, peer.id, port, proto,
+                    ingress=ing, l4=True,
+                )
+                assert ex["allowed"] == (int(v[i]) == FORWARD), (
+                    f"explain={ex} batch verdict={int(v[i])} flow={batch[i]}"
+                )
+                assert ex["l7_redirect"] == bool(red[i])
+                if ex["allowed"]:
+                    assert ex["rule_index"] >= 0
+                    assert ex["rule"] is not None
+                    assert ex["reason"] == (
+                        "l7-redirect" if ex["l7_redirect"] else "allowed"
+                    )
+                else:
+                    assert ex["reason"] in (
+                        "deny-rule", "no-l3-match", "no-l4-match"
+                    )
+
+
+class TestOffPathProgram:
+    def test_off_path_phase_set_unchanged(self):
+        """A pipeline that had attribution toggled on and back off must
+        trace the exact same phase set as one that never attributed —
+        the off path runs the program shipped before policyd-flows."""
+        a, idents = self._ct_world(seed=3)
+        b, _ = self._ct_world(seed=3)
+        b.set_attribution(True)
+        b.set_attribution(False)
+        a.tracer.enable()
+        b.tracer.enable()
+        batches = _batches(idents, 2, 256, seed0=40)
+        for p, e, d, pr in batches:
+            va, _ = a.process(p, e, d, pr)
+            vb, _ = b.process(p, e, d, pr)
+            np.testing.assert_array_equal(va, vb)
+        names_a = {
+            ph[0] for t in a.tracer.traces() for ph in t["phases"]
+        }
+        names_b = {
+            ph[0] for t in b.tracer.traces() for ph in t["phases"]
+        }
+        assert names_a == names_b
+        assert not any("attrib" in n for n in names_b)
+
+    @staticmethod
+    def _ct_world(seed: int):
+        pipe, engine, idents = _build_datapath_world(seed=seed)
+        ct_pipe = DatapathPipeline(
+            engine, pipe.ipcache, pipe.prefilter,
+            conntrack=FlowConntrack(capacity_bits=12),
+        )
+        ct_pipe.set_endpoints([i.id for i in idents[:4]])
+        ct_pipe.rebuild()
+        return ct_pipe, idents

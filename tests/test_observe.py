@@ -243,6 +243,7 @@ class TestSurfaces:
                            "capacity": d.pipeline.tracer.capacity,
                            "pipeline_depth": d.pipeline.pipeline_depth,
                            "in_flight": 0,
+                           "flow_attribution": False,
                            "traces": []}
             d.config_patch({"PhaseTracing": True})
             assert d.pipeline.tracer.active
