@@ -650,6 +650,158 @@ def _bench_flows(
     return k * b / t_off, k * b / t_on, overhead
 
 
+def _bench_tune(repo, reg, idents, nrng: np.random.Generator, attached):
+    """``--tune``: policyd-autotune round → result dict for the
+    one-line JSON. Three measurements on the N_RULES world:
+
+    - depth sweep 1..verdict-pipeline-max-depth: pipelined vps and the
+      achieved overlap_ratio per depth (the PR 3 methodology with the
+      depth held fixed), plus the smallest depth within 3% of the best
+      vps as ``sweep_optimal_depth`` (ties go shallow — extra depth
+      past saturation only ages batches);
+    - controller convergence: the same pipeline reset to depth 1 with
+      DispatchAutoTune on (short epochs), fed until the tuner rests —
+      its depth lands within ±1 of the sweep optimum;
+    - pad waste: CT-miss tails of awkward sizes (1100/3000/5000
+      flows) through the bucket ladder, reported as pad/(live+pad)
+      from dispatch_pad_lanes_total next to what the single-4096-
+      bucket scheme pads for the same tails."""
+    from cilium_tpu import metrics as _m
+    from cilium_tpu.datapath.conntrack import FlowConntrack
+    from cilium_tpu.datapath.pipeline import (
+        TRAFFIC_INGRESS,
+        DatapathPipeline,
+        process_flows_wide,
+    )
+    from cilium_tpu.engine import PolicyEngine
+    from cilium_tpu.ipcache.ipcache import IPCache
+    from cilium_tpu.ipcache.prefilter import PreFilter
+    from cilium_tpu.option import get_config
+
+    eng = PolicyEngine(repo, reg)
+    cache = IPCache()
+    for i, ident in enumerate(idents):
+        cache.upsert(
+            f"10.{(i >> 8) & 255}.{i & 255}.1/32", ident.id, source="k8s"
+        )
+
+    def make_batch(b):
+        i_sel = nrng.integers(0, len(idents), b)
+        ips = (
+            np.uint32(10) << 24
+            | ((i_sel >> 8) & 255).astype(np.uint32) << 16
+            | (i_sel & 255).astype(np.uint32) << 8
+            | 1
+        ).astype(np.uint32)
+        eps = nrng.integers(0, N_ENDPOINTS, b).astype(np.int32)
+        dports = nrng.choice(np.array([80, 443, 8080, 53, 22], np.int32), b)
+        protos = np.where(dports == 53, 17, 6).astype(np.int32)
+        return ips, eps, dports, protos
+
+    max_depth = get_config().verdict_pipeline_max_depth
+    pipe = DatapathPipeline(
+        eng, cache, PreFilter(), conntrack=None,
+        pipeline_depth=1, pipeline_max_depth=max_depth,
+    )
+    pipe.set_endpoints([idents[j].id for j in range(N_ENDPOINTS)])
+    b, k = 1 << 16, 8
+    batches = [make_batch(b) for _ in range(k)]
+    pipe.process(*batches[0])  # warm the jit cache + tables
+
+    # pure device execution for the same K batches — the denominator
+    # of overlap_ratio (what there is to hide)
+    t = pipe._tables[(TRAFFIC_INGRESS, 4)]
+    staged = [tuple(jnp.asarray(a) for a in bt) for bt in batches]
+    pf_stage = not pipe._pf_empty[0]
+    v, _red, _c = process_flows_wide(
+        t, *staged[0], ep_count=N_ENDPOINTS, prefilter=pf_stage,
+        row_override=None,
+    )
+    jax.block_until_ready(v)
+    t0 = time.time()
+    for d_ in staged:
+        v, _red, _c = process_flows_wide(
+            t, *d_, ep_count=N_ENDPOINTS, prefilter=pf_stage,
+            row_override=None,
+        )
+    jax.block_until_ready(v)
+    t_dev = time.time() - t0
+
+    per_depth = {}
+    t_sync = None
+    for depth in range(1, max_depth + 1):
+        attached.stage(f"tune-sweep:d{depth}")
+        pipe.pipeline_depth = depth
+        for p in [pipe.submit(*bt) for bt in batches]:  # settle
+            p.result()
+        t0 = time.time()
+        for p in [pipe.submit(*bt) for bt in batches]:
+            p.result()
+        td = time.time() - t0
+        if depth == 1:
+            t_sync = td
+        hidden = max(0.0, t_sync - td)
+        per_depth[depth] = {
+            "vps": round(k * b / td),
+            "overlap_ratio": round(
+                min(1.0, hidden / t_dev) if t_dev > 0 else 0.0, 3
+            ),
+        }
+    best = max(s["vps"] for s in per_depth.values())
+    sweep_optimal = min(
+        d for d, s in per_depth.items() if s["vps"] >= best * 0.97
+    )
+
+    # controller convergence from a cold depth-1 start (short epochs
+    # so ~16 decision points fit in the round)
+    attached.stage("tune-converge")
+    pipe.pipeline_depth = 1
+    pipe.set_autotune(True, max_depth=max_depth, epoch=4)
+    small = [make_batch(1 << 14) for _ in range(8)]
+    for _ in range(8):
+        for p in [pipe.submit(*bt) for bt in small]:
+            p.result()
+    converged = pipe.pipeline_depth
+    snap = pipe.autotune_state()
+    pipe.set_autotune(False)
+
+    # bucket-ladder pad waste on CT-miss tails (the ISSUE's 1100-flow
+    # example padded to 4096 under the single-bucket scheme)
+    attached.stage("tune-padwaste")
+    ct_pipe = DatapathPipeline(
+        eng, cache, PreFilter(),
+        conntrack=FlowConntrack(capacity_bits=12), pipeline_depth=2,
+    )
+    ct_pipe.set_endpoints([idents[j].id for j in range(N_ENDPOINTS)])
+    pad0 = _m.dispatch_pad_lanes_total.get({"family": "v4"})
+    tails = (1100, 3000, 5000)
+    live = 0
+    for n in tails:
+        # each new rung shape compiles the fused CT program — heartbeat
+        # per tail so a slow compile is distinguishable from a wedge
+        attached.stage(f"tune-padwaste:{n}")
+        bt = make_batch(n)
+        ct_pipe.process(
+            *bt, sports=nrng.integers(1024, 60000, n).astype(np.int32)
+        )
+        live += n
+    ct_pipe.drain()
+    pad = _m.dispatch_pad_lanes_total.get({"family": "v4"}) - pad0
+    single = sum(-(-n // 4096) * 4096 for n in tails)
+    return {
+        "per_depth": {str(d): s for d, s in per_depth.items()},
+        "sweep_optimal_depth": sweep_optimal,
+        "converged_depth": converged,
+        "converged_within_one": abs(converged - sweep_optimal) <= 1,
+        "autotune_adjustments": snap["adjustments"],
+        "pad_lanes": int(pad),
+        "pad_waste_pct": round(pad / (live + pad) * 100.0, 2),
+        "pad_waste_pct_single_bucket": round(
+            (single - live) / single * 100.0, 2
+        ),
+    }
+
+
 def _bench_native_e2e(snaps, idents, nrng: np.random.Generator):
     """The native front-end's FULL per-node pipeline (conntrack probe →
     identity LPM → policymap, bpf_lxc.c end to end) — (mixed_vps,
@@ -1158,6 +1310,24 @@ def main() -> None:
             "flows_off_vps": round(off_vps),
             "flows_on_vps": round(on_vps),
             "pipeline_depth": 2,
+            "backend": backend,
+            "build_s": round(t_build, 2),
+        }))
+        return
+
+    if "--tune" in sys.argv[1:]:
+        # policyd-autotune round: depth sweep vs controller convergence
+        # + bucket-ladder pad waste — the round driver diffs
+        # converged_depth/pad_waste_pct across PRs
+        out = _bench_tune(
+            repo, reg, idents, np.random.default_rng(23), attached
+        )
+        attached.set()
+        print(json.dumps({
+            "metric": f"autotune converged pipeline depth at {N_RULES} rules",
+            "value": out["converged_depth"],
+            "unit": "depth",
+            **out,
             "backend": backend,
             "build_s": round(t_build, 2),
         }))

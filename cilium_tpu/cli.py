@@ -1073,6 +1073,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                     # not 3) — name it so waterfalls read honestly
                     print("flow attribution is ON: host_sync includes "
                           "rule/reason/hit-counter pulls")
+                at = out.get("autotune")
+                if at:
+                    # depth moved between these traces' batches —
+                    # waterfalls are NOT like-for-like comparable
+                    # without this context (observe/README.md)
+                    adj = at.get("adjustments", {})
+                    print(
+                        f"auto-tune is ON: depth {at.get('depth')} in "
+                        f"[{at.get('min_depth')}, {at.get('max_depth')}], "
+                        f"{adj.get('up', 0)} up / {adj.get('down', 0)} "
+                        f"down step(s)"
+                    )
                 print()
             for t in out.get("traces", ()):
                 print(render_waterfall(

@@ -35,6 +35,7 @@ from .maps.proxymap import ProxyMap
 from .maps.routes import RouteTable
 from .maps.tunnel import TunnelMap
 from .mtu import MTUConfig
+from .observe.flows import FlowRing
 from .utils.iputil import prefix_lengths_of
 from .utils.logging import get_logger
 from .utils.prefix_counter import PrefixLengthCounter
@@ -103,6 +104,8 @@ class Daemon:
             monitor=self.monitor,
             pipeline_depth=cfg.verdict_pipeline_depth,
             sharding=cfg.verdict_sharding,
+            flow_ring=FlowRing(capacity=cfg.flow_ring_capacity),
+            pipeline_max_depth=cfg.verdict_pipeline_max_depth,
         )
         # ONE controller registry for the whole daemon (pkg/controller;
         # `cilium status --all-controllers` reads it) — the endpoint
@@ -756,6 +759,7 @@ class Daemon:
         {
             "Conntrack", "TraceNotification", "DropNotification", "Debug",
             "PhaseTracing", "VerdictSharding", "FlowAttribution",
+            "DispatchAutoTune",
         }
     )
 
@@ -789,6 +793,10 @@ class Daemon:
             # the verdict program recompiles with the origin tail on
             # the next rebuild, the off path keeps today's program
             self.pipeline.set_attribution(value)
+        elif name == "DispatchAutoTune":
+            # policyd-autotune: adaptive pipeline depth; off restores
+            # the static configured depth
+            self.pipeline.set_autotune(value)
         elif name == "Debug":
             import logging as _logging
 
@@ -1015,6 +1023,11 @@ class Daemon:
             # policyd-flows: attribution changes what the host_sync
             # phase pulls (6 arrays, not 3) — trace readers should know
             "flow_attribution": self.pipeline.flow_ring.active,
+            # policyd-autotune: None while DispatchAutoTune is off;
+            # otherwise the tuner snapshot (bounds, per-depth EWMA
+            # stats, adjustment counts) — waterfalls read under a
+            # moving depth need this context (observe/README.md)
+            "autotune": self.pipeline.autotune_state(),
             "traces": tr.traces(limit),
         }
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -63,6 +64,7 @@ from ..ops.materialize import (
 )
 from ..lb.device import flow_hash32, lb_translate
 from .conntrack import CT_NEW, FlowConntrack, pack_keys
+from .tuner import DepthTuner
 
 FORWARD = 1
 DROP_POLICY = 2
@@ -483,6 +485,53 @@ def _bucket_multiple(n: int, ndev: int, floor: int = 1024) -> int:
     return b + ((-b) % ndev)
 
 
+# policyd-autotune bucket ladder: the ONLY padded shapes the bucketed
+# (CT-miss tail) dispatch path ever compiles. Fixed — not derived from
+# traffic — so the jit shape-bucket count is bounded by construction at
+# len(BUCKET_LADDER) per static-arg combination, and a rung warmed by
+# any batch stays reusable by every later batch. STABLE CONTRACT
+# (ROADMAP): changing the rungs invalidates every warm compiled
+# program and the staging-pool sizing.
+BUCKET_LADDER = (1024, 2048, 4096, 8192)
+
+
+def _ladder_rungs(ndev: int, ladder: Tuple[int, ...] = BUCKET_LADDER):
+    """Ladder rungs rounded up to mesh-device multiples (same reason as
+    _bucket_multiple: P("flows") must split each chunk evenly)."""
+    if ndev <= 1:
+        return ladder
+    return tuple(r + ((-r) % ndev) for r in ladder)
+
+
+@functools.lru_cache(maxsize=512)
+def _tail_cover(m: int, rungs: Tuple[int, ...]) -> Tuple[int, int, Tuple[int, ...]]:
+    """Minimum-padded-lane rung cover of an m-flow tail (m ≤ top rung
+    after full-top-rung stripping): returns (lanes, chunks, plan) with
+    the plan sorted largest-first so only the final chunk carries pad.
+    Lanes are minimized first, chunk count second (each chunk is one
+    h2d + enqueue), and on full ties the larger leading rung wins —
+    e.g. an 1100-flow tail covers with one 2048 chunk, not 1024+1024,
+    and a 3000-flow tail with 2048+1024 (3072 lanes) instead of one
+    4096 chunk (the single-warm-bucket scheme's ~37% extra pad).
+    Exact, not greedy: ndev-rounded rungs are not mutual multiples, so
+    greedy largest-fit can strand a worse tail. Depth is bounded by
+    top/floor (≤ 8 recursions)."""
+    best = None
+    for r in reversed(rungs):  # largest first → wins full ties
+        if r >= m:
+            cand = (r, 1, (r,))
+        else:
+            lanes, chunks, plan = _tail_cover(m - r, rungs)
+            cand = (
+                r + lanes,
+                chunks + 1,
+                tuple(sorted((r,) + plan, reverse=True)),
+            )
+        if best is None or (cand[0], cand[1]) < (best[0], best[1]):
+            best = cand
+    return best
+
+
 class PendingBatch:
     """Handle for one batch accepted by ``DatapathPipeline.submit()``.
     Batches complete strictly FIFO; ``result()`` blocks until this
@@ -516,12 +565,18 @@ class _InFlight:
     when the batch COMPLETES. ``finish=None`` marks a batch that ran
     synchronously (the donated-state device-CT path)."""
 
-    __slots__ = ("pending", "finish", "bt")
+    __slots__ = ("pending", "finish", "bt", "enq_ns", "occ", "b")
 
     def __init__(self, pending: PendingBatch, finish, bt) -> None:
         self.pending = pending
         self.finish = finish
         self.bt = bt
+        # depth-tuner observations (populated only while DispatchAutoTune
+        # is on): enqueue-half wall ns, queue occupancy at admission,
+        # batch size. enq_ns == 0 marks "not observed".
+        self.enq_ns = 0
+        self.occ = 0
+        self.b = 0
 
 
 class _Enqueued:
@@ -531,15 +586,20 @@ class _Enqueued:
     ``exact`` marks device counters (and rule-hit sums) usable as-is
     (no padded lanes polluted them)."""
 
-    __slots__ = ("chunks", "spans", "b", "exact", "ndev", "attrib")
+    __slots__ = ("chunks", "spans", "b", "exact", "ndev", "attrib", "staging")
 
-    def __init__(self, chunks, spans, b, exact, ndev, attrib=False) -> None:
+    def __init__(
+        self, chunks, spans, b, exact, ndev, attrib=False, staging=()
+    ) -> None:
         self.chunks = chunks
         self.spans = spans
         self.b = b
         self.exact = exact
         self.ndev = ndev
         self.attrib = attrib
+        # staging tuples pinned under this dispatch's device inputs;
+        # released back to the pipeline's pool at the host pull
+        self.staging = staging
 
 
 class DatapathPipeline:
@@ -561,6 +621,8 @@ class DatapathPipeline:
         pipeline_depth: int = 2,
         sharding: bool = False,
         flow_ring: Optional[FlowRing] = None,
+        pipeline_max_depth: int = 4,
+        autotune: bool = False,
     ) -> None:
         self.engine = engine
         self.ipcache = ipcache
@@ -660,11 +722,31 @@ class DatapathPipeline:
         # its in-flight window) cannot create entries verdicted under
         # the old basis
         self._ct_epoch = 0
-        # shape buckets already dispatched: the chunker splits a batch
-        # larger than the largest warm bucket into full warm-bucket
-        # dispatches (overlapped by the queue) instead of padding to
-        # the next power of two (~2x waste just past 2^k)
+        # ladder rungs already dispatched (telemetry: the chunker's
+        # shape set is the fixed BUCKET_LADDER; a rung joins this set
+        # the first time a batch actually compiles/warms it)
         self._warm_buckets: set = set()
+        # -- policyd-autotune: pre-pinned staging + depth tuner --------
+        # (rung, peer_width) → free-list of rung-sized int32 host
+        # staging tuples (peer_bytes, ep_idx, dports, protos, row_ov).
+        # The bucketed pad half writes into these instead of np.pad
+        # allocations per chunk. A tuple leaves the list at enqueue and
+        # returns at the batch's host pull — never earlier: JAX CPU can
+        # alias aligned numpy memory zero-copy, so reuse before
+        # completion could race the device program's reads.
+        self._staging: Dict[Tuple[int, int], list] = {}
+        self._staging_lock = threading.Lock()
+        # depth auto-tuner (DispatchAutoTune): OFF by default — the
+        # dispatch path then pays one `self._tuner is None` read per
+        # batch and pipeline_depth never moves (static-depth behavior
+        # preserved exactly). _static_depth is what set_autotune(False)
+        # restores.
+        self._static_depth = self.pipeline_depth
+        self.pipeline_max_depth = max(self.pipeline_depth, int(pipeline_max_depth))
+        self._tuner: Optional[DepthTuner] = None
+        if autotune:
+            self.set_autotune(True)
+        _metrics.pipeline_depth_current.set(float(self.pipeline_depth))
         # -- multi-device flow sharding (VerdictSharding) -------------
         # active mesh → tables replicated, flow batches split over the
         # "flows" axis. The dispatch-visible sharding rides _dp_state
@@ -764,6 +846,95 @@ class DatapathPipeline:
         self.flow_ring.active = bool(on)
         self._seen_shapes.clear()
         self._warm_buckets.clear()
+
+    # -- policyd-autotune: depth controller ----------------------------
+    def set_autotune(
+        self,
+        on: bool,
+        *,
+        max_depth: Optional[int] = None,
+        epoch: Optional[int] = None,
+    ) -> None:
+        """Toggle the dispatch depth auto-tuner (the DispatchAutoTune
+        runtime option). ON installs a fresh DepthTuner stepping
+        pipeline_depth in [1, pipeline_max_depth] from per-batch
+        enqueue/complete timings; OFF restores the configured static
+        depth and drops the tuner (the per-batch cost returns to one
+        ``self._tuner is None`` read). ``epoch`` shrinks the decision
+        interval for tests/bench convergence runs."""
+        if max_depth is not None:
+            self.pipeline_max_depth = max(1, int(max_depth))
+        if not on:
+            if self._tuner is not None:
+                self._tuner = None
+                self._apply_depth(self._static_depth)
+            return
+        kw = {} if epoch is None else {"epoch": int(epoch)}
+        self._tuner = DepthTuner(1, self.pipeline_max_depth, **kw)
+        _metrics.pipeline_depth_current.set(float(self.pipeline_depth))
+
+    def _apply_depth(self, depth: int) -> None:
+        """Move the effective pipeline depth (tuner decisions and
+        autotune-off restore). Reads of pipeline_depth on the admission
+        path are GIL-atomic, so a step takes effect on the very next
+        submit — a deeper queue admits immediately, a shallower one
+        drains through the existing over-depth completion loop."""
+        depth = max(
+            1, min(int(depth), max(self.pipeline_max_depth, self._static_depth))
+        )
+        cur = self.pipeline_depth
+        if depth == cur:
+            return
+        self.pipeline_depth = depth
+        _metrics.pipeline_depth_current.set(float(depth))
+        _metrics.autotune_adjustments_total.inc(
+            {"direction": "up" if depth > cur else "down"}
+        )
+
+    def autotune_state(self) -> Optional[Dict]:
+        """Tuner snapshot for GET /traces (None while autotune is
+        off)."""
+        t = self._tuner
+        if t is None:
+            return None
+        snap = t.snapshot()
+        snap["depth"] = self.pipeline_depth
+        snap["static_depth"] = self._static_depth
+        return snap
+
+    # -- policyd-autotune: pre-pinned staging --------------------------
+    # free-list bound per (rung, width): deeper queues keep more tuples
+    # in flight, but depth × chunks stays small — beyond this the
+    # allocations were a burst, not steady state, so let them collect
+    _STAGING_FREE_CAP = 8
+
+    def _staging_acquire(self, rung: int, width: int):
+        """One rung-sized staging tuple (peer[rung, width], ep, dp, pr,
+        row_override — all int32, matching what prepare() coerces), off
+        the free-list or freshly allocated on first use of a rung."""
+        key = (rung, width)
+        with self._staging_lock:
+            free = self._staging.get(key)
+            if free:
+                return free.pop()
+        return (
+            np.empty((rung, width), np.int32),
+            np.empty(rung, np.int32),
+            np.empty(rung, np.int32),
+            np.empty(rung, np.int32),
+            np.empty(rung, np.int32),
+        )
+
+    def _staging_release(self, bufs_list) -> None:
+        """Return a completed batch's staging tuples to their
+        free-lists (called from the host-pull half only — see the
+        aliasing note at _staging)."""
+        for bufs in bufs_list:
+            key = (bufs[0].shape[0], bufs[0].shape[1])
+            with self._staging_lock:
+                free = self._staging.setdefault(key, [])
+                if len(free) < self._STAGING_FREE_CAP:
+                    free.append(bufs)
 
     def _refresh_mesh_locked(self) -> None:
         """Form/drop the verdict mesh to match the sharding request
@@ -1436,40 +1607,70 @@ class DatapathPipeline:
         Unbucketed (the no-CT full-batch path) keeps the exact shape —
         padded lanes would pollute the device-side counters — except
         under sharding, where the batch must split evenly across the
-        mesh. Bucketed spans (the CT-miss tail) reuse warm compiled
-        shapes: a batch larger than the largest warm bucket dispatches
-        as full warm-bucket chunks plus one bucketed tail (each chunk
-        its own overlapped enqueue) instead of padding to the next
-        power of two, which wastes ~2x just past 2^k."""
+        mesh. Bucketed spans (the CT-miss tail) come off the fixed
+        BUCKET_LADDER (ndev-rounded): full top-rung chunks first (zero
+        pad, each its own overlapped enqueue), then the exact
+        minimum-padded-lane rung cover of what remains (_tail_cover) —
+        so the padded shape set stays ≤ len(BUCKET_LADDER) per
+        static-arg combination while tail pad drops versus both the
+        old largest-warm-bucket reuse (a 3000-flow tail dispatched as
+        3×1024, now 2048+1024) and a single-bucket pad (1100 flows pad
+        to 2048, not 4096)."""
         if not bucketed:
             return [(0, n, n + ((-n) % ndev) if ndev > 1 else n)]
-        w = max(self._warm_buckets, default=1024)
-        if n <= w:
-            return [(0, n, _bucket_multiple(n, ndev))]
+        rungs = _ladder_rungs(ndev)
+        top = rungs[-1]
         spans = []
         lo = 0
-        while n - lo > w:
-            spans.append((lo, lo + w, w))
-            lo += w
-        spans.append((lo, n, _bucket_multiple(n - lo, ndev)))
+        while n - lo > top:
+            spans.append((lo, lo + top, top))
+            lo += top
+        _lanes, _chunks, plan = _tail_cover(n - lo, rungs)
+        for r in plan:  # largest-first: only the final chunk has pad
+            live = min(r, n - lo)
+            spans.append((lo, lo + live, r))
+            lo += live
         return spans
 
     def _enqueue_one(
         self, t, peer_bytes, ep_idx, dports, protos, row_override,
         lo, hi, padded, *, family, pf_stage, ep_count, v6_fused,
-        flow_sharding, rule_tab=None, n_rules=0,
+        flow_sharding, rule_tab=None, n_rules=0, staging=None,
     ):
         """Pad + upload + enqueue ONE chunk; returns the UN-PULLED
         device (verdict, redirect, counters) triple. Under sharding
         the flow arrays are committed split over the mesh's "flows"
-        axis (the tests/test_multichip.py pattern) before the call."""
+        axis (the tests/test_multichip.py pattern) before the call.
+        ``staging`` (bucketed dispatches only) collects the pre-pinned
+        rung buffers the pad half wrote into, for release at the host
+        pull; padded rungs then cost four memcpys instead of four
+        np.pad allocations."""
         pb = peer_bytes[lo:hi]
         ei = ep_idx[lo:hi]
         dp = dports[lo:hi]
         pr = protos[lo:hi]
         ro = None if row_override is None else row_override[lo:hi]
         pad = padded - (hi - lo)
-        if pad:
+        if pad and staging is not None:
+            bufs = self._staging_acquire(padded, peer_bytes.shape[1])
+            spb, sei, sdp, spr, sro = bufs
+            m = hi - lo
+            spb[:m] = pb
+            spb[m:] = 0
+            sei[:m] = ei
+            sei[m:] = 0
+            sdp[:m] = dp
+            sdp[m:] = 0
+            spr[:m] = pr
+            spr[m:] = 0
+            pb, ei, dp, pr = spb, sei, sdp, spr
+            if ro is not None:
+                # padded lanes must derive-by-LPM, never trust (-1)
+                sro[:m] = ro
+                sro[m:] = -1
+                ro = sro
+            staging.append(bufs)
+        elif pad:
             pb, ei, dp, pr, ro = _pad_flows(pad, pb, ei, dp, pr,
                                             row_override=ro)
         peer = _pack_v4_u32(pb) if family == 4 else pb
@@ -1534,6 +1735,14 @@ class DatapathPipeline:
         pf_stage = ingress and not pf_empty[0 if family == 4 else 1]
         ep_count = max(1, len(self._endpoints))
         spans = self._chunk_spans(b, bucketed=bucketed, ndev=ndev)
+        # pad-lane accounting on EVERY dispatch path (bucketed rung pad
+        # and the unbucketed sharded ndev-rounding alike) — bench.py
+        # derives pad_waste_pct as pad / (live + pad)
+        pad_lanes = sum(p for _, _, p in spans) - b
+        if pad_lanes:
+            _metrics.dispatch_pad_lanes_total.inc(
+                {"family": f"v{family}"}, float(pad_lanes)
+            )
         tr = self.tracer
         if tr.active:
             # shape-bucket telemetry: the jit cache keys on padded
@@ -1568,6 +1777,7 @@ class DatapathPipeline:
         # counter matmul trace as one jit — splitting them into
         # separate spans would de-fuse the program); the actual device
         # execution time aggregates into "host_sync" at completion.
+        staging = [] if bucketed else None
         with bt.phase("dispatch"):
             chunks = [
                 self._enqueue_one(
@@ -1575,7 +1785,7 @@ class DatapathPipeline:
                     lo, hi, padded, family=family, pf_stage=pf_stage,
                     ep_count=ep_count, v6_fused=v6_fused,
                     flow_sharding=flow_sharding, rule_tab=rule_tab,
-                    n_rules=n_rules,
+                    n_rules=n_rules, staging=staging,
                 )
                 for lo, hi, padded in spans
             ]
@@ -1584,7 +1794,8 @@ class DatapathPipeline:
                 self._warm_buckets.add(padded)
         exact = all(hi - lo == padded for lo, hi, padded in spans)
         return _Enqueued(chunks, spans, b, exact, ndev,
-                         attrib=rule_tab is not None)
+                         attrib=rule_tab is not None,
+                         staging=staging or ())
 
     def _dispatch_complete(
         self, enq: _Enqueued, bt=_NOOP_BATCH
@@ -1637,6 +1848,12 @@ class DatapathPipeline:
                         hits = hits + np.asarray(ch[5])
             else:
                 counters = None
+        if enq.staging:
+            # the host pull above proves the device program finished —
+            # only now are the pinned buffers safe to hand to the next
+            # batch (JAX CPU zero-copy aliasing)
+            self._staging_release(enq.staging)
+            enq.staging = ()
         if not enq.attrib:
             return verdict, redirect, counters
         return verdict, redirect, counters, rule, l4x, hits
@@ -1675,6 +1892,14 @@ class DatapathPipeline:
                 return False
             inf = self._inflight.popleft()
             _metrics.pipeline_inflight_depth.set(float(len(self._inflight)))
+        # completion-half timing (p99 verdict-latency proxy): observed
+        # only for batches admitted while the tuner was on
+        tuner = self._tuner
+        t0 = (
+            time.perf_counter_ns()
+            if tuner is not None and inf.enq_ns
+            else 0
+        )
         try:
             inf.pending._value = inf.finish()
         except BaseException as e:
@@ -1683,6 +1908,13 @@ class DatapathPipeline:
             inf.pending._event.set()
             if inf.bt is not _NOOP_BATCH:
                 inf.bt.end(self.monitor)
+        if t0:
+            new_depth = tuner.observe(
+                self.pipeline_depth, inf.b, inf.enq_ns,
+                time.perf_counter_ns() - t0, inf.occ,
+            )
+            if new_depth is not None:
+                self._apply_depth(new_depth)
         return True
 
     def _complete_until(self, pending: PendingBatch) -> None:
@@ -1726,6 +1958,11 @@ class DatapathPipeline:
         prepared — and admission beyond pipeline_depth completes the
         oldest batch first (the bounded in-flight queue)."""
         tr = self.tracer
+        # tuner timing: the enqueue half is everything up to queue
+        # admission (prepare + CT pre-pass + h2d + async enqueue) —
+        # captured only while DispatchAutoTune is on
+        tuner = self._tuner
+        t0 = time.perf_counter_ns() if tuner is not None else 0
         if tr.active:
             bt = tr.begin(
                 f"v{family}-{'ingress' if ingress else 'egress'}",
@@ -1753,6 +1990,10 @@ class DatapathPipeline:
             return inf.pending
         with self._queue_lock:
             self._inflight.append(inf)
+            if tuner is not None:
+                inf.enq_ns = time.perf_counter_ns() - t0
+                inf.occ = len(self._inflight)
+                inf.b = peer_bytes.shape[0]
             _metrics.pipeline_inflight_depth.set(float(len(self._inflight)))
             over = len(self._inflight) > self.pipeline_depth
         while over:

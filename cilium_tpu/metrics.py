@@ -231,6 +231,25 @@ pipeline_inflight_depth = registry.gauge(
     "(bounded by VerdictPipelineDepth)",
 )
 
+# -- policyd-autotune (adaptive dispatch) families -------------------------
+dispatch_pad_lanes_total = registry.counter(
+    "cilium_tpu_dispatch_pad_lanes_total",
+    "Device lanes dispatched as shape-bucket padding, not live flows "
+    "(label: family — divide by live+pad for the pad-waste fraction; "
+    "counted on every dispatch path, bucketed or not)",
+)
+pipeline_depth_current = registry.gauge(
+    "cilium_tpu_pipeline_depth_current",
+    "Effective verdict pipeline depth right now (moves between 1 and "
+    "VerdictPipelineMaxDepth while DispatchAutoTune is on; otherwise "
+    "the static configured depth)",
+)
+autotune_adjustments_total = registry.counter(
+    "cilium_tpu_autotune_adjustments_total",
+    "Depth steps taken by the dispatch auto-tuner "
+    "(label direction: up|down)",
+)
+
 # -- policyd-flows (verdict attribution) families -------------------------
 rule_hits_total = registry.counter(
     "cilium_tpu_rule_hits_total",

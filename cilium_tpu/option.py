@@ -35,10 +35,19 @@ class DaemonConfig:
     # blocks pulling the oldest: depth 1 = fully synchronous, depth 2
     # overlaps host prep of batch N+1 with device execution of batch N.
     verdict_pipeline_depth: int = 2
+    # Ceiling for the DispatchAutoTune depth controller (policyd-
+    # autotune): while the runtime option is on, the effective depth
+    # moves in [1, verdict_pipeline_max_depth]; off keeps the static
+    # verdict_pipeline_depth. Part of the stable tuner contract
+    # (ROADMAP).
+    verdict_pipeline_max_depth: int = 4
     # Boot-time value of the VerdictSharding runtime option (flow
     # batches split across jax.devices(), tables replicated). Only
     # takes effect with >1 visible device.
     verdict_sharding: bool = False
+    # Capacity of the sampled flow-log ring (observe/flows.py) serving
+    # GET /flows while FlowAttribution is on.
+    flow_ring_capacity: int = 1024
 
     def validate(self) -> None:
         if self.enforcement_mode not in ("default", "always", "never"):
@@ -49,6 +58,13 @@ class DaemonConfig:
             raise ValueError("invalid proxy port range")
         if not 1 <= self.verdict_pipeline_depth <= 64:
             raise ValueError("verdict-pipeline-depth must be 1-64")
+        if not self.verdict_pipeline_depth <= self.verdict_pipeline_max_depth <= 64:
+            raise ValueError(
+                "verdict-pipeline-max-depth must be in "
+                "[verdict-pipeline-depth, 64]"
+            )
+        if self.flow_ring_capacity < 1:
+            raise ValueError("flow-ring-capacity must be >= 1")
 
 
 _config = DaemonConfig()
@@ -107,6 +123,13 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
             "On-device verdict attribution (policyd-flows): matched-rule "
             "index, drop-reason codes, per-rule hit counters, and the "
             "sampled flow-log ring",
+        ),
+        OptionSpec(
+            "DispatchAutoTune",
+            "Adaptive verdict pipeline depth (policyd-autotune): an EWMA "
+            "controller steps the in-flight bound between 1 and "
+            "verdict-pipeline-max-depth from per-batch enqueue/complete "
+            "timings; off keeps the static configured depth",
         ),
     )
 }

@@ -1,0 +1,418 @@
+"""policyd-autotune: bucket-ladder chunking, the depth auto-tuner, and
+pre-pinned staging. The load-bearing guarantees:
+
+- the bucketed chunker's padded shapes come ONLY from the fixed
+  BUCKET_LADDER (jit shape set bounded by construction) and pad
+  strictly fewer lanes than the single-warm-bucket scheme on awkward
+  CT-miss tails;
+- DispatchAutoTune OFF is bit-identical to the static-depth pipeline
+  (verdicts, counters, compiled shape keys, phase names) — including
+  the VerdictSharding + CT replay + FlowAttribution combination;
+- the DepthTuner converges near the optimum on synthetic timings,
+  respects its bounds, and does not oscillate;
+- staging buffers recycle across batches without leaking pad garbage
+  into verdicts.
+
+Runs on the virtual 8-device CPU mesh from conftest.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from __graft_entry__ import _build_datapath_world, _make_ip_flows
+
+from cilium_tpu import metrics
+from cilium_tpu.datapath.conntrack import FlowConntrack
+from cilium_tpu.datapath.pipeline import (
+    BUCKET_LADDER,
+    DatapathPipeline,
+    _ladder_rungs,
+    _tail_cover,
+)
+from cilium_tpu.datapath.tuner import DepthTuner
+
+# the policyd-trace stable phase-name contract (observe/README.md)
+STABLE_PHASES = {
+    "rebuild", "prepare", "lb_translate", "ct_prepass", "dispatch",
+    "host_sync", "ct_create", "counters", "emit_events",
+}
+
+
+def _ct_world(seed: int = 3, depth: int = 1, **kw):
+    pipe, engine, idents = _build_datapath_world(seed=seed)
+    ct_pipe = DatapathPipeline(
+        engine, pipe.ipcache, pipe.prefilter,
+        conntrack=FlowConntrack(capacity_bits=12),
+        pipeline_depth=depth, **kw,
+    )
+    ct_pipe.set_endpoints([i.id for i in idents[:4]])
+    ct_pipe.rebuild()
+    return ct_pipe, idents
+
+
+def _spans(pipe, n, *, bucketed=True, ndev=1):
+    return pipe._chunk_spans(n, bucketed=bucketed, ndev=ndev)
+
+
+class TestLadderChunker:
+    @pytest.fixture(scope="class")
+    def pipe(self):
+        p, _, _ = _build_datapath_world(seed=3)
+        return p
+
+    def test_exact_rung_boundary_no_pad(self, pipe):
+        for rung in BUCKET_LADDER:
+            spans = _spans(pipe, rung)
+            assert spans == [(0, rung, rung)]
+
+    def test_below_floor_pads_to_floor(self, pipe):
+        for n in (1, 5, 700, 1023):
+            spans = _spans(pipe, n)
+            assert spans == [(0, n, BUCKET_LADDER[0])]
+
+    def test_ndev_not_dividing_rung(self, pipe):
+        # ndev=3 divides no power of two: every rung rounds up to a
+        # multiple of 3 so P("flows") splits each chunk evenly
+        rungs = _ladder_rungs(3)
+        assert all(r % 3 == 0 for r in rungs)
+        for n in (1, 1024, 1100, 3000, 9000):
+            spans = _spans(pipe, n, ndev=3)
+            assert all(p % 3 == 0 for _, _, p in spans)
+            assert all(p in rungs for _, _, p in spans)
+            assert sum(hi - lo for lo, hi, _ in spans) == n
+            assert all(p >= hi - lo for lo, hi, p in spans)
+
+    def test_cold_start_ignores_warm_set(self, pipe):
+        # the ladder is FIXED: with one (or zero) warm rungs the
+        # decomposition is identical — no largest-warm-bucket reuse
+        saved = set(pipe._warm_buckets)
+        try:
+            pipe._warm_buckets = {1024}
+            cold = _spans(pipe, 3000)
+            pipe._warm_buckets = set()
+            assert _spans(pipe, 3000) == cold == [
+                (0, 2048, 2048), (2048, 3000, 1024)
+            ]
+        finally:
+            pipe._warm_buckets = saved
+
+    def test_spans_cover_exactly_and_pad_only_last_chunk(self, pipe):
+        for n in (1, 1100, 2048, 2500, 5000, 9000, 20000, 100_000):
+            spans = _spans(pipe, n)
+            lo_expect = 0
+            for lo, hi, p in spans:
+                assert lo == lo_expect and hi > lo and p >= hi - lo
+                lo_expect = hi
+            assert lo_expect == n
+            # every chunk except the last is dispatched full
+            assert all(p == hi - lo for lo, hi, p in spans[:-1])
+
+    def test_strictly_beats_single_warm_bucket(self, pipe):
+        """Acceptance: 1100/3000/5000-flow CT-miss tails pad strictly
+        fewer lanes than the single-warm-bucket scheme (everything
+        chunked/padded to one warm 4096 bucket — the ISSUE's
+        1100→4096, ~73%-wasted example)."""
+        w = 4096
+        for n in (1100, 3000, 5000):
+            lanes = sum(p for _, _, p in _spans(pipe, n))
+            single = -(-n // w) * w
+            assert lanes < single, (n, lanes, single)
+            assert lanes >= n
+
+    def test_shape_set_bounded_by_ladder(self, pipe):
+        # acceptance: jit shape-bucket count ≤ ladder size × directions
+        seen = set()
+        for n in range(1, 30_000, 251):
+            for _, _, p in _spans(pipe, n):
+                seen.add(p)
+        assert seen <= set(BUCKET_LADDER)
+        assert len(seen) * 2 <= len(BUCKET_LADDER) * 2
+
+    def test_tail_cover_minimizes_lanes_then_chunks(self):
+        rungs = _ladder_rungs(1)
+        lanes, chunks, plan = _tail_cover(1100, rungs)
+        assert (lanes, chunks, plan) == (2048, 1, (2048,))
+        lanes, chunks, plan = _tail_cover(3000, rungs)
+        assert (lanes, chunks, plan) == (3072, 2, (2048, 1024))
+        lanes, chunks, plan = _tail_cover(5000, rungs)
+        assert (lanes, chunks, plan) == (5120, 2, (4096, 1024))
+
+
+class TestPadLaneAccounting:
+    def test_bucketed_pad_lanes_counted(self):
+        pipe, idents = _ct_world()
+        rng = np.random.default_rng(3)
+        n = 1100
+        before = metrics.dispatch_pad_lanes_total.get({"family": "v4"})
+        pipe.process(
+            *_make_ip_flows(idents, n, seed=9),
+            sports=rng.integers(1024, 4096, n).astype(np.int32),
+        )
+        delta = metrics.dispatch_pad_lanes_total.get({"family": "v4"}) - before
+        assert delta == 2048 - n  # all flows miss → one 2048 rung
+
+    def test_unbucketed_sharded_pad_lanes_counted(self):
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >1 device for VerdictSharding")
+        pipe, _, idents = _build_datapath_world(seed=3)
+        pipe.set_sharding(True)
+        pipe.rebuild()
+        ndev = len(jax.devices())
+        b = ndev * 8 + 3  # forces pad-to-multiple-of-ndev
+        before = metrics.dispatch_pad_lanes_total.get({"family": "v4"})
+        pipe.process(*_make_ip_flows(idents, b, seed=5))
+        delta = metrics.dispatch_pad_lanes_total.get({"family": "v4"}) - before
+        assert delta == (-b) % ndev
+
+
+class TestDepthTuner:
+    @staticmethod
+    def _simulate(tuner, optimal, *, epochs=60, flat=False):
+        """Feed synthetic per-batch timings: enqueue 1ms; the
+        completion half shrinks with depth (overlap) up to ``optimal``
+        then degrades past it; ``flat`` makes depth buy nothing."""
+        depth = tuner.min_depth
+        for _ in range(epochs * tuner.epoch):
+            if flat:
+                comp = 1_000_000
+            elif depth <= optimal:
+                comp = 1_000_000 // depth
+            else:
+                comp = int(1_000_000 / optimal * (1 + 0.5 * (depth - optimal)))
+            new = tuner.observe(depth, 1000, 1_000_000, comp, depth + 1)
+            if new is not None:
+                assert tuner.min_depth <= new <= tuner.max_depth
+                assert abs(new - depth) == 1  # single steps only
+                depth = new
+        return depth
+
+    @pytest.mark.parametrize("optimal", [1, 2, 3, 4])
+    def test_converges_within_one_of_optimum(self, optimal):
+        tuner = DepthTuner(1, 4, epoch=4)
+        depth = self._simulate(tuner, optimal)
+        assert abs(depth - optimal) <= 1, (depth, optimal)
+
+    def test_respects_max_depth_bound(self):
+        tuner = DepthTuner(1, 3, epoch=4)
+        depth = self._simulate(tuner, optimal=8)  # always improving
+        assert depth == 3
+
+    def test_flat_profile_does_not_oscillate(self):
+        # cooldown must stop the d↔d+1 ping-pong on a host-bound box
+        tuner = DepthTuner(1, 4, epoch=4)
+        epochs = 60
+        depth = self._simulate(tuner, optimal=1, flat=True, epochs=epochs)
+        assert depth == 1
+        # without cooldown a failed probe would retry every 2nd epoch
+        # (~30 ups); with it, re-probes are at least 8 epochs apart
+        assert tuner.ups <= epochs // 8 + 2
+        assert tuner.downs == tuner.ups  # every probe was rolled back
+
+    def test_epoch_gates_decisions(self):
+        tuner = DepthTuner(1, 4, epoch=16)
+        for _ in range(15):
+            assert tuner.observe(1, 100, 1000, 1000, 2) is None
+        snap = tuner.snapshot()
+        assert snap["epochs_seen"] == 0
+
+    def test_snapshot_shape(self):
+        tuner = DepthTuner(2, 4, epoch=2)
+        tuner.observe(2, 100, 1000, 1000, 3)
+        tuner.observe(2, 100, 1000, 1000, 3)
+        snap = tuner.snapshot()
+        assert snap["min_depth"] == 2 and snap["max_depth"] == 4
+        assert snap["epochs_seen"] == 1
+        assert "2" in snap["stats"]
+        assert set(snap["adjustments"]) == {"up", "down"}
+
+
+class TestAutotuneParity:
+    def test_off_path_is_static_and_tunerless(self):
+        pipe, _ = _ct_world()
+        assert pipe._tuner is None
+        assert pipe.pipeline_depth == 1
+        # no tuner observation fields populated on submitted batches
+        pend = pipe.submit(
+            *_make_ip_flows(_ct_world()[1], 64, seed=2),
+            sports=np.arange(64, dtype=np.int32) + 1024,
+        )
+        pend.result()
+        assert pipe._tuner is None
+
+    def test_on_off_verdicts_and_programs_identical(self):
+        """Autotune ON (depth actively moving, tiny epochs) vs OFF:
+        verdicts, counters, and the compiled shape-key set must be
+        bit-identical — the tuner only re-times the queue bound."""
+        pipe_a, idents = _ct_world(depth=1)
+        pipe_a.set_autotune(True, max_depth=4, epoch=2)
+        pipe_b, _ = _ct_world(depth=1)
+        rng = np.random.default_rng(17)
+        batches = [_make_ip_flows(idents, 300, seed=70 + i) for i in range(10)]
+        sports = [
+            rng.integers(1024, 4096, 300).astype(np.int32) for _ in batches
+        ]
+        batches.append(batches[0])  # CT replay
+        sports.append(sports[0])
+        pend = [
+            pipe_a.submit(p, e, d, pr, sports=sp)
+            for (p, e, d, pr), sp in zip(batches, sports)
+        ]
+        got = [pb.result() for pb in pend]
+        for (p, e, d, pr), sp, (v_a, red_a) in zip(batches, sports, got):
+            v_b, red_b = pipe_b.process(p, e, d, pr, sports=sp)
+            np.testing.assert_array_equal(v_a, v_b)
+            np.testing.assert_array_equal(red_a, red_b)
+        np.testing.assert_array_equal(pipe_a.counters, pipe_b.counters)
+        assert pipe_a._seen_shapes == pipe_b._seen_shapes
+        assert len(pipe_a.conntrack) == len(pipe_b.conntrack)
+
+    def test_sharded_ct_attribution_combo_parity(self):
+        """The full stack at once: VerdictSharding + CT replay +
+        FlowAttribution, autotuned vs static."""
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >1 device for VerdictSharding")
+        pipe_a, idents = _ct_world(depth=2)
+        pipe_a.set_sharding(True)
+        pipe_a.set_attribution(True)
+        pipe_a.set_autotune(True, max_depth=4, epoch=2)
+        pipe_a.rebuild()
+        pipe_b, _ = _ct_world(depth=1)
+        pipe_b.set_sharding(True)
+        pipe_b.set_attribution(True)
+        pipe_b.rebuild()
+        rng = np.random.default_rng(23)
+        batches = [_make_ip_flows(idents, 250, seed=40 + i) for i in range(6)]
+        sports = [
+            rng.integers(1024, 4096, 250).astype(np.int32) for _ in batches
+        ]
+        batches.append(batches[0])
+        sports.append(sports[0])
+        pend = [
+            pipe_a.submit(p, e, d, pr, sports=sp)
+            for (p, e, d, pr), sp in zip(batches, sports)
+        ]
+        got = [pb.result() for pb in pend]
+        for (p, e, d, pr), sp, (v_a, red_a) in zip(batches, sports, got):
+            v_b, red_b = pipe_b.process(p, e, d, pr, sports=sp)
+            np.testing.assert_array_equal(v_a, v_b)
+            np.testing.assert_array_equal(red_a, red_b)
+        np.testing.assert_array_equal(pipe_a.counters, pipe_b.counters)
+
+    def test_phase_names_stay_stable_under_autotune(self):
+        pipe, idents = _ct_world(depth=1)
+        pipe.set_autotune(True, max_depth=4, epoch=2)
+        pipe.tracer.enable()
+        rng = np.random.default_rng(2)
+        for i in range(6):
+            pipe.process(
+                *_make_ip_flows(idents, 200, seed=90 + i),
+                sports=rng.integers(1024, 4096, 200).astype(np.int32),
+            )
+        pipe.tracer.disable()
+        for t in pipe.tracer.traces(6):
+            names = {ph[0] for ph in t["phases"]}
+            assert names <= STABLE_PHASES
+
+    def test_set_autotune_off_restores_static_depth(self):
+        pipe, _ = _ct_world(depth=2)
+        pipe.set_autotune(True, max_depth=4, epoch=2)
+        pipe._apply_depth(4)
+        assert pipe.pipeline_depth == 4
+        pipe.set_autotune(False)
+        assert pipe._tuner is None
+        assert pipe.pipeline_depth == 2
+        assert pipe.autotune_state() is None
+
+
+class TestStaging:
+    def test_staging_recycles_and_verdicts_stay_clean(self):
+        """Two same-rung batches back-to-back: the second reuses the
+        first's released staging tuple (whose tail still holds the
+        first batch's flows) — pad re-zeroing must keep verdicts
+        identical to a fresh pipeline."""
+        pipe, idents = _ct_world(depth=1)
+        rng = np.random.default_rng(31)
+        b1 = _make_ip_flows(idents, 1500, seed=11)
+        b2 = _make_ip_flows(idents, 1200, seed=12)
+        sp1 = rng.integers(1024, 4096, 1500).astype(np.int32)
+        sp2 = rng.integers(8192, 16384, 1200).astype(np.int32)
+        v1, _ = pipe.process(*b1, sports=sp1)
+        assert pipe._staging.get((2048, 4)), "released tuple not pooled"
+        pooled = pipe._staging[(2048, 4)][-1]
+        v2, _ = pipe.process(*b2, sports=sp2)
+        # same tuple object went out and came back
+        assert any(p is pooled for p in pipe._staging.get((2048, 4), ()))
+        fresh, _ = _ct_world(depth=1)
+        fv1, _ = fresh.process(*b1, sports=sp1)
+        fv2, _ = fresh.process(*b2, sports=sp2)
+        np.testing.assert_array_equal(v1, fv1)
+        np.testing.assert_array_equal(v2, fv2)
+
+    def test_free_list_is_bounded(self):
+        pipe, idents = _ct_world(depth=1)
+        rng = np.random.default_rng(37)
+        for i in range(12):
+            n = 1100 + i
+            pipe.process(
+                *_make_ip_flows(idents, n, seed=100 + i),
+                sports=rng.integers(1024, 60000, n).astype(np.int32),
+            )
+        for free in pipe._staging.values():
+            assert len(free) <= pipe._STAGING_FREE_CAP
+
+
+class TestDaemonWiring:
+    def test_dispatch_autotune_option_and_traces(self, tmp_path):
+        from cilium_tpu.daemon import Daemon
+
+        d = Daemon(state_dir=str(tmp_path), conntrack=False)
+        try:
+            assert d.traces()["autotune"] is None
+            out = d.config_patch({"DispatchAutoTune": "true"})
+            assert "DispatchAutoTune" in out["changed"]
+            assert d.pipeline._tuner is not None
+            at = d.traces()["autotune"]
+            assert at["min_depth"] == 1
+            assert at["max_depth"] == d.pipeline.pipeline_max_depth
+            assert at["depth"] == d.pipeline.pipeline_depth
+            d.config_patch({"DispatchAutoTune": "false"})
+            assert d.pipeline._tuner is None
+            assert d.traces()["autotune"] is None
+        finally:
+            d.shutdown()
+
+    def test_flow_ring_capacity_config(self, tmp_path):
+        from cilium_tpu.daemon import Daemon
+        from cilium_tpu.option import DaemonConfig, get_config, set_config
+
+        saved = get_config()
+        try:
+            set_config(DaemonConfig(flow_ring_capacity=64))
+            d = Daemon(state_dir=str(tmp_path), conntrack=False)
+            try:
+                assert d.pipeline.flow_ring.capacity == 64
+                assert d.flows()["capacity"] == 64
+            finally:
+                d.shutdown()
+        finally:
+            set_config(saved)
+
+    def test_max_depth_validation(self):
+        from cilium_tpu.option import DaemonConfig
+
+        with pytest.raises(ValueError):
+            DaemonConfig(
+                verdict_pipeline_depth=5, verdict_pipeline_max_depth=4
+            ).validate()
+        with pytest.raises(ValueError):
+            DaemonConfig(flow_ring_capacity=0).validate()
+        DaemonConfig(
+            verdict_pipeline_depth=2, verdict_pipeline_max_depth=8
+        ).validate()

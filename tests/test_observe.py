@@ -244,6 +244,7 @@ class TestSurfaces:
                            "pipeline_depth": d.pipeline.pipeline_depth,
                            "in_flight": 0,
                            "flow_attribution": False,
+                           "autotune": None,
                            "traces": []}
             d.config_patch({"PhaseTracing": True})
             assert d.pipeline.tracer.active

@@ -117,10 +117,10 @@ class TestPipelinedParity:
 
 class TestWarmBucketChunking:
     def test_oversize_batch_chunks_into_warm_buckets(self):
-        """A CT-miss tail larger than the largest warm bucket must
-        dispatch as full warm-bucket chunks + a bucketed tail instead
-        of padding to the next power of two (3000 → 3×1024 = 3072
-        lanes, not 4096)."""
+        """A CT-miss tail must decompose over the fixed bucket ladder:
+        3000 flows dispatch as 2048 + 1024 (3072 lanes, two chunks) —
+        fewer enqueues than the old 3×1024 largest-warm-bucket reuse
+        and 1024 lanes less pad than a single 4096 bucket."""
         pipe, idents = _ct_world()
         rng = np.random.default_rng(11)
         warm = _make_ip_flows(idents, 700, seed=80)
@@ -133,9 +133,9 @@ class TestWarmBucketChunking:
             *big, sports=rng.integers(8192, 16384, 3000).astype(np.int32)
         )
         pipe.tracer.disable()
-        assert pipe._warm_buckets == {1024}  # no 4096 compile
+        assert pipe._warm_buckets == {1024, 2048}  # no 4096 compile
         (t,) = pipe.tracer.traces(1)
-        assert t["notes"]["chunks"] == 3
+        assert t["notes"]["chunks"] == 2
         assert t["notes"]["padded"] == 3072
 
         fresh, _ = _ct_world()
