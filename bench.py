@@ -802,6 +802,153 @@ def _bench_tune(repo, reg, idents, nrng: np.random.Generator, attached):
     }
 
 
+def _bench_chaos(repo, reg, idents, nrng: np.random.Generator, attached):
+    """``--chaos``: policyd-failsafe round → result dict for the
+    one-line JSON. Fixed-seed fault injection at ≥4 distinct sites
+    through the REAL pipeline:
+
+    - transient faults at h2d/complete retried invisibly (verdicts
+      match the clean reference bit-for-bit);
+    - a kvstore partition (transient pump fault) proven eventually
+      consistent — the withheld event applies on the next pump;
+    - poisoned faults trip the breaker down the full ladder
+      (sharded → single-device → host), with host-mode verdicts
+      asserted equal to the device reference;
+    - clean traffic re-promotes back to level 0 without a restart
+      (``recovery_s`` measures fault → healthy);
+    - a transient attach fault exercises the bounded attach retry.
+
+    Every submitted flow must come back with a verdict —
+    ``verdicts_lost`` is computed, not assumed, and must be 0;
+    fail-closed batches carry DROP_DEGRADED (monitor reason 155)."""
+    from cilium_tpu import faults as _faults
+    from cilium_tpu import metrics as _m
+    from cilium_tpu.datapath.pipeline import DROP_DEGRADED, DatapathPipeline
+    from cilium_tpu.engine import PolicyEngine
+    from cilium_tpu.ipcache.ipcache import IPCache
+    from cilium_tpu.ipcache.prefilter import PreFilter
+    from cilium_tpu.kvstore.backend import InMemoryBackend, InMemoryStore
+    from cilium_tpu.kvstore.store import SharedStore
+
+    _faults.hub.reset()
+    eng = PolicyEngine(repo, reg)
+    cache = IPCache()
+    for i, ident in enumerate(idents):
+        cache.upsert(
+            f"10.{(i >> 8) & 255}.{i & 255}.1/32", ident.id, source="k8s"
+        )
+    pipe = DatapathPipeline(
+        eng, cache, PreFilter(), conntrack=None, pipeline_depth=2
+    )
+    pipe.set_endpoints([idents[j].id for j in range(N_ENDPOINTS)])
+    # shrink the breaker so the full ladder fits in a bench round
+    pipe.breaker_threshold = 2
+    pipe.recover_after_clean = 3
+    pipe.retry_min_s = pipe.retry_max_s = 0.001
+
+    b = 1 << 12
+    batches = []
+    for _ in range(4):
+        i_sel = nrng.integers(0, len(idents), b)
+        ips = (
+            np.uint32(10) << 24
+            | ((i_sel >> 8) & 255).astype(np.uint32) << 16
+            | (i_sel & 255).astype(np.uint32) << 8
+            | 1
+        ).astype(np.uint32)
+        eps = nrng.integers(0, N_ENDPOINTS, b).astype(np.int32)
+        dports = nrng.choice(np.array([80, 443, 8080, 53, 22], np.int32), b)
+        protos = np.where(dports == 53, 17, 6).astype(np.int32)
+        batches.append((ips, eps, dports, protos))
+
+    submitted = 0
+    resolved = 0
+    degraded_flows = 0
+
+    def run(bt):
+        nonlocal submitted, resolved, degraded_flows
+        submitted += bt[0].shape[0]
+        v, _red = pipe.process(*bt)
+        resolved += int(v.shape[0])
+        degraded_flows += int((v == DROP_DEGRADED).sum())
+        return v
+
+    reason0 = _m.drop_reasons_total.get({"reason": "pipeline-degraded"})
+    attached.stage("chaos-baseline")
+    ref_v = run(batches[0])  # clean level-0 reference (warms the jit)
+
+    # transient faults: retried inside the pipeline, invisible outside
+    attached.stage("chaos-transient")
+    _faults.hub.fail(_faults.SITE_H2D, _faults.KIND_TRANSIENT, times=1)
+    _faults.hub.fail(_faults.SITE_COMPLETE, _faults.KIND_TRANSIENT, times=1)
+    v = run(batches[0])
+    transparent = bool(np.array_equal(v, ref_v))
+
+    # kvstore partition: the pump returns 0 applied, the event is NOT
+    # lost — it lands on the next pump
+    attached.stage("chaos-kvstore")
+    store = SharedStore(InMemoryBackend(InMemoryStore()), "chaos")
+    store.backend.update(store._key_path("k1"), b'{"v": 1}')
+    _faults.hub.fail(_faults.SITE_KVSTORE, _faults.KIND_TRANSIENT, times=1)
+    partition_held = store.pump() == 0 and "k1" not in store.shared
+    kv_recovered = store.pump() >= 1 and "k1" in store.shared
+
+    # poisoned faults: breaker trips down the full ladder
+    attached.stage("chaos-descend")
+    t_fault = time.time()
+    for site in (_faults.SITE_COMPLETE, _faults.SITE_COMPLETE,
+                 _faults.SITE_DISPATCH, _faults.SITE_DISPATCH):
+        _faults.hub.fail(site, _faults.KIND_POISONED, times=1)
+        run(batches[1])
+    modes = [pipe.pipeline_mode]
+    host_v = run(batches[0])  # clean batch on the host/numpy path
+    host_parity = bool(np.array_equal(host_v, ref_v))
+
+    # recovery: clean traffic walks the ladder back up, no restart
+    attached.stage("chaos-recover")
+    recovery_rounds = 0
+    while pipe.pipeline_mode != "sharded" and recovery_rounds < 64:
+        run(batches[2 + (recovery_rounds % 2)])
+        recovery_rounds += 1
+        if pipe.pipeline_mode not in modes:
+            modes.append(pipe.pipeline_mode)
+    recovery_s = time.time() - t_fault
+    v = run(batches[0])
+    recovered_parity = bool(np.array_equal(v, ref_v))
+
+    # attach: a transient handshake fault absorbed by the bounded retry
+    attached.stage("chaos-attach")
+    _faults.hub.fail(_faults.SITE_ATTACH, _faults.KIND_TRANSIENT, times=1)
+    reattached = _attach_backend(attached, 60.0, attempts=2)
+
+    snap = _faults.hub.snapshot()
+    _faults.hub.reset()
+    sites = sorted({k.split(":")[0] for k in snap["injected"]})
+    return {
+        "chaos_seed": 21,  # the nrng seed main() hands every round
+        "sites_injected": sites,
+        "distinct_sites": len(sites),
+        "faults_injected": int(sum(snap["injected"].values())),
+        "verdicts_lost": submitted - resolved,
+        "degraded_flows": degraded_flows,
+        "reason_155_flows": int(
+            _m.drop_reasons_total.get({"reason": "pipeline-degraded"})
+            - reason0
+        ),
+        "transient_transparent": transparent,
+        "kv_partition_held": bool(partition_held),
+        "kv_recovered": bool(kv_recovered),
+        "modes_visited": modes,
+        "host_parity": host_parity,
+        "recovery_rounds": recovery_rounds,
+        "recovery_s": round(recovery_s, 3),
+        "recovered_parity": recovered_parity,
+        "final_mode": pipe.pipeline_mode,
+        "reattached": reattached,
+        "failsafe": pipe.failsafe_state(),
+    }
+
+
 def _bench_native_e2e(snaps, idents, nrng: np.random.Generator):
     """The native front-end's FULL per-node pipeline (conntrack probe →
     identity LPM → policymap, bpf_lxc.c end to end) — (mixed_vps,
@@ -1195,6 +1342,13 @@ def _attach_backend(
 
         def probe():
             try:
+                from cilium_tpu import faults as _faults
+
+                if _faults.hub.active:
+                    # chaos rounds rehearse the wedged-attach failure
+                    # (round 5's rc-3-no-data) through the same bounded
+                    # retry that real reattaches take
+                    _faults.hub.check(_faults.SITE_ATTACH)
                 devs = jax.devices()  # backend handshake; no program yet
                 # first device op: forces the first XLA compile
                 # through the tunnel
@@ -1310,6 +1464,24 @@ def main() -> None:
             "flows_off_vps": round(off_vps),
             "flows_on_vps": round(on_vps),
             "pipeline_depth": 2,
+            "backend": backend,
+            "build_s": round(t_build, 2),
+        }))
+        return
+
+    if "--chaos" in sys.argv[1:]:
+        # policyd-failsafe round: fixed-seed fault injection through
+        # the real pipeline — the round driver gates on verdicts_lost
+        # == 0 and a completed ladder round-trip
+        out = _bench_chaos(
+            repo, reg, idents, np.random.default_rng(21), attached
+        )
+        attached.set()
+        print(json.dumps({
+            "metric": f"chaos recovery at {N_RULES} rules",
+            "value": out["recovery_s"],
+            "unit": "s",
+            **out,
             "backend": backend,
             "build_s": round(t_build, 2),
         }))

@@ -26,6 +26,12 @@ TPU003  jit-decorated function closes over a mutable module-level
 TPU004  dtype-literal drift: a matmul (``@``, ``jnp.matmul``,
         ``jnp.dot``, ``lax.dot_general``) whose two operands are cast
         to different integer/float dtype literals.
+ROBUST001  bare/broad ``except`` (no type, ``Exception``, or
+        ``BaseException``) in a hot module whose handler neither
+        re-raises nor routes through the ``faults.classify`` taxonomy
+        — on the verdict path a swallowed error leaves the in-flight
+        FIFO, CT epoch, and staging free-lists in an undefined state
+        (policyd-failsafe exists because of exactly these blocks).
 """
 
 from __future__ import annotations
@@ -549,6 +555,55 @@ def _check_dtype_drift(
                 )
 
 
+def _is_broad_handler(h: ast.ExceptHandler) -> bool:
+    """True for ``except:``, ``except Exception``, ``except
+    BaseException`` (bare name or a tuple containing one)."""
+    t = h.type
+    if t is None:
+        return True
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        chain = attr_chain(e)
+        if chain and chain[-1] in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _handler_is_classified(h: ast.ExceptHandler) -> bool:
+    """A broad handler is fine when it re-raises or consults the fault
+    taxonomy: any ``raise`` in the body, or a call whose attr chain
+    ends in ``classify`` (``faults.classify(e)``/``_faults.classify``).
+    Nested defs/lambdas don't count — a raise THERE doesn't run HERE."""
+    for n in walk_skipping(
+        h, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    ):
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, ast.Call):
+            chain = attr_chain(n.func)
+            if chain and chain[-1] == "classify":
+                return True
+    return False
+
+
+def _check_broad_except(mod: ModuleSource, findings: List[Finding]) -> None:
+    """ROBUST001: swallow-everything except blocks in hot modules."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if _is_broad_handler(node) and not _handler_is_classified(node):
+            findings.append(
+                mod.finding(
+                    "ROBUST001",
+                    SEV_WARNING,
+                    node.lineno,
+                    "broad except in a hot module swallows every error "
+                    "class — classify through faults.classify() (re-raise "
+                    "KIND_ERROR, quarantine/retry the rest) or re-raise",
+                )
+            )
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -566,4 +621,5 @@ def analyze_hotpath(mod: ModuleSource) -> List[Finding]:
                 _FuncTaint(mod, imports, jit_names, node, findings)
                 _check_loops(mod, imports, node, findings)
         _check_dtype_drift(mod, imports, mod.tree, findings)
+        _check_broad_except(mod, findings)
     return findings

@@ -1085,6 +1085,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"{adj.get('up', 0)} up / {adj.get('down', 0)} "
                         f"down step(s)"
                     )
+                fs = out.get("failsafe")
+                if fs and fs.get("degraded"):
+                    # a degraded ladder changes what the spans MEAN
+                    # (host mode has no device phases at all) — say so
+                    # before any waterfall prints
+                    print(
+                        f"pipeline DEGRADED: mode {fs.get('mode')} "
+                        f"(level {fs.get('level')}), "
+                        f"{fs.get('quarantined_batches', 0)} batch(es) "
+                        f"quarantined, "
+                        f"{'fail-open' if fs.get('fail_open') else 'fail-closed'}"
+                    )
                 print()
             for t in out.get("traces", ()):
                 print(render_waterfall(

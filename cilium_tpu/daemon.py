@@ -759,7 +759,7 @@ class Daemon:
         {
             "Conntrack", "TraceNotification", "DropNotification", "Debug",
             "PhaseTracing", "VerdictSharding", "FlowAttribution",
-            "DispatchAutoTune",
+            "DispatchAutoTune", "FailOpen", "FaultInjection",
         }
     )
 
@@ -797,6 +797,19 @@ class Daemon:
             # policyd-autotune: adaptive pipeline depth; off restores
             # the static configured depth
             self.pipeline.set_autotune(value)
+        elif name == "FailOpen":
+            # policyd-failsafe: what degraded mode returns — forward
+            # (fail-open) vs the default deny with reason 155
+            self.pipeline.set_fail_open(value)
+        elif name == "FaultInjection":
+            # policyd-failsafe: arm/disarm the injection hub; off keeps
+            # rules queued so a re-enable resumes a chaos scenario
+            from . import faults as _faults
+
+            if value:
+                _faults.hub.enable()
+            else:
+                _faults.hub.disable()
         elif name == "Debug":
             import logging as _logging
 
@@ -1028,6 +1041,11 @@ class Daemon:
             # stats, adjustment counts) — waterfalls read under a
             # moving depth need this context (observe/README.md)
             "autotune": self.pipeline.autotune_state(),
+            # policyd-failsafe: ladder level, breaker counters, and the
+            # fault-hub snapshot — a trace read during a chaos round or
+            # a real degradation needs to say WHICH path produced the
+            # spans (device phases vanish at host level)
+            "failsafe": self.pipeline.failsafe_state(),
             "traces": tr.traces(limit),
         }
 
@@ -1081,6 +1099,11 @@ class Daemon:
             # controller.go:282 status surfacing (`cilium status
             # --all-controllers`)
             "controllers": self.controllers.statuses(),
+            # policyd-failsafe: /healthz must answer "are verdicts
+            # degraded" without a second RPC — level 0 is healthy,
+            # 1/2 names the mode (sharded|single-device|host)
+            "pipeline_mode": self.pipeline.pipeline_mode,
+            "pipeline_degraded": self.pipeline.pipeline_mode != "sharded",
         }
 
     def _peek_features(self):

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import faults as _faults
 from .. import metrics as _metrics
 from ..engine import PolicyEngine
 from ..identity.model import ID_WORLD
@@ -63,6 +64,7 @@ from ..ops.materialize import (
     patch_identity_rows,
 )
 from ..lb.device import flow_hash32, lb_translate
+from ..utils.backoff import Backoff
 from .conntrack import CT_NEW, FlowConntrack, pack_keys
 from .tuner import DepthTuner
 
@@ -70,6 +72,10 @@ FORWARD = 1
 DROP_POLICY = 2
 DROP_PREFILTER = 3
 DROP_NO_SERVICE = 4  # frontend matched but zero backends (lb4_local)
+# policyd-failsafe: the pipeline could not verdict this flow (device
+# fault exhausted its bounded retries) and FailOpen is off — the
+# fail-closed deny. Maps to monitor drop reason 155 (STABLE taxonomy).
+DROP_DEGRADED = 5
 
 # verdict code → metrics outcome label (metricsmap REASON strings)
 _OUTCOME_NAMES = (
@@ -77,7 +83,14 @@ _OUTCOME_NAMES = (
     (DROP_POLICY, "dropped_policy"),
     (DROP_PREFILTER, "dropped_prefilter"),
     (DROP_NO_SERVICE, "dropped_no_service"),
+    (DROP_DEGRADED, "dropped_degraded"),
 )
+
+# degradation-ladder levels (policyd-failsafe): index = ladder level.
+# Level 0 is the full device complement (sharded across the verdict
+# mesh when VerdictSharding is on), 1 re-forms the mesh down to a
+# single healthy device, 2 verdicts on host numpy.
+_MODE_NAMES = ("sharded", "single-device", "host")
 
 
 @chex.dataclass(frozen=True)
@@ -565,18 +578,25 @@ class _InFlight:
     when the batch COMPLETES. ``finish=None`` marks a batch that ran
     synchronously (the donated-state device-CT path)."""
 
-    __slots__ = ("pending", "finish", "bt", "enq_ns", "occ", "b")
+    __slots__ = ("pending", "finish", "bt", "enq_ns", "occ", "b", "rev")
 
-    def __init__(self, pending: PendingBatch, finish, bt) -> None:
+    def __init__(
+        self, pending: PendingBatch, finish, bt,
+        b: int = 0, rev: bool = False,
+    ) -> None:
         self.pending = pending
         self.finish = finish
         self.bt = bt
         # depth-tuner observations (populated only while DispatchAutoTune
-        # is on): enqueue-half wall ns, queue occupancy at admission,
-        # batch size. enq_ns == 0 marks "not observed".
+        # is on): enqueue-half wall ns, queue occupancy at admission.
+        # enq_ns == 0 marks "not observed".
         self.enq_ns = 0
         self.occ = 0
-        self.b = 0
+        # batch size + rev-NAT flag: always populated — the failsafe
+        # quarantine path synthesizes a shape-correct degraded result
+        # from these when the finish closure is unrecoverable
+        self.b = b
+        self.rev = rev
 
 
 class _Enqueued:
@@ -586,10 +606,13 @@ class _Enqueued:
     ``exact`` marks device counters (and rule-hit sums) usable as-is
     (no padded lanes polluted them)."""
 
-    __slots__ = ("chunks", "spans", "b", "exact", "ndev", "attrib", "staging")
+    __slots__ = (
+        "chunks", "spans", "b", "exact", "ndev", "attrib", "staging", "host",
+    )
 
     def __init__(
-        self, chunks, spans, b, exact, ndev, attrib=False, staging=()
+        self, chunks, spans, b, exact, ndev, attrib=False, staging=(),
+        host=None,
     ) -> None:
         self.chunks = chunks
         self.spans = spans
@@ -600,6 +623,9 @@ class _Enqueued:
         # staging tuples pinned under this dispatch's device inputs;
         # released back to the pipeline's pool at the host pull
         self.staging = staging
+        # ladder level 2 (host fallback): (verdict, redirect) computed
+        # synchronously on host numpy — no device arrays to pull
+        self.host = host
 
 
 class DatapathPipeline:
@@ -722,6 +748,11 @@ class DatapathPipeline:
         # its in-flight window) cannot create entries verdicted under
         # the old basis
         self._ct_epoch = 0
+        # set when a basis move is DETECTED, cleared only after the
+        # flush+epoch-advance completes: table versions commit above
+        # this block, so a fault between commit and flush must not let
+        # a retried rebuild skip the flush (policyd-failsafe)
+        self._ct_flush_pending = False
         # ladder rungs already dispatched (telemetry: the chunker's
         # shape set is the fixed BUCKET_LADDER; a rung joins this set
         # the first time a batch actually compiles/warms it)
@@ -770,6 +801,40 @@ class DatapathPipeline:
         # direction → (source rule_tab, replicated copy) — the
         # _placed_pm pattern for the attribution gather table
         self._placed_rt: Dict[int, Tuple[object, object]] = {}
+        # -- policyd-failsafe: self-healing / degradation ladder ------
+        # ladder level (index into _MODE_NAMES): 0 = full device
+        # complement, 1 = single-device, 2 = host fallback. Transitions
+        # take self._lock; dispatch paths read the int lock-free
+        # (GIL-atomic, same rule as pipeline_depth).
+        self._ladder_level = 0
+        # FailOpen runtime option: what an UNRESOLVABLE batch returns.
+        # Off (default) = fail-closed: DROP_DEGRADED verdicts, monitor
+        # reason 155. On = forward unverdicted traffic.
+        self._fail_open = False
+        # device ids the mesh must exclude (populated on a sharded →
+        # single-device descent; consulted by _refresh_mesh_locked)
+        self._excluded_devices: set = set()
+        # circuit breaker: quarantines increment _breaker_faults and a
+        # clean-batch streak clears them; at the threshold the ladder
+        # descends one level. recover_after_clean clean batches at a
+        # degraded level probe one level back up. Both knobs are plain
+        # attributes so tests/bench shrink the windows.
+        self.breaker_threshold = 3
+        self.recover_after_clean = 32
+        self._breaker_faults = 0
+        self._clean_batches = 0
+        # bounded retry of classified-transient failures (completion
+        # pull / enqueue): retry_limit attempts spaced by a fresh
+        # Backoff(retry_min_s → retry_max_s) per failure
+        self.retry_limit = 2
+        self.retry_min_s = 0.005
+        self.retry_max_s = 0.1
+        self._quarantined = 0  # batches resolved degraded (lifetime)
+        # direction → (source policymap, host numpy copy) for the
+        # ladder-level-2 fallback — pulled once per materialization,
+        # not per batch
+        self._host_pm: Dict[int, Tuple[object, Tuple]] = {}
+        _metrics.pipeline_mode.set(0.0)
 
     def set_endpoints(self, endpoints: Sequence) -> None:
         """Accepts identity ids (endpoint id == identity id) or
@@ -938,17 +1003,203 @@ class DatapathPipeline:
 
     def _refresh_mesh_locked(self) -> None:
         """Form/drop the verdict mesh to match the sharding request
-        (held-lock helper for rebuild)."""
-        want = self._sharding_requested and len(jax.devices()) > 1
-        if want and self._mesh is None:
-            # Mesh normalizes the device list itself — no host pull
-            self._mesh = Mesh(jax.devices(), ("flows",))
-            self._flow_sharding = NamedSharding(self._mesh, P("flows"))
-            self._table_sharding = NamedSharding(self._mesh, P())
-        elif not want and self._mesh is not None:
+        (held-lock helper for rebuild). Devices in _excluded_devices
+        (a degradation-ladder descent) never join the mesh; with an
+        empty exclusion set this is exactly the pre-failsafe behavior
+        — one mesh over all visible devices, formed once."""
+        devs = jax.devices()
+        if self._excluded_devices:
+            devs = [d for d in devs if d.id not in self._excluded_devices]
+            if not devs:  # never exclude everything
+                devs = jax.devices()[:1]
+        want = self._sharding_requested and len(devs) > 1
+        if want:
+            if self._mesh is None or tuple(
+                d.id for d in self._mesh.devices.flat
+            ) != tuple(d.id for d in devs):
+                # Mesh normalizes the device list itself — no host pull
+                self._mesh = Mesh(devs, ("flows",))
+                self._flow_sharding = NamedSharding(self._mesh, P("flows"))
+                self._table_sharding = NamedSharding(self._mesh, P())
+        elif self._mesh is not None:
             self._mesh = None
             self._flow_sharding = None
             self._table_sharding = None
+
+    # -- policyd-failsafe: ladder + classified error handling ----------
+    def set_fail_open(self, on: bool) -> None:
+        """Toggle the FailOpen runtime option: what a batch that
+        exhausted its retries returns. Off (default) is fail-closed —
+        DROP_DEGRADED verdicts carrying monitor reason 155; on forwards
+        unverdicted traffic (availability over enforcement)."""
+        self._fail_open = bool(on)
+
+    @property
+    def pipeline_mode(self) -> str:
+        return _MODE_NAMES[self._ladder_level]
+
+    def failsafe_state(self) -> Dict:
+        """Degraded-state snapshot for GET /healthz, GET /traces, and
+        the CLI traces header."""
+        return {
+            "mode": self.pipeline_mode,
+            "level": self._ladder_level,
+            "degraded": self._ladder_level > 0,
+            "fail_open": self._fail_open,
+            "breaker_faults": self._breaker_faults,
+            "clean_batches": self._clean_batches,
+            "quarantined_batches": self._quarantined,
+            "excluded_devices": sorted(self._excluded_devices),
+            "fault_injection": _faults.hub.active,
+        }
+
+    def _set_level(self, level: int) -> None:
+        """Move the degradation ladder (descent on a tripped breaker,
+        re-promotion probe on a clean streak). Clears placed tables and
+        the shape/warm caches — the next rebuild re-forms the mesh over
+        the healthy device set and re-places tables through the
+        identity-cached placement, exactly like a sharding toggle."""
+        with self._lock:
+            cur = self._ladder_level
+            level = max(0, min(len(_MODE_NAMES) - 1, int(level)))
+            if level == cur:
+                return
+            frm, to = _MODE_NAMES[cur], _MODE_NAMES[level]
+            self._ladder_level = level
+            if level == 0:
+                # full re-promotion: all devices eligible again
+                self._excluded_devices.clear()
+            elif cur == 0:
+                # sharded → single-device: keep ONE healthy device.
+                # Which chip faulted is not attributable host-side (the
+                # pull fails for the whole mesh program), so keep the
+                # first and exclude the rest — the recovery probe
+                # re-admits them after a clean streak.
+                self._excluded_devices.update(
+                    d.id for d in jax.devices()[1:]
+                )
+            self._tables = {}
+            self._tries = None
+            self._placed_pm.clear()
+            self._placed_rt.clear()
+            self._breaker_faults = 0
+            self._clean_batches = 0
+        self._seen_shapes.clear()
+        self._warm_buckets.clear()
+        _metrics.degradations_total.inc({"from": frm, "to": to})
+        _metrics.pipeline_mode.set(float(level))
+
+    def _note_fault(self, exc: BaseException, kind: str) -> None:
+        """Account one classified fault and trip the breaker when due.
+        Injected FaultErrors were already counted at the injection site
+        (faults.hub.check) — only real errors add a metric here."""
+        if not isinstance(exc, _faults.FaultError):
+            _metrics.pipeline_faults_total.inc(
+                {"site": getattr(exc, "site", "pipeline"), "kind": kind}
+            )
+        with self._lock:
+            self._clean_batches = 0
+            self._breaker_faults += 1
+            trip = self._breaker_faults >= self.breaker_threshold
+            lvl = self._ladder_level
+        if trip and lvl < len(_MODE_NAMES) - 1:
+            self._set_level(lvl + 1)
+
+    def _note_clean_batch(self) -> None:
+        """One healthy completion: clear the breaker after a short
+        streak; at a degraded level a long-enough streak is the
+        recovery probe — re-promote ONE level and keep watching."""
+        if self._ladder_level == 0 and self._breaker_faults == 0:
+            return  # steady state: one int read, no lock
+        with self._lock:
+            self._clean_batches += 1
+            if self._clean_batches >= self.breaker_threshold:
+                self._breaker_faults = 0
+            lvl = self._ladder_level
+            promote = lvl > 0 and self._clean_batches >= self.recover_after_clean
+        if promote:
+            self._set_level(lvl - 1)
+
+    def _degraded_result(self, inf: "_InFlight"):
+        """Shape-correct result for an unresolvable batch. NEVER an
+        exception: every submitted flow gets a verdict (verdicts_lost
+        stays 0) — FORWARD under FailOpen, DROP_DEGRADED (monitor
+        reason 155) fail-closed. Flow tuples are no longer reachable
+        (they live in the abandoned closure), so per-endpoint counters
+        and DropNotify events are skipped; the batch still lands in
+        verdicts_total{dropped_degraded} and drop_reasons_total."""
+        b = max(0, inf.b)
+        if self._fail_open:
+            v = np.full(b, FORWARD, np.int8)
+        else:
+            v = np.full(b, DROP_DEGRADED, np.int8)
+            if b:
+                _metrics.drop_reasons_total.inc(
+                    {"reason": "pipeline-degraded"}, float(b)
+                )
+        self._account_batch(v)
+        red = np.zeros(b, bool)
+        if inf.rev:
+            return v, red, np.zeros(b, np.uint16)
+        return v, red
+
+    def _quarantine(self, inf: "_InFlight"):
+        """Give up on a poisoned batch: advance the CT epoch under the
+        lock so any sibling completing after us cannot create CT
+        entries verdicted under the possibly-poisoned basis, drop the
+        device-CT state, and resolve the handle with a degraded RESULT.
+        The batch's pinned staging buffers are abandoned (NOT returned
+        to the free-list — the wedged device program may still read
+        them; the pool only ever re-issues buffers it owns, so the
+        free-lists stay consistent and the GC reclaims the orphans once
+        the program dies)."""
+        with self._lock:
+            self._ct_epoch += 1
+            self._device_ct = None
+            self._quarantined += 1
+        return self._degraded_result(inf)
+
+    def _finish_guarded(self, inf: "_InFlight"):
+        """Run a batch's finish closure with classified error handling:
+
+        - transient → bounded retry (retry_limit attempts, fresh
+          Backoff sleeps). Sound because the closure's externally
+          visible mutations (counters, CT create, events) all happen
+          AFTER the host pull — the only device interaction that can
+          fail transiently — so re-running from the top cannot
+          double-account.
+        - poisoned (or retries exhausted) → quarantine: degraded
+          result, CT-epoch rollback, FIFO order preserved.
+        - error (programmer/control) → returned as the exception for
+          the caller to surface raw through PendingBatch.result(),
+          exactly the pre-failsafe contract.
+
+        Returns (value, exc) — exactly one is non-None."""
+        attempt = 0
+        bo: Optional[Backoff] = None
+        while True:
+            try:
+                out = inf.finish()
+            except BaseException as e:
+                kind = _faults.classify(e)
+                if kind == _faults.KIND_ERROR:
+                    return None, e
+                self._note_fault(e, kind)
+                if (
+                    kind == _faults.KIND_TRANSIENT
+                    and attempt < self.retry_limit
+                ):
+                    attempt += 1
+                    if bo is None:
+                        bo = Backoff(
+                            min_s=self.retry_min_s, max_s=self.retry_max_s,
+                            jitter=False,
+                        )
+                    time.sleep(bo.duration())
+                    continue
+                return self._quarantine(inf), None
+            self._note_clean_batch()
+            return out, None
 
     # ------------------------------------------------------------------
     def rebuild(self, force: bool = False) -> Dict[Tuple[int, int], DatapathTables]:
@@ -1126,6 +1377,13 @@ class DatapathPipeline:
             # captured BEFORE the reads so a mutation landing mid-build
             # flushes again on the next rebuild rather than slipping by.
             if mat_fresh or saw_row_event or basis_moved:
+                self._ct_flush_pending = True
+            if self._ct_flush_pending:
+                if _faults.hub.active:
+                    # before the flush: a retried rebuild re-runs this
+                    # whole block (pending stays set), so nothing is
+                    # half-advanced
+                    _faults.hub.check(_faults.SITE_CT_EPOCH)
                 if self.conntrack is not None:
                     self.conntrack.flush()
                 # a basis move while batches are in flight: their
@@ -1133,6 +1391,7 @@ class DatapathPipeline:
                 # verdicted under the old basis
                 self._ct_epoch += 1
                 self._device_ct = None  # zeroed on next use
+                self._ct_flush_pending = False
 
             # LB tables: deterministic per-flow backend selection means
             # backend churn changes the translated CT key (natural
@@ -1321,6 +1580,7 @@ class DatapathPipeline:
             return
         from ..monitor.events import (
             REASON_NO_SERVICE,
+            REASON_PIPELINE_DEGRADED,
             REASON_POLICY,
             REASON_POLICY_DENY,
             REASON_POLICY_NO_L3,
@@ -1337,6 +1597,7 @@ class DatapathPipeline:
             DROP_POLICY: REASON_POLICY,
             DROP_PREFILTER: REASON_PREFILTER,
             DROP_NO_SERVICE: REASON_NO_SERVICE,
+            DROP_DEGRADED: REASON_PIPELINE_DEGRADED,
         }
 
         def _reason(i: int) -> int:
@@ -1368,7 +1629,13 @@ class DatapathPipeline:
                 return default
             try:
                 return bool(self.endpoint_options(ep_id, name, default))
-            except Exception:
+            except Exception as e:
+                # classified (policyd-failsafe): a transient/poisoned
+                # resolver fault degrades to the default — but a
+                # programmer error in the resolver is a bug and must
+                # surface, not silently un-gate event emission
+                if _faults.classify(e) == _faults.KIND_ERROR:
+                    raise
                 return default
 
         for i in np.nonzero(verdict >= DROP_POLICY)[0]:
@@ -1420,7 +1687,7 @@ class DatapathPipeline:
         series so hot shards are visible."""
         _metrics.verdict_batches.inc({"path": "pipeline"})
         if shard_of is None:
-            counts = np.bincount(verdict.astype(np.int64), minlength=5)
+            counts = np.bincount(verdict.astype(np.int64), minlength=6)
             for code, outcome in _OUTCOME_NAMES:
                 n = int(counts[code])
                 if n:
@@ -1428,7 +1695,7 @@ class DatapathPipeline:
             return
         for d in np.unique(shard_of):
             counts = np.bincount(
-                verdict[shard_of == d].astype(np.int64), minlength=5
+                verdict[shard_of == d].astype(np.int64), minlength=6
             )
             for code, outcome in _OUTCOME_NAMES:
                 n = int(counts[code])
@@ -1469,6 +1736,7 @@ class DatapathPipeline:
             ("no-l3-match", pol & ~deny & ~l4x),
             ("prefilter", verdict == DROP_PREFILTER),
             ("no-service", verdict == DROP_NO_SERVICE),
+            ("pipeline-degraded", verdict == DROP_DEGRADED),
         ):
             n = int(np.count_nonzero(mask))
             if n:
@@ -1498,6 +1766,7 @@ class DatapathPipeline:
 
         from ..monitor.events import (
             REASON_NO_SERVICE,
+            REASON_PIPELINE_DEGRADED,
             REASON_POLICY_DENY,
             REASON_POLICY_NO_L3,
             REASON_POLICY_NO_L4,
@@ -1528,6 +1797,8 @@ class DatapathPipeline:
                 reason = REASON_PREFILTER
             elif code == DROP_NO_SERVICE:
                 reason = REASON_NO_SERVICE
+            elif code == DROP_DEGRADED:
+                reason = REASON_PIPELINE_DEGRADED
             elif code == DROP_POLICY:
                 if ri >= 0:
                     reason = REASON_POLICY_DENY
@@ -1555,7 +1826,13 @@ class DatapathPipeline:
                     return ()
                 try:
                     return tuple(labels_of(ident))
-                except Exception:
+                except Exception as e:
+                    # classified (policyd-failsafe): degrade to
+                    # unlabeled records on environmental faults only —
+                    # a buggy resolver surfaces instead of silently
+                    # stripping every flow record's labels
+                    if _faults.classify(e) == _faults.KIND_ERROR:
+                        raise
                     return ()
 
             # flow orientation: ingress = peer → endpoint, egress =
@@ -1645,6 +1922,8 @@ class DatapathPipeline:
         rung buffers the pad half wrote into, for release at the host
         pull; padded rungs then cost four memcpys instead of four
         np.pad allocations."""
+        if _faults.hub.active:
+            _faults.hub.check(_faults.SITE_H2D)
         pb = peer_bytes[lo:hi]
         ei = ep_idx[lo:hi]
         dp = dports[lo:hi]
@@ -1696,6 +1975,132 @@ class DatapathPipeline:
             n_rules=n_rules,
         )
 
+    # -- policyd-failsafe: ladder level 2 (host fallback) ---------------
+    def _host_tables(self, direction: int) -> Optional[Tuple]:
+        """Host numpy copy of one direction's policymap columns/bitmaps,
+        cached on the source object (the _replicated_policymap pattern).
+        The pull itself touches the device — on a dead backend it fails
+        classified, and the caller falls through to policy synthesis."""
+        mat = self._mat.get(direction)
+        if mat is None:
+            return None
+        pm = mat.tables
+        src, ht = self._host_pm.get(direction, (None, None))
+        if src is pm:
+            return ht
+        try:
+            ht = (
+                np.asarray(pm.col_ep),
+                np.asarray(pm.col_port),
+                np.asarray(pm.col_proto),
+                np.asarray(pm.col_is_l3).astype(bool),
+                np.asarray(pm.id_bits),
+            )
+        except BaseException as e:
+            if _faults.classify(e) == _faults.KIND_ERROR:
+                raise
+            return None
+        self._host_pm[direction] = (pm, ht)
+        return ht
+
+    def _host_verdicts(
+        self, peer_bytes, ep_idx, dports, protos, *, ingress, family,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Numpy mirror of the device verdict path (the ladder's last
+        rung). Identity resolution goes through the HOST ipcache — the
+        authoritative source the device tries are built FROM — instead
+        of mirroring the LPM walk bit-for-bit; the policymap decision
+        mirrors ops/lookup.lookup_batch exactly (colsel → allow/red).
+        O(B · C) numpy plus an O(B) python ipcache walk: an emergency
+        path that keeps verdicts flowing, not a fast path. When even
+        the host tables are unreachable, falls back to pure policy
+        synthesis (FailOpen → forward, fail-closed → DROP_DEGRADED)."""
+        import ipaddress as _ipa
+
+        b = peer_bytes.shape[0]
+        direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
+        ht = self._host_tables(direction)
+        if ht is None:
+            v = np.full(
+                b, FORWARD if self._fail_open else DROP_DEGRADED, np.int8
+            )
+            if not self._fail_open and b:
+                _metrics.drop_reasons_total.inc(
+                    {"reason": "pipeline-degraded"}, float(b)
+                )
+            return v, np.zeros(b, bool)
+        col_ep, col_port, col_proto, col_is_l3, id_bits = ht
+        addrs = [
+            _ipa.ip_address(bytes(int(x) & 0xFF for x in peer_bytes[i]))
+            for i in range(b)
+        ]
+        idents = np.empty(b, np.int64)
+        for i, a in enumerate(addrs):
+            e = self.ipcache.lookup_by_ip(str(a))
+            idents[i] = ID_WORLD if e is None else e.identity
+        rows = np.asarray(self.engine.rows_or_negative(idents))
+        world = np.asarray(
+            self.engine.rows_or_negative(np.array([ID_WORLD], np.int64))
+        )[0]
+        rows = np.where(rows < 0, world, rows).astype(np.int64)
+        # prefilter deny (ingress only, like the device pf stage)
+        pf_drop = np.zeros(b, bool)
+        if ingress:
+            _, pf_cidrs = self.prefilter.dump()
+            nets = [
+                _ipa.ip_network(c)
+                for c in pf_cidrs
+                if (":" in c) == (family == 6)
+            ]
+            if nets:
+                for i, a in enumerate(addrs):
+                    pf_drop[i] = any(a in n for n in nets)
+        w = id_bits.shape[1] // 2
+        gathered = id_bits[np.clip(rows, 0, id_bits.shape[0] - 1)]
+        shifts = np.arange(32, dtype=np.uint32)
+        both = (
+            ((gathered[:, :, None] >> shifts) & np.uint32(1))
+            .astype(bool)
+            .reshape(b, -1)
+        )
+        c = col_ep.shape[0]
+        allow_bits = both[:, : w * 32][:, :c]
+        red_bits = both[:, w * 32:][:, :c]
+        ep = np.asarray(ep_idx, np.int64)
+        colsel = (ep[:, None] == col_ep[None, :]) & (
+            col_is_l3[None, :]
+            | (
+                (np.asarray(dports)[:, None] == col_port[None, :])
+                & (np.asarray(protos)[:, None] == col_proto[None, :])
+            )
+        )
+        hit = colsel & allow_bits
+        allow = hit.any(axis=1)
+        red = (hit & red_bits).any(axis=1)
+        v = np.where(allow, np.int8(FORWARD), np.int8(DROP_POLICY))
+        v = np.where(pf_drop, np.int8(DROP_PREFILTER), v).astype(np.int8)
+        return v, (red & (v == FORWARD))
+
+    def _host_enqueue(
+        self, peer_bytes, ep_idx, dports, protos, *, ingress, family, bt,
+    ) -> _Enqueued:
+        """_dispatch_enqueue stand-in at ladder level 2: the "dispatch"
+        phase computes on host numpy and the _Enqueued carries finished
+        results — _dispatch_complete returns them without touching the
+        device. Shapes/ordering of the completion half are unchanged so
+        the FIFO queue, CT create, counters, and events all run as
+        usual over host-produced verdicts."""
+        with bt.phase("dispatch"):
+            v, red = self._host_verdicts(
+                peer_bytes, ep_idx, dports, protos,
+                ingress=ingress, family=family,
+            )
+        attrib = self._dp_state[5] is not None
+        return _Enqueued(
+            (), [], peer_bytes.shape[0], False, 1,
+            attrib=attrib, host=(v, red),
+        )
+
     def _dispatch_enqueue(
         self,
         peer_bytes: np.ndarray,
@@ -1715,6 +2120,15 @@ class DatapathPipeline:
         runs after successor batches were enqueued, so device execution
         hides behind their host prep."""
         direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
+        if self._ladder_level >= 2:
+            # host fallback (ladder level 2): verdict on host numpy,
+            # synchronously — there is no device work to overlap
+            return self._host_enqueue(
+                peer_bytes, ep_idx, dports, protos,
+                ingress=ingress, family=family, bt=bt,
+            )
+        if _faults.hub.active:
+            _faults.hub.check(_faults.SITE_DISPATCH)
         # ONE atomic snapshot read: tables + flags + sharding +
         # attribution swap together in rebuild(), so fused-ness,
         # placement, and the rule table always match the tables they
@@ -1811,6 +2225,24 @@ class DatapathPipeline:
         l4_covered, hits) — the attribution d2h pulls live HERE, in
         the completion half, so PR 3's enqueue/complete overlap is
         preserved."""
+        if enq.host is not None:
+            # ladder level 2: verdicts were computed on host at enqueue
+            v, red = enq.host
+            if not enq.attrib:
+                return v, red, None
+            # host fallback carries no per-rule attribution — report
+            # "no rule decided" (-1) so rule_hits_total only ever
+            # counts real device attributions
+            b = enq.b
+            return (
+                v, red, None,
+                np.full(b, -1, np.int32), np.zeros(b, bool), None,
+            )
+        if _faults.hub.active:
+            # the injected "complete" fault fires BEFORE the pull — the
+            # retry soundness argument in _finish_guarded relies on the
+            # transient window preceding any host-state mutation
+            _faults.hub.check(_faults.SITE_COMPLETE)
         if self.tracer.active:
             _metrics.device_transfers_total.inc(
                 {"direction": "d2h"},
@@ -1901,9 +2333,13 @@ class DatapathPipeline:
             else 0
         )
         try:
-            inf.pending._value = inf.finish()
-        except BaseException as e:
-            inf.pending._exc = e
+            # classified completion (policyd-failsafe): transient
+            # faults retry bounded, poisoned batches quarantine into a
+            # degraded RESULT, and only programmer errors come back as
+            # an exception for result() to surface raw
+            value, exc = self._finish_guarded(inf)
+            inf.pending._value = value
+            inf.pending._exc = exc
         finally:
             inf.pending._event.set()
             if inf.bt is not _NOOP_BATCH:
@@ -1970,17 +2406,51 @@ class DatapathPipeline:
             )
         else:
             bt = _NOOP_BATCH
-        try:
-            inf = self._submit_inner(
-                peer_bytes, ep_idx, dports, protos, sports,
-                ingress=ingress, family=family, peer_words=peer_words,
-                want_rev_nat=want_rev_nat,
-                tunnel_identities=tunnel_identities, bt=bt,
-            )
-        except BaseException:
-            if bt is not _NOOP_BATCH:
-                bt.end(self.monitor)
-            raise
+        # classified enqueue (policyd-failsafe): a fault in the enqueue
+        # half (rebuild / h2d / async dispatch) retries bounded on
+        # transient, then resolves DEGRADED — the caller always gets a
+        # PendingBatch whose result() carries a verdict per flow.
+        # Programmer errors still raise raw (pre-failsafe contract).
+        attempt = 0
+        bo: Optional[Backoff] = None
+        while True:
+            try:
+                inf = self._submit_inner(
+                    peer_bytes, ep_idx, dports, protos, sports,
+                    ingress=ingress, family=family, peer_words=peer_words,
+                    want_rev_nat=want_rev_nat,
+                    tunnel_identities=tunnel_identities, bt=bt,
+                )
+                break
+            except BaseException as e:
+                kind = _faults.classify(e)
+                if kind == _faults.KIND_ERROR:
+                    if bt is not _NOOP_BATCH:
+                        bt.end(self.monitor)
+                    raise
+                self._note_fault(e, kind)
+                if (
+                    kind == _faults.KIND_TRANSIENT
+                    and attempt < self.retry_limit
+                ):
+                    attempt += 1
+                    if bo is None:
+                        bo = Backoff(
+                            min_s=self.retry_min_s, max_s=self.retry_max_s,
+                            jitter=False,
+                        )
+                    time.sleep(bo.duration())
+                    continue
+                if bt is not _NOOP_BATCH:
+                    bt.end(self.monitor)
+                pending = PendingBatch(self)
+                shell = _InFlight(
+                    pending, None, bt,
+                    b=peer_bytes.shape[0], rev=want_rev_nat,
+                )
+                pending._value = self._quarantine(shell)
+                pending._event.set()
+                return pending
         if bt is not _NOOP_BATCH:
             tr.detach(bt)
         if inf.finish is None:
@@ -2081,6 +2551,7 @@ class DatapathPipeline:
         # overlay tunnel identities.
         if (
             self._device_ct_bits is not None
+            and self._ladder_level < 2
             and sports is not None
             and svc_drop is None
             and row_override is None
@@ -2173,7 +2644,7 @@ class DatapathPipeline:
                     return v, red, np.zeros(b, np.uint16)
                 return v, red
 
-            return _InFlight(pending, finish, bt)
+            return _InFlight(pending, finish, bt, b=b, rev=want_rev_nat)
 
         # --- conntrack pre-pass (vectorized host) ----------------------
         with bt.phase("ct_prepass"):
@@ -2330,7 +2801,7 @@ class DatapathPipeline:
                 return verdict, redirect, ct_rev
             return verdict, redirect
 
-        return _InFlight(pending, finish, bt)
+        return _InFlight(pending, finish, bt, b=b, rev=want_rev_nat)
 
     def _process_device_ct(
         self,

@@ -504,7 +504,15 @@ class PolicyEngine:
                     self._install_compiled(*result)
             except Exception as e:
                 # a failed background compile leaves the restored
-                # tables serving; the next refresh() retries
+                # tables serving; the next refresh() retries. Only
+                # environmental failures are absorbed — a programmer
+                # error (classified KIND_ERROR) re-raises and kills
+                # this thread loudly via threading.excepthook instead
+                # of hiding a TypeError behind a warning forever
+                from . import faults as _faults
+
+                if _faults.classify(e) == _faults.KIND_ERROR:
+                    raise
                 from .utils.logging import get_logger
 
                 get_logger("engine").warning(

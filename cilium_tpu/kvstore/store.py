@@ -23,6 +23,7 @@ from .backend import (
     EventTypeListDone,
     Watcher,
 )
+from .. import faults as _faults
 
 
 class SharedStore:
@@ -61,6 +62,16 @@ class SharedStore:
     def pump(self) -> int:
         """Apply pending watch events to the shared view; fires
         observers. Returns events applied."""
+        if _faults.hub.active:
+            try:
+                _faults.hub.check(_faults.SITE_KVSTORE)
+            except _faults.FaultError as e:
+                if e.kind == _faults.KIND_POISONED:
+                    raise
+                # transient partition: events stay queued in the
+                # watcher and apply on the next pump — the replicated
+                # view is eventually consistent by design
+                return 0
         n = 0
         for ev in self._watcher.drain():
             n += 1

@@ -250,6 +250,27 @@ autotune_adjustments_total = registry.counter(
     "(label direction: up|down)",
 )
 
+# -- policyd-failsafe (fault injection + degradation ladder) families ------
+pipeline_faults_total = registry.counter(
+    "cilium_tpu_pipeline_faults_total",
+    "Classified verdict-path faults (labels: site = the stable "
+    "cilium_tpu/faults.py site set, kind = transient|poisoned; counts "
+    "injected faults at injection time and real classified errors at "
+    "handling time)",
+)
+degradations_total = registry.counter(
+    "cilium_tpu_pipeline_degradations_total",
+    "Degradation-ladder transitions (labels from/to: "
+    "sharded|single-device|host; re-promotions count too — a recovery "
+    "probe is a transition back up)",
+)
+pipeline_mode = registry.gauge(
+    "cilium_tpu_pipeline_mode",
+    "Current verdict-path ladder level: 0 = full device complement "
+    "(sharded when VerdictSharding is on), 1 = single-device (mesh "
+    "re-formed excluding faulted devices), 2 = host/numpy fallback",
+)
+
 # -- policyd-flows (verdict attribution) families -------------------------
 rule_hits_total = registry.counter(
     "cilium_tpu_rule_hits_total",

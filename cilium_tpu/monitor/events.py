@@ -40,6 +40,9 @@ REASON_POLICY_DENY = 151  # an explicit deny rule matched
 REASON_POLICY_NO_L3 = 152  # no L3 allow covered the peer
 REASON_POLICY_NO_L4 = 153  # L4 coverage existed, peer not allowed
 REASON_PROXY_REDIRECT = 154  # allowed, but diverted to the L7 proxy
+# policyd-failsafe: the pipeline could not verdict the batch (device
+# fault exhausted its retries) and FailOpen is off — fail-closed deny
+REASON_PIPELINE_DEGRADED = 155
 
 _REASON_NAMES = {
     REASON_POLICY: "Policy denied",
@@ -51,6 +54,7 @@ _REASON_NAMES = {
     REASON_POLICY_NO_L3: "Policy denied (no L3 allow)",
     REASON_POLICY_NO_L4: "Policy denied (no L4 allow)",
     REASON_PROXY_REDIRECT: "Proxy redirect (L7)",
+    REASON_PIPELINE_DEGRADED: "Pipeline degraded (fail-closed)",
 }
 
 # trace observation points (pkg/monitor/datapath_trace.go TraceTo*)

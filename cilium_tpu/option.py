@@ -131,6 +131,20 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
             "verdict-pipeline-max-depth from per-batch enqueue/complete "
             "timings; off keeps the static configured depth",
         ),
+        OptionSpec(
+            "FailOpen",
+            "Degraded-mode verdict policy (policyd-failsafe): when the "
+            "pipeline cannot resolve a batch (quarantine, ladder "
+            "exhaustion), forward instead of the default fail-closed "
+            "deny with drop reason pipeline-degraded (155)",
+        ),
+        OptionSpec(
+            "FaultInjection",
+            "Enable the cilium_tpu/faults.py hub: deterministic, seeded "
+            "fault injection at the named verdict-path sites (h2d, "
+            "dispatch, complete, ct_epoch, kvstore, attach); off keeps "
+            "the hot path at one attribute read per site",
+        ),
     )
 }
 

@@ -20,6 +20,12 @@ class HpackError(Exception):
     pass
 
 
+# Ceiling on cumulative DECODED header bytes per block (names + values
+# after huffman/table expansion) — the HPACK-bomb guard. Mirrors the
+# reference proxy's default max header list size.
+MAX_DECODED_HEADER_BYTES = 1 << 16
+
+
 # RFC 7541 Appendix A: the static table (1-based).
 STATIC_TABLE: List[Tuple[bytes, bytes]] = [
     (b":authority", b""),
@@ -175,16 +181,19 @@ _DECODE_TREE = _build_decode_tree()
 
 
 def huffman_decode(data: bytes) -> bytes:
-    """RFC 7541 §5.2. Padding must be the EOS prefix (all 1s, < 8
-    bits); anything else — including a full EOS symbol — is an error."""
+    """RFC 7541 §5.2. Padding must be the EOS prefix (all 1s) and
+    STRICTLY shorter than 8 bits; anything else — a 0 bit, a full EOS
+    symbol, or ≥8 all-ones bits — is an error."""
     out = bytearray()
     node = _DECODE_TREE
     pad_ok = True  # only-1s since last symbol boundary
+    pad_bits = 0  # bits consumed since last symbol boundary
     for byte in data:
         for i in range(7, -1, -1):
             bit = (byte >> i) & 1
             if bit == 0:
                 pad_ok = False
+            pad_bits += 1
             nxt = node[bit]
             if nxt is None:
                 raise HpackError("invalid huffman code")
@@ -194,14 +203,16 @@ def huffman_decode(data: bytes) -> bytes:
                 out.append(nxt)
                 node = _DECODE_TREE
                 pad_ok = True
+                pad_bits = 0
             else:
                 node = nxt
     if not pad_ok:
         raise HpackError("huffman padding contains 0 bits")
-    if node is not _DECODE_TREE:
-        # mid-symbol: legal only as ≤7 bits of EOS prefix, which the
-        # pad_ok check above already guarantees
-        pass
+    if node is not _DECODE_TREE and pad_bits >= 8:
+        # ≥8 all-ones trailing bits decode as an EOS prefix too, but
+        # §5.2 says padding "strictly less than 8 bits" — longer runs
+        # MUST be treated as a decoding error (EOS-prefix smuggling)
+        raise HpackError("huffman padding of 8 or more bits")
     return bytes(out)
 
 
@@ -300,12 +311,15 @@ class HpackDecoder:
 
     def decode(self, data: bytes) -> List[Tuple[bytes, bytes]]:
         headers: List[Tuple[bytes, bytes]] = []
+        decoded = 0  # cumulative DECODED bytes (HPACK-bomb guard)
         pos = 0
         while pos < len(data):
             b = data[pos]
             if b & 0x80:  # indexed field
                 index, pos = decode_int(data, pos, 7)
-                headers.append(self._entry(index))
+                entry = self._entry(index)
+                decoded += len(entry[0]) + len(entry[1])
+                headers.append(entry)
             elif b & 0x40:  # literal with incremental indexing
                 index, pos = decode_int(data, pos, 6)
                 if index:
@@ -314,6 +328,7 @@ class HpackDecoder:
                     name, pos = self._read_string(data, pos)
                 value, pos = self._read_string(data, pos)
                 self._add(name, value)
+                decoded += len(name) + len(value)
                 headers.append((name, value))
             elif b & 0x20:  # dynamic table size update
                 size, pos = decode_int(data, pos, 5)
@@ -330,7 +345,17 @@ class HpackDecoder:
                 else:
                     name, pos = self._read_string(data, pos)
                 value, pos = self._read_string(data, pos)
+                decoded += len(name) + len(value)
                 headers.append((name, value))
+            if decoded > MAX_DECODED_HEADER_BYTES:
+                # the wire bytes are small; the EXPANSION is the bomb
+                # (huffman + table references amplify ~100×). Checked
+                # after every field so the cap bounds peak memory, not
+                # just the returned list. → COMPRESSION_ERROR upstream.
+                raise HpackError(
+                    f"decoded header list exceeds "
+                    f"{MAX_DECODED_HEADER_BYTES} bytes"
+                )
         return headers
 
 

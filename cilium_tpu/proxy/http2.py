@@ -91,6 +91,10 @@ MAX_FRAME_SIZE = 16384
 # header blocks (HEADERS + CONTINUATIONs) are capped too — the 2024
 # CONTINUATION-flood pattern grows the block forever otherwise
 MAX_HEADER_BLOCK = 1 << 17
+# the SETTINGS_MAX_CONCURRENT_STREAMS value we advertise — and, since
+# the advertisement alone is advisory, also ENFORCE: stream N+1 gets
+# RST_STREAM(REFUSED_STREAM) instead of an unbounded streams dict
+MAX_CONCURRENT_STREAMS = 256
 
 
 def read_frame(
@@ -370,7 +374,7 @@ class H2ServerConnection(_ConnBase):
             settings_payload({
                 SETTINGS_ENABLE_PUSH: 0,
                 SETTINGS_INITIAL_WINDOW_SIZE: OUR_WINDOW,
-                SETTINGS_MAX_CONCURRENT_STREAMS: 256,
+                SETTINGS_MAX_CONCURRENT_STREAMS: MAX_CONCURRENT_STREAMS,
             }),
         )
         # grow the connection window beyond the 64KB default
@@ -510,7 +514,16 @@ class H2ServerConnection(_ConnBase):
             if sid not in self.streams:
                 if sid > self._last_sid:  # genuinely new stream
                     self._last_sid = sid
-                    self.streams[sid] = H2Stream(sid)
+                    if len(self.streams) >= MAX_CONCURRENT_STREAMS:
+                        # we advertised this ceiling in SETTINGS; a
+                        # peer exceeding it gets RST_STREAM(REFUSED_
+                        # STREAM) per RFC 9113 §5.1.2 — but the block
+                        # is still DECODED below (HPACK state is
+                        # connection-wide; skipping it desyncs the
+                        # dynamic table for every later stream)
+                        self.reset(sid, ERR_REFUSED_STREAM)
+                    else:
+                        self.streams[sid] = H2Stream(sid)
                 # else: frames for a closed/pruned id — still DECODE
                 # the block (HPACK state is connection-wide) but the
                 # fields are discarded in _headers_complete
@@ -718,6 +731,10 @@ class H2ClientConnection(_ConnBase):
         if ftype == FRAME_HEADERS:
             body = _strip_padding(flags, payload)
             if flags & FLAG_PRIORITY:
+                if len(body) < 5:
+                    # a short frame here would silently decode an
+                    # EMPTY header block instead of erroring
+                    raise H2Error("short priority block")
                 body = body[5:]
             self._headers_buf = body
             self._headers_end_stream = bool(flags & FLAG_END_STREAM)

@@ -245,7 +245,11 @@ class TestSurfaces:
                            "in_flight": 0,
                            "flow_attribution": False,
                            "autotune": None,
+                           "failsafe": d.pipeline.failsafe_state(),
                            "traces": []}
+            # healthy baseline: the failsafe block reports level 0
+            assert out["failsafe"]["mode"] == "sharded"
+            assert out["failsafe"]["degraded"] is False
             d.config_patch({"PhaseTracing": True})
             assert d.pipeline.tracer.active
             d.config_patch({"PhaseTracing": False})
