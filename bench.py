@@ -1067,21 +1067,15 @@ def _bench_native_l7() -> float:
     return iters * b / (time.time() - t0)
 
 
-def _bench_stretch() -> dict:
-    """The north-star stretch config (BASELINE.json configs[4]):
-    100k identities × 100k rules, 64 endpoints — the reference's full
-    identity envelope (pkg/identity/allocator.go:77-78) merged with
-    local/CIDR identities in the high range, at 10× its per-endpoint
-    rule scale. Reports compile + full-materialize time and sustained
-    verdicts/s on the materialized policymap."""
+def _stretch_world(n_rules: int, n_ids: int):
+    """The stretch-config world generator (BASELINE.json configs[4]) at
+    a parameterized scale — shared by --stretch inside the full sweep
+    and the 100k leg of --updates."""
     import random as _random
 
-    from cilium_tpu.engine import PolicyEngine as _PE
     from cilium_tpu.identity import IdentityRegistry as _IR
     from cilium_tpu.policy.repository import Repository as _Repo
 
-    n_rules = int(os.environ.get("BENCH_STRETCH_RULES", 100_000))
-    n_ids = int(os.environ.get("BENCH_STRETCH_IDS", 100_000))
     rng = _random.Random(1)
     repo = _Repo()
     rules = []
@@ -1119,6 +1113,21 @@ def _bench_stretch() -> dict:
         idents.append(
             reg.allocate(parse_label_array(labels), local=len(idents) >= 65000)
         )
+    return repo, reg, idents
+
+
+def _bench_stretch() -> dict:
+    """The north-star stretch config (BASELINE.json configs[4]):
+    100k identities × 100k rules, 64 endpoints — the reference's full
+    identity envelope (pkg/identity/allocator.go:77-78) merged with
+    local/CIDR identities in the high range, at 10× its per-endpoint
+    rule scale. Reports compile + full-materialize time and sustained
+    verdicts/s on the materialized policymap."""
+    from cilium_tpu.engine import PolicyEngine as _PE
+
+    n_rules = int(os.environ.get("BENCH_STRETCH_RULES", 100_000))
+    n_ids = int(os.environ.get("BENCH_STRETCH_IDS", 100_000))
+    repo, reg, idents = _stretch_world(n_rules, n_ids)
 
     engine = _PE(repo, reg)
     t0 = time.time()
@@ -1200,6 +1209,156 @@ def _bench_stretch() -> dict:
         "selectors": compiled.num_selectors,
         "rows": int(compiled.id_bits.shape[0]),
         "allow_fraction": round(float((np.asarray(dec) == 1).mean()), 4),
+    }
+
+
+def _bench_updates(repo, reg, idents) -> dict:
+    """policyd-delta churn round (--updates): update-latency
+    percentiles with the O(delta) refresh paths live. Samples are
+    DEVICE-BLOCKING via engine.wait_device() — refresh() itself never
+    blocks on the device (the coalesced _set_rows2 / CSR column
+    scatters are enqueue-only), so the wait is the true device RTT of
+    the delta — and the pipeline leg is measured through the REAL
+    rebuild() so what's timed is the delta routing: row patches,
+    patch_endpoints_state column patches, and an epoch-swapped full
+    rebuild."""
+    from cilium_tpu.datapath.pipeline import DatapathPipeline
+    from cilium_tpu.ipcache.ipcache import IPCache
+    from cilium_tpu.labels import parse_label_array as _pla
+
+    engine = PolicyEngine(repo, reg)
+    engine.refresh()
+    engine.wait_device()
+    pipe = DatapathPipeline(engine, IPCache())
+    pipe.set_endpoints([i.id for i in idents[:N_ENDPOINTS]])
+    pipe.rebuild()
+
+    def pcts(samples):
+        s = sorted(samples)
+        return (
+            round(s[len(s) // 2] * 1000, 2),
+            round(s[min(len(s) - 1, int(len(s) * 0.99))] * 1000, 2),
+        )
+
+    # Warm every measured path once (jit the row/column patch kernels
+    # + the sweep): percentiles should report steady-state churn, not
+    # first-compile cost — the sweep idiom of the main bench.
+    warm_ident = reg.allocate(_pla(["k8s:app=a1", "k8s:env=updwarm"]))
+    engine.refresh()
+    engine.wait_device()
+    pipe.rebuild()
+    reg.release(warm_ident)
+    engine.refresh()
+    engine.wait_device()
+    pipe.rebuild()
+    warm_rule = rule(
+        ["k8s:app=a1"],
+        ingress=[IngressRule(
+            from_endpoints=(EndpointSelector.make(["k8s:app=a2"]),),
+        )],
+        labels=["k8s:policy=updwarm"],
+    )
+    repo.add_list([warm_rule])
+    engine.refresh()
+    engine.wait_device()
+    pipe.rebuild()
+    repo.delete_by_labels(_pla(["k8s:policy=updwarm"]))
+    engine.refresh()
+    engine.wait_device()
+    pipe.rebuild()
+
+    # identity churn: allocate → refresh (coalesced row-delta enqueue)
+    # → wait_device; restore between samples so row-capacity crossings
+    # can't skew the series (the _bench_ident_update discipline)
+    ident_s = []
+    for i in range(20):
+        labels = _pla([f"k8s:app=a{i % 512}", "k8s:env=updbench"])
+        t0 = time.perf_counter()
+        ident = reg.allocate(labels)
+        engine.refresh()
+        engine.wait_device()
+        ident_s.append(time.perf_counter() - t0)
+        reg.release(ident)
+        engine.refresh()
+        engine.wait_device()
+    ident_p50, ident_p99 = pcts(ident_s)
+    # Drain the 40 accumulated row deltas into one coalesced
+    # patch_identity_rows replay (unmeasured) so the rule-loop
+    # percentiles time pure column patches — without this the first
+    # rule rebuild also pays the whole ident backlog's re-sweep.
+    pipe.rebuild()
+
+    # single-rule append: engine-side in-place matrix append + CSR
+    # sel_match window scatter, then the pipeline's O(delta) column
+    # patch; patch_hits counts rebuilds that kept the MaterializedState
+    # objects (i.e. actually took patch_endpoints_state, not a full
+    # re-materialization)
+    rng = random.Random(77)
+    rule_s, delta_s = [], []
+    patch_hits = 0
+    n_rule_samples = 12
+    # i == -1 peels one full iteration of the exact measured body as a
+    # discard: the first column patch jit-compiles the sweep at the
+    # patch segment-bucket shape (a shape the L3-only warm rule above
+    # does not produce), and that one-time compile would otherwise BE
+    # the p99
+    for i in range(-1, n_rule_samples):
+        r = rule(
+            [f"k8s:app=a{rng.randrange(512)}"],
+            ingress=[IngressRule(
+                from_endpoints=(
+                    EndpointSelector.make([f"k8s:app=a{rng.randrange(512)}"]),
+                ),
+            )],
+            labels=[f"k8s:policy=updbench-{i}"],
+        )
+        t0 = time.perf_counter()
+        repo.add_list([r])
+        engine.refresh()
+        engine.wait_device()
+        if i >= 0:
+            rule_s.append(time.perf_counter() - t0)
+        base = dict(pipe._mat)
+        t0 = time.perf_counter()
+        pipe.rebuild()
+        if i >= 0:
+            delta_s.append(time.perf_counter() - t0)
+            if all(pipe._mat.get(d) is base[d] for d in base):
+                patch_hits += 1
+        repo.delete_by_labels(_pla([f"k8s:policy=updbench-{i}"]))
+        engine.refresh()
+        engine.wait_device()
+        pipe.rebuild()
+    rule_p50, rule_p99 = pcts(rule_s)
+    delta_p50, delta_p99 = pcts(delta_s)
+
+    # epoch swap: a forced full recompile served through the shadow
+    # thread — wall time from the kicking rebuild() to the publishing
+    # one. Dispatches would keep verdicting the old generation for all
+    # but the final publish instant.
+    pipe.set_epoch_swap(True)
+    engine.refresh(force=True)
+    t0 = time.perf_counter()
+    pipe.rebuild()  # kicks the shadow, keeps serving
+    swapped = pipe.wait_epoch_swap(600)
+    pipe.rebuild()  # the batch-boundary publish
+    epoch_swap_ms = (time.perf_counter() - t0) * 1000
+    pipe.set_epoch_swap(False)
+
+    return {
+        "identities": len(idents),
+        "rules": len(repo),
+        "update_ident_p50_ms": ident_p50,
+        "update_ident_p99_ms": ident_p99,
+        "update_rule_p50_ms": rule_p50,
+        "update_rule_p99_ms": rule_p99,
+        "delta_materialize_ms": delta_p50,
+        "delta_materialize_p99_ms": delta_p99,
+        "delta_patch_hits": patch_hits,
+        "delta_patch_samples": n_rule_samples,
+        "epoch_swap_ms": round(epoch_swap_ms, 1),
+        "epoch_swap_completed": bool(swapped),
+        "policy_epoch": pipe.policy_epoch,
     }
 
 
@@ -1482,6 +1641,31 @@ def main() -> None:
             "value": out["recovery_s"],
             "unit": "s",
             **out,
+            "backend": backend,
+            "build_s": round(t_build, 2),
+        }))
+        return
+
+    if "--updates" in sys.argv[1:]:
+        # policyd-delta round: churn latency percentiles at 10k scale
+        # (the built world) and, unless BENCH_STRETCH=0, at the 100k
+        # stretch scale — the round driver tracks the <10ms
+        # update_ident target per round from these
+        out10 = _bench_updates(repo, reg, idents)
+        out100 = {}
+        if os.environ.get("BENCH_STRETCH", "1") != "0":
+            srepo, sreg, sidents = _stretch_world(
+                int(os.environ.get("BENCH_STRETCH_RULES", 100_000)),
+                int(os.environ.get("BENCH_STRETCH_IDS", 100_000)),
+            )
+            out100 = _bench_updates(srepo, sreg, sidents)
+        attached.set()
+        print(json.dumps({
+            "metric": f"policy update latency at {N_RULES} rules",
+            "value": out10["update_ident_p50_ms"],
+            "unit": "ms",
+            **out10,
+            "scale_100k": out100,
             "backend": backend,
             "build_s": round(t_build, 2),
         }))

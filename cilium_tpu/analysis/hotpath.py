@@ -26,6 +26,17 @@ TPU003  jit-decorated function closes over a mutable module-level
 TPU004  dtype-literal drift: a matmul (``@``, ``jnp.matmul``,
         ``jnp.dot``, ``lax.dot_general``) whose two operands are cast
         to different integer/float dtype literals.
+TPU005  synchronous host pull on the engine refresh path: inside a
+        function marked ``# policyd: refresh-path`` (the comment sits
+        on the line above the def or its first decorator), a
+        ``block_until_ready`` call, an ``.item()/.tolist()``, or an
+        ``np.asarray()/int()``-style coercion whose argument is
+        device-resident (a jnp/jax chain, a name or attribute chain
+        mentioning the device tables — ``sel_match``/``id_bits``/
+        ``rule_tab``/``*device*``). Each such pull is a full device
+        RTT *per call*; policyd-delta exists because a churny tick
+        multiplied exactly this cost — batch the pull or keep the
+        patch on device.
 ROBUST001  bare/broad ``except`` (no type, ``Exception``, or
         ``BaseException``) in a hot module whose handler neither
         re-raises nor routes through the ``faults.classify`` taxonomy
@@ -37,6 +48,7 @@ ROBUST001  bare/broad ``except`` (no type, ``Exception``, or
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from .core import (
@@ -66,6 +78,11 @@ MUTABLE_FACTORIES = {
     "list", "dict", "set", "bytearray", "deque", "defaultdict",
     "OrderedDict", "Counter",
 }
+# TPU005: the marker that opts a function into the refresh-path pull
+# audit, and the attribute names that identify the device-table state
+# (DeviceTables fields the engine scatters into).
+_REFRESH_RE = re.compile(r"#\s*policyd:\s*refresh-path\b")
+DEVICE_ATTRS = {"sel_match", "id_bits", "rule_tab"}
 
 
 class _Imports:
@@ -395,6 +412,148 @@ class _FuncTaint:
 # ---------------------------------------------------------------------------
 
 
+def _is_refresh_marked(mod: ModuleSource, func: ast.AST) -> bool:
+    """True when a ``# policyd: refresh-path`` comment sits in the
+    comment block immediately above ``func`` (above its first decorator
+    when decorated — the marker reads as documentation of the def, so
+    it goes where a docstring reader would look)."""
+    start = func.lineno
+    if func.decorator_list:
+        start = min(start, min(d.lineno for d in func.decorator_list))
+    i = start - 2  # 0-based index of the line above the def/decorator
+    while i >= 0:
+        text = mod.lines[i].strip()
+        if not text.startswith("#"):
+            return False
+        if _REFRESH_RE.search(text):
+            return True
+        i -= 1
+    return False
+
+
+class _RefreshPull:
+    """TPU005 walk: synchronous host pulls inside a refresh-marked
+    function.
+
+    Unlike TPU001 (which needs the value to *flow from* a jnp op in
+    the same function), the refresh path mostly pulls pre-existing
+    device state — ``np.asarray(self._device.sel_match)`` never touches
+    a jnp chain, so TPU001's taint can't see it. Here "device-resident"
+    means: a jnp/jax chain, a name/attr mentioning the device tables
+    (``*device*``, ``sel_match``/``id_bits``/``rule_tab``), or a local
+    assigned from one of those (light forward taint).
+    """
+
+    def __init__(
+        self,
+        mod: ModuleSource,
+        imports: _Imports,
+        func: ast.AST,
+        findings: List[Finding],
+    ) -> None:
+        self.mod = mod
+        self.imports = imports
+        self.findings = findings
+        self.tainted: Set[str] = set()
+        for stmt in func.body:
+            self._stmt(stmt)
+
+    def _devicey(self, expr: ast.AST) -> bool:
+        for n in walk_skipping(expr, (ast.FunctionDef, ast.Lambda)):
+            if isinstance(n, ast.Name):
+                if n.id in self.tainted or "device" in n.id.lower():
+                    return True
+            elif isinstance(n, ast.Attribute):
+                chain = attr_chain(n)
+                if chain is None:
+                    continue
+                if self.imports.is_device_chain(chain):
+                    return True
+                if any(
+                    part in DEVICE_ATTRS or "device" in part.lower()
+                    for part in chain
+                ):
+                    return True
+        return False
+
+    # -- walk (taint through plain Assigns; recurse into control flow) --
+    def _stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs are separate scopes (and unmarked)
+        for expr in ast.iter_child_nodes(stmt):
+            if isinstance(expr, ast.expr):
+                self._check_expr(expr)
+        for item in getattr(stmt, "items", []) or []:  # with-blocks
+            self._check_expr(item.context_expr)
+        if isinstance(stmt, ast.Assign):
+            names = [
+                n for t in stmt.targets for n in iter_target_names(t)
+            ]
+            if self._devicey(stmt.value):
+                self.tainted.update(names)
+            else:
+                self.tainted.difference_update(names)
+        for body in ("body", "orelse", "finalbody"):
+            for s in getattr(stmt, body, []) or []:
+                self._stmt(s)
+        for h in getattr(stmt, "handlers", []) or []:
+            for s in h.body:
+                self._stmt(s)
+
+    def _check_expr(self, expr: ast.AST) -> None:
+        for node in walk_skipping(expr, (ast.FunctionDef, ast.Lambda)):
+            if isinstance(node, ast.Call):
+                self._check_call(node)
+
+    def _check_call(self, node: ast.Call) -> None:
+        fchain = attr_chain(node.func)
+
+        # x.block_until_ready() / jax.block_until_ready(x): an explicit
+        # barrier is a pull by definition — no arg analysis needed.
+        if fchain and fchain[-1] == "block_until_ready":
+            self._emit(node, "block_until_ready()")
+            return
+
+        # device.sel_match.item() / .tolist() / .__array__()
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in SYNC_METHODS
+            and self._devicey(node.func.value)
+        ):
+            self._emit(node, f".{node.func.attr}()")
+            return
+
+        # np.asarray(device...) / int(device...)-style coercions
+        is_coercion = (
+            fchain is not None
+            and len(fchain) == 1
+            and fchain[0] in COERCIONS
+        )
+        is_np_pull = (
+            fchain is not None
+            and len(fchain) == 2
+            and fchain[0] in self.imports.np
+            and fchain[1] in NP_SYNC_FUNCS
+        )
+        if (is_coercion or is_np_pull) and node.args:
+            if self._devicey(node.args[0]):
+                self._emit(node, f"{'.'.join(fchain)}()")
+
+    def _emit(self, node: ast.AST, what: str) -> None:
+        self.findings.append(
+            self.mod.finding(
+                "TPU005",
+                SEV_ERROR,
+                node.lineno,
+                f"{what} on device state inside a refresh-path function "
+                "— every call is a full host-device RTT, and a churny "
+                "tick multiplies it (the policyd-delta failure mode); "
+                "coalesce the pull across the batch or keep the patch "
+                "on device",
+            )
+        )
+
+
 def _check_loops(
     mod: ModuleSource,
     imports: _Imports,
@@ -620,6 +779,8 @@ def analyze_hotpath(mod: ModuleSource) -> List[Finding]:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 _FuncTaint(mod, imports, jit_names, node, findings)
                 _check_loops(mod, imports, node, findings)
+                if _is_refresh_marked(mod, node):
+                    _RefreshPull(mod, imports, node, findings)
         _check_dtype_drift(mod, imports, mod.tree, findings)
         _check_broad_except(mod, findings)
     return findings

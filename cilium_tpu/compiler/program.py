@@ -611,6 +611,20 @@ def rule_origin_arrays(
     return deny_rule, allow_rule, combo_rule
 
 
+def subject_sids(rules: Sequence[Rule], table: SelectorTable) -> Tuple[int, ...]:
+    """Sorted, deduplicated subject-selector ids for a rule batch —
+    the delta-log payload bound (policyd-delta): every verdict term a
+    compile emits is gated on its rule's subject selector
+    (_extract_direction interns ``r.endpoint_selector`` as the ``subj``
+    of every deny/allow/entry cell), so these ids bound the policymap
+    COLUMNS an incremental append/delete can change, and
+    patch_endpoints_state only re-sweeps endpoints whose label sets
+    match one of them. Interning here is idempotent for already-compiled
+    rules: appends intern the same selector the compile is about to,
+    deletes hit selectors the original compile interned."""
+    return tuple(sorted({table.intern(r.endpoint_selector) for r in rules}))
+
+
 def _merge_raws(raws: Sequence[_RawDirection]) -> _RawDirection:
     """Concatenate per-rule raws into one batch raw, renumbering group
     ids globally (the shape the packer sizes its buckets from)."""

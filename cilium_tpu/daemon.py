@@ -106,6 +106,7 @@ class Daemon:
             sharding=cfg.verdict_sharding,
             flow_ring=FlowRing(capacity=cfg.flow_ring_capacity),
             pipeline_max_depth=cfg.verdict_pipeline_max_depth,
+            epoch_swap=cfg.policy_epoch_swap,
         )
         # ONE controller registry for the whole daemon (pkg/controller;
         # `cilium status --all-controllers` reads it) — the endpoint
@@ -162,6 +163,7 @@ class Daemon:
         # boot value rides DaemonConfig; the pipeline already took it
         # via its ctor, so seed the map BEFORE wiring on_change
         self.options.set("VerdictSharding", cfg.verdict_sharding)
+        self.options.set("EpochSwap", cfg.policy_epoch_swap)
         self.options.on_change(self._on_option_change)
         # fleet regeneration is synchronous by default (tests and
         # small deployments observe effects immediately); a busy node
@@ -759,7 +761,7 @@ class Daemon:
         {
             "Conntrack", "TraceNotification", "DropNotification", "Debug",
             "PhaseTracing", "VerdictSharding", "FlowAttribution",
-            "DispatchAutoTune", "FailOpen", "FaultInjection",
+            "DispatchAutoTune", "FailOpen", "FaultInjection", "EpochSwap",
         }
     )
 
@@ -801,6 +803,10 @@ class Daemon:
             # policyd-failsafe: what degraded mode returns — forward
             # (fail-open) vs the default deny with reason 155
             self.pipeline.set_fail_open(value)
+        elif name == "EpochSwap":
+            # policyd-delta: shadow-built full rebuilds swapped in at
+            # a batch boundary; off abandons any in-flight shadow
+            self.pipeline.set_epoch_swap(value)
         elif name == "FaultInjection":
             # policyd-failsafe: arm/disarm the injection hub; off keeps
             # rules queued so a re-enable resumes a chaos scenario

@@ -61,6 +61,7 @@ from ..ops.materialize import (
     TRAFFIC_EGRESS,
     TRAFFIC_INGRESS,
     materialize_endpoints_state,
+    patch_endpoints_state,
     patch_identity_rows,
 )
 from ..lb.device import flow_hash32, lb_translate
@@ -649,6 +650,7 @@ class DatapathPipeline:
         flow_ring: Optional[FlowRing] = None,
         pipeline_max_depth: int = 4,
         autotune: bool = False,
+        epoch_swap: bool = False,
     ) -> None:
         self.engine = engine
         self.ipcache = ipcache
@@ -834,6 +836,25 @@ class DatapathPipeline:
         # ladder-level-2 fallback — pulled once per materialization,
         # not per batch
         self._host_pm: Dict[int, Tuple[object, Tuple]] = {}
+        # -- policyd-delta: epoch-swapped device tables ---------------
+        # Opt-in (EpochSwap runtime option): a full re-materialization
+        # demanded by the delta log builds its policymaps on a SHADOW
+        # thread while dispatches keep serving the current generation;
+        # the finished generation installs under self._lock and becomes
+        # dispatch-visible through the NEXT rebuild's single _dp_state
+        # publish — the atomic batch-boundary swap, riding the same
+        # transactional _ct_flush_pending block (and SITE_CT_EPOCH
+        # fault site) as every other basis move. _swap_gen is the
+        # abandonment guard: any event that invalidates the basis the
+        # shadow bound to (quarantine, ladder move, endpoint/sharding/
+        # attribution change, swap-off) bumps it, and a finishing
+        # shadow whose generation no longer matches is discarded — a
+        # swap mid-quarantine must not resurrect the abandoned epoch.
+        self._epoch_swap = bool(epoch_swap)
+        self._policy_epoch = 0  # generations actually swapped in
+        self._swap_gen = 0  # basis generation a shadow build binds to
+        self._shadow_thread: Optional[threading.Thread] = None
+        self._shadow_exc: Optional[BaseException] = None
         _metrics.pipeline_mode.set(0.0)
 
     def set_endpoints(self, endpoints: Sequence) -> None:
@@ -854,6 +875,7 @@ class DatapathPipeline:
                 self.conntrack.flush()
             self._ct_epoch += 1
             self._device_ct = None
+            self._swap_gen += 1  # column layout moved: abandon shadows
 
     def endpoint_index(self, endpoint_id: int) -> Optional[int]:
         try:
@@ -881,6 +903,7 @@ class DatapathPipeline:
             self._tries = None
             self._placed_pm.clear()
             self._placed_rt.clear()
+            self._swap_gen += 1  # placement basis moved: abandon shadows
         # telemetry/warm caches: best-effort sets the lock-free dispatch
         # paths also mutate bare (GIL-atomic; a racing add only costs
         # one redundant compile or a miscounted cache-hit metric)
@@ -908,6 +931,7 @@ class DatapathPipeline:
             self._mat.clear()
             self._mat_sig = ()
             self._placed_rt.clear()
+            self._swap_gen += 1  # sweep variant moved: abandon shadows
         self.flow_ring.active = bool(on)
         self._seen_shapes.clear()
         self._warm_buckets.clear()
@@ -1084,6 +1108,9 @@ class DatapathPipeline:
             self._placed_rt.clear()
             self._breaker_faults = 0
             self._clean_batches = 0
+            # a ladder move re-forms the mesh: a shadow generation
+            # built for the old device set must never install
+            self._swap_gen += 1
         self._seen_shapes.clear()
         self._warm_buckets.clear()
         _metrics.degradations_total.inc({"from": frm, "to": to})
@@ -1157,6 +1184,9 @@ class DatapathPipeline:
             self._ct_epoch += 1
             self._device_ct = None
             self._quarantined += 1
+            # the epoch the shadow bound to may be the poisoned one —
+            # a swap mid-quarantine must not resurrect it
+            self._swap_gen += 1
         return self._degraded_result(inf)
 
     def _finish_guarded(self, inf: "_InFlight"):
@@ -1228,36 +1258,35 @@ class DatapathPipeline:
 
             mat_fresh = False
             saw_row_event = False
+            saw_rule_delta = False
+            swap_pending = False
             if force or not self._mat or self._mat_sig != ep_sig:
                 self._materialize_both(compiled, device)
                 mat_fresh = True
             else:
-                deltas = self.engine.deltas_since(self._last_delta_seq)
-                if deltas is None or any(k != "rows" for _, k, _ in deltas):
-                    # rule appends or full recompiles invalidate column
-                    # layout / verdict basis → re-materialize (warm jit,
-                    # shape-bucketed, so this is the fast full path)
-                    self._materialize_both(compiled, device)
-                    mat_fresh = True
+                routed = self._route_deltas(
+                    compiled, device, self.engine.deltas_since(self._last_delta_seq)
+                )
+                if routed is None:
+                    # full rebuild needed (log truncation, a "full"
+                    # recompile event, or a rule delta the column patch
+                    # cannot express). With EpochSwap on, build it on
+                    # the shadow thread and KEEP SERVING the current
+                    # generation — the install advances the delta
+                    # cursor itself, so nothing here commits.
+                    if self._epoch_swap and self._kick_shadow_build(
+                        compiled, device, ep_sig, delta_target
+                    ):
+                        swap_pending = True
+                    else:
+                        # warm jit, shape-bucketed — the fast full path
+                        self._materialize_both(compiled, device)
+                        mat_fresh = True
                 else:
-                    ao, nr = self._attrib_origins(compiled)
-                    for _seq, _kind, events in deltas:
-                        for direction, mat in self._mat.items():
-                            patch_identity_rows(
-                                mat, compiled, device, events,
-                                attrib_origin=ao[
-                                    direction == TRAFFIC_INGRESS
-                                ],
-                                n_rules=nr,
-                            )
-                        # Any row event (add OR release) can change what an
-                        # ipcache entry resolves to — e.g. a released id
-                        # being re-allocated onto a tombstoned row, or an
-                        # add resolving a previously-unmapped entry — so
-                        # the tries must follow every row move.
-                        saw_row_event |= bool(events)
-            self._mat_sig = ep_sig
-            self._last_delta_seq = delta_target
+                    saw_row_event, saw_rule_delta = routed
+            if not swap_pending:
+                self._mat_sig = ep_sig
+                self._last_delta_seq = delta_target
 
             # Tries: rebuilt when their sources move, when the row basis
             # was re-established, or when any row event could have
@@ -1376,7 +1405,11 @@ class DatapathPipeline:
             # flow is a single batched dispatch). Uses the versions
             # captured BEFORE the reads so a mutation landing mid-build
             # flushes again on the next rebuild rather than slipping by.
-            if mat_fresh or saw_row_event or basis_moved:
+            # saw_rule_delta: a column patch is still a rule change —
+            # a revoked rule must not keep admitting its established
+            # flows just because the policymap was patched in place
+            # rather than re-materialized.
+            if mat_fresh or saw_row_event or saw_rule_delta or basis_moved:
                 self._ct_flush_pending = True
             if self._ct_flush_pending:
                 if _faults.hub.active:
@@ -1470,6 +1503,87 @@ class DatapathPipeline:
                 self.counters = np.zeros((len(self._endpoints), 3), np.int64)
             return self._tables
 
+    def _route_deltas(
+        self, compiled, device, deltas
+    ) -> Optional[Tuple[bool, bool]]:
+        """Apply the engine delta log to the materialized state IN
+        PLACE (the O(delta) refresh path). Held-lock helper for
+        rebuild. Returns ``(saw_row_event, saw_rule_delta)`` on
+        success, or None when the
+        log demands a full re-materialization: a truncated ring, a
+        "full" recompile event, or a rule delta the column patch cannot
+        express (slot growth, row-bucket crossing, attribution deletes
+        — every later rule's index shifts, so the per-cell rule table
+        cannot be patched).
+
+        Ordering note: row patches rewrite whole identity ROWS and
+        column patches whole endpoint COLUMNS, and both sweep against
+        the FINAL (compiled, device) snapshot — so replaying rows
+        first and the coalesced rule-column union second lands every
+        touched cell on its final value regardless of how the log
+        interleaved them."""
+        if deltas is None:
+            return None
+        if any(k == "full" for _, k, _ in deltas):
+            return None
+        if self._attrib_requested and any(
+            k == "rules" and p and p[0] == "del" for _, k, p in deltas
+        ):
+            if any(m.rule_nc is not None for m in self._mat.values()):
+                return None
+        t0 = time.perf_counter()
+        ao, nr = self._attrib_origins(compiled)
+        saw_row_event = False
+        touched_sids: set = set()
+        row_events: list = []
+        for _seq, kind, payload in deltas:
+            if kind == "rows":
+                # Coalesce across log entries, one patch per direction
+                # below — the engine-side _set_rows2 discipline applied
+                # at the pipeline layer. The stale-snapshot scan and
+                # the verdict re-sweep are per-CALL costs, so a churny
+                # tick (many row deltas between rebuilds) must replay
+                # as one patch, not one per log entry; last event per
+                # row wins inside patch_identity_rows, which preserves
+                # log order.
+                row_events.extend(payload)
+                # Any row event (add OR release) can change what an
+                # ipcache entry resolves to — e.g. a released id being
+                # re-allocated onto a tombstoned row, or an add
+                # resolving a previously-unmapped entry — so the tries
+                # must follow every row move.
+                saw_row_event |= bool(payload)
+            else:  # "rules": ("add"|"del", (subject_sid, ...))
+                touched_sids.update(payload[1])
+        if row_events:
+            for direction, mat in self._mat.items():
+                patch_identity_rows(
+                    mat, compiled, device, row_events,
+                    attrib_origin=ao[direction == TRAFFIC_INGRESS],
+                    n_rules=nr,
+                )
+        if touched_sids:
+            for direction, mat in self._mat.items():
+                if not patch_endpoints_state(
+                    mat, compiled, device, sorted(touched_sids),
+                    attrib_origin=ao[direction == TRAFFIC_INGRESS],
+                    n_rules=nr,
+                ):
+                    # partial patches are harmless: every cell they
+                    # wrote already holds its final value, and the
+                    # full rebuild replaces the state wholesale
+                    return None
+            # appends grow the rule set: keep the completion half's
+            # rule-index → origin map in step with the patched tables
+            if nr:
+                self._attrib_n_rules = nr
+                self._attrib_names = self.engine.repo.origin_names()
+        if saw_row_event or touched_sids:
+            _metrics.engine_refresh_seconds.observe(
+                time.perf_counter() - t0, {"kind": "delta"}
+            )
+        return saw_row_event, bool(touched_sids)
+
     def _replicated_policymap(self, direction: int, pm: PolicymapTables):
         """Mesh-replicated copy of one direction's policymap, cached on
         the source object so row patches (which swap the arrays) re-place
@@ -1521,16 +1635,133 @@ class DatapathPipeline:
         self._attrib_names = (
             self.engine.repo.origin_names() if nr else []
         )
-        self._mat = {
+        self._mat = self._build_mats(compiled, device, self._endpoints, ao, nr)
+
+    @staticmethod
+    def _build_mats(compiled, device, endpoints, ao, nr):
+        """Both directions' full sweeps from one frozen (compiled,
+        device) snapshot. Static and self-free on purpose: the
+        epoch-swap shadow thread runs this OFF the pipeline lock, so
+        it must not read mutable pipeline state."""
+        return {
             TRAFFIC_INGRESS: materialize_endpoints_state(
-                compiled, device, self._endpoints, ingress=True,
+                compiled, device, endpoints, ingress=True,
                 attrib_origin=ao[True], n_rules=nr,
             ),
             TRAFFIC_EGRESS: materialize_endpoints_state(
-                compiled, device, self._endpoints, ingress=False,
+                compiled, device, endpoints, ingress=False,
                 attrib_origin=ao[False], n_rules=nr,
             ),
         }
+
+    # -- policyd-delta: epoch-swapped shadow rebuilds ------------------
+    def set_epoch_swap(self, on: bool) -> None:
+        """Toggle epoch-swapped full rebuilds (the EpochSwap runtime
+        option). Turning it off also abandons any in-flight shadow
+        build — the next rebuild that needs a full sweep runs it
+        synchronously again."""
+        with self._lock:
+            on = bool(on)
+            if on == self._epoch_swap:
+                return
+            self._epoch_swap = on
+            if not on:
+                self._swap_gen += 1
+
+    def wait_epoch_swap(self, timeout: float = 60.0) -> bool:
+        """Block until no shadow build is in flight (tests/bench
+        convergence helper; the daemon never calls this). Returns False
+        on timeout. The installed generation becomes dispatch-visible
+        on the NEXT rebuild() — call it after this returns."""
+        t = self._shadow_thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+            return not t.is_alive()
+        return True
+
+    @property
+    def policy_epoch(self) -> int:
+        """Shadow-built generations swapped in since start (telemetry:
+        rides /healthz next to the failsafe state)."""
+        return self._policy_epoch
+
+    def _kick_shadow_build(
+        self, compiled, device, ep_sig, delta_target
+    ) -> bool:
+        """Start (or keep watching) a shadow materialization bound to
+        the current basis generation. Held-lock helper for rebuild.
+        Returns True while a shadow is (now) running — the caller keeps
+        serving the old generation — or False when it must fall back to
+        the synchronous full path (a previous shadow died on a
+        transient/poisoned fault; programmer errors re-raise here)."""
+        exc = self._shadow_exc
+        if exc is not None:
+            self._shadow_exc = None
+            if _faults.classify(exc) == _faults.KIND_ERROR:
+                raise exc
+            return False
+        t = self._shadow_thread
+        if t is not None and t.is_alive():
+            return True  # one shadow at a time; converge via the log
+        gen = self._swap_gen
+        ao, nr = self._attrib_origins(compiled)
+        names = self.engine.repo.origin_names() if nr else []
+        t = threading.Thread(
+            target=self._shadow_build,
+            args=(
+                compiled, device, list(self._endpoints), ep_sig,
+                delta_target, gen, ao, nr, names,
+            ),
+            name="policyd-shadow-mat",
+            daemon=True,
+        )
+        self._shadow_thread = t
+        t.start()
+        return True
+
+    def _shadow_build(
+        self, compiled, device, endpoints, ep_sig, delta_target, gen,
+        ao, nr, names,
+    ) -> None:
+        """Shadow-thread body: the expensive sweeps run OFF the
+        pipeline lock (dispatches and O(delta) rebuilds keep going
+        against the old generation), then the finished generation
+        installs under it. Deltas that landed while the sweep ran are
+        NOT lost: the install rewinds the cursor to the kick-time
+        target, so the next rebuild replays them against the new
+        generation (row/column patches compute from the then-current
+        snapshot — eventually consistent, same contract as any
+        in-flight window)."""
+        try:
+            mats = self._build_mats(compiled, device, endpoints, ao, nr)
+        # The broad catch is the point: ANY shadow failure must park in
+        # _shadow_exc so the next kick can route it through
+        # faults.classify (KIND_ERROR re-raises there, transients fall
+        # back to a synchronous build) — a raise on this daemon thread
+        # would vanish.  # policyd-lint: disable=ROBUST001
+        except BaseException as e:
+            with self._lock:
+                if self._swap_gen == gen:
+                    self._shadow_exc = e
+            return
+        with self._lock:
+            if self._swap_gen != gen:
+                return  # basis moved under us: abandon this epoch
+            self._mat = mats
+            self._mat_sig = ep_sig
+            self._last_delta_seq = delta_target
+            self._attrib_n_rules = nr
+            self._attrib_names = names
+            # rows may have moved with the rebuild: tries must follow
+            self._tries = None
+            # The generation becomes dispatch-visible ONLY through the
+            # next rebuild's single _dp_state publish (the atomic
+            # batch-boundary swap). Its CT flush rides the
+            # transactional pending block there — fault-injectable at
+            # SITE_CT_EPOCH like every other basis move.
+            self._ct_flush_pending = True
+            self._policy_epoch += 1
+        _metrics.engine_epoch_swaps_total.inc()
 
     def snapshots(self, ingress: bool = True) -> List[EndpointPolicySnapshot]:
         self.rebuild()

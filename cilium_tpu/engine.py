@@ -37,7 +37,7 @@ from .compiler import (
     host_selector_matches,
     try_append_rules,
 )
-from .compiler.program import rule_origin_arrays, unpack_conjuncts
+from .compiler.program import rule_origin_arrays, subject_sids, unpack_conjuncts
 from .identity import IdentityRegistry
 from .identity.model import MAX_USER_IDENTITY
 from .ops.bitmap import compute_selector_matches
@@ -73,6 +73,44 @@ def _set_rows2(
     """Row-update two buffers in ONE dispatch (device round trips
     dominate small updates, especially over the axon tunnel)."""
     return a.at[idx].set(rows_a), b.at[idx].set(rows_b)
+
+
+@jax.jit
+def _set_rows_cols(
+    buf: jnp.ndarray,
+    rows: jnp.ndarray,  # [k] int32
+    cols: jnp.ndarray,  # [w] int32
+    vals: jnp.ndarray,  # [k, w]
+) -> jnp.ndarray:
+    """Sparse rows × word-window scatter for sel_match: a new selector
+    matching k identities uploads O(k · window) words, not [N, S/32].
+    Duplicate row indices (power-of-two padding repeats the last row)
+    carry identical values, so the scatter stays deterministic."""
+    return buf.at[rows[:, None], cols[None, :]].set(vals)
+
+
+@jax.jit
+def _set_col_window(
+    buf: jnp.ndarray,
+    start_word: jnp.ndarray,  # scalar int32
+    window: jnp.ndarray,  # [N, w]
+) -> jnp.ndarray:
+    """Dense fallback when most identities match the appended
+    selectors: upload the whole touched word window (still O(N · w),
+    never the full matrix). Traced start keeps one program per width."""
+    return jax.lax.dynamic_update_slice(buf, window, (jnp.int32(0), start_word))
+
+
+def _pow2_rows(rows: np.ndarray) -> np.ndarray:
+    """Pad a row-index list to a power-of-two bucket (min 8) by
+    repeating the last row, bounding _set_rows_cols recompiles."""
+    k = rows.shape[0]
+    bucket = 8
+    while bucket < k:
+        bucket <<= 1
+    if bucket == k:
+        return rows
+    return np.concatenate([rows, np.repeat(rows[-1:], bucket - k)])
 
 
 def _pack_match_words(m: np.ndarray) -> np.ndarray:
@@ -144,6 +182,7 @@ class PolicyEngine:
             or c.identity_version != self.registry.version
         )
 
+    # policyd: refresh-path
     def refresh(self, force: bool = False) -> CompiledPolicy:
         """Recompile (or incrementally patch) if repository or identity
         state moved (the revision gate of pkg/endpoint/policy.go:506).
@@ -260,6 +299,7 @@ class PolicyEngine:
         return compiled
 
     # -- incremental paths ---------------------------------------------
+    # policyd: refresh-path
     def _apply_identity_delta(self) -> bool:
         """Apply pending identity adds/releases as device row updates.
         False → caller must full-rebuild."""
@@ -356,10 +396,12 @@ class PolicyEngine:
         # this delta stay queued and are covered by the next refresh.
         c.identity_version = target_version
         del self._pending_idents[: len(pend)]
+        _metrics.engine_delta_rows_total.inc(value=len(events))
         # payload: (row, identity_id, live) events in apply order
         self._log_delta("rows", tuple(events))
         return True
 
+    # policyd: refresh-path
     @staticmethod
     def _patch_tables(tables: DeviceTables, writes) -> DeviceTables:
         """Apply a DirectionPacker write log as per-matrix scatters —
@@ -408,6 +450,7 @@ class PolicyEngine:
                 raise KeyError(name)
         return tables.replace(**reps)
 
+    # policyd: refresh-path
     def _apply_rule_append(self, rules, revision: int) -> bool:
         """Append a rule batch in place, advancing the compiled revision
         to the op's own revision. False → full rebuild needed."""
@@ -418,6 +461,7 @@ class PolicyEngine:
             return False
         self._conj_unpacked = None  # conjunct rows changed
         old_s, new_s = res
+        new_match = None
         if new_s > old_s:
             # New selector columns: match against ALL identities, then
             # OR the bits into the packed words (columns were zero).
@@ -434,14 +478,20 @@ class PolicyEngine:
                 col = m[:, j]
                 if col.any():
                     sm[:, sid >> 5] |= col.astype(np.uint32) << np.uint32(sid & 31)
+            # CSR-style device update: only the word WINDOW the new
+            # selector bits land in moves, and only for the rows that
+            # matched — k identities cost O(k · window) words, not the
+            # full [N, S/32] re-upload this used to be.
+            w0, w1 = old_s >> 5, (new_s - 1) >> 5
+            cols = np.arange(w0, w1 + 1, dtype=np.int32)
+            touched = np.nonzero(m.any(axis=1))[0]
+            new_match = self._scatter_sel_window(sm, touched, cols)
         device = self._device
         assert device is not None
         self._device = DevicePolicy(
             id_bits=device.id_bits,
             sel_match=(
-                jnp.asarray(self._sel_match_host)
-                if new_s > old_s
-                else device.sel_match
+                new_match if new_match is not None else device.sel_match
             ),
             ingress=self._patch_tables(
                 device.ingress, self._state.ingress.take_writes()
@@ -450,9 +500,42 @@ class PolicyEngine:
                 device.egress, self._state.egress.take_writes()
             ),
         )
-        self._log_delta("rules", (tuple(rules),))
+        # payload: op + the subject selector ids the batch touches —
+        # every verdict term is subject-gated, so these columns bound
+        # the policymap cells the delta can change (the pipeline's
+        # patch_endpoints_state contract)
+        self._log_delta(
+            "rules", ("add", subject_sids(rules, self._state.table))
+        )
         return True
 
+    # policyd: refresh-path
+    def _scatter_sel_window(
+        self, sm: np.ndarray, touched: np.ndarray, cols: np.ndarray
+    ):
+        """Upload the changed sel_match word window: row-sparse scatter
+        when few identities matched, dense column window otherwise."""
+        device = self._device
+        assert device is not None
+        if touched.size == 0:
+            # no identity matches the new selectors — their device bits
+            # were zero and stay zero
+            return device.sel_match
+        if touched.size <= max(8, sm.shape[0] // 4):
+            rows = _pow2_rows(touched.astype(np.int32))
+            return _set_rows_cols(
+                device.sel_match,
+                jnp.asarray(rows),
+                jnp.asarray(cols),
+                jnp.asarray(sm[np.ix_(rows, cols)]),
+            )
+        return _set_col_window(
+            device.sel_match,
+            jnp.int32(cols[0]),
+            jnp.asarray(np.ascontiguousarray(sm[:, cols])),
+        )
+
+    # policyd: refresh-path
     def _apply_rule_delete(self, rules, revision: int) -> bool:
         """Retract a deleted rule batch in place (the incremental
         counterpart of repository.go DeleteByLabels:286): refcounted
@@ -482,7 +565,11 @@ class PolicyEngine:
             egress=self._patch_tables(device.egress, eg.take_writes()),
         )
         c.revision = revision
-        self._log_delta("rules", ())
+        # deletes only retract cells under the removed rules' subject
+        # selectors (refcounted 0-writes) — same column-bounding
+        # contract as appends; the selectors stay interned, so this
+        # lookup never grows the table
+        self._log_delta("rules", ("del", subject_sids(rules, state.table)))
         return True
 
     def _kick_background_refresh(self) -> None:
@@ -532,6 +619,17 @@ class PolicyEngine:
             return True
         t.join(timeout)
         return not t.is_alive()
+
+    def wait_device(self) -> None:
+        """Block until every in-flight device update (row scatters,
+        sel_match windows, table patches) has completed. The refresh
+        path itself never calls this — updates stay enqueue-only — but
+        tests and the churn bench need a completion edge to measure the
+        true device RTT of a delta."""
+        with self._lock:
+            device = self._device
+        if device is not None:
+            jax.block_until_ready((device.id_bits, device.sel_match))
 
     # -- compiled-state snapshots (pinned-map persistence analog) -------
     def save_snapshot(self, path: str, mats=None) -> None:

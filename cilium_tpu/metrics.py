@@ -208,12 +208,25 @@ batch_total_seconds = registry.histogram(
 )
 engine_refresh_seconds = registry.histogram(
     "cilium_tpu_engine_refresh_seconds",
-    "Policy engine refresh latency (label kind: full|incremental)",
+    "Policy engine refresh latency (label kind: full|incremental|delta — "
+    "delta is the pipeline's O(delta) materialization patch)",
     buckets=PHASE_BUCKETS,
 )
 engine_refreshes_total = registry.counter(
     "cilium_tpu_engine_refreshes_total",
     "Policy engine refreshes by kind (full recompile vs incremental patch)",
+)
+
+# -- policyd-delta (O(delta) refresh) families -----------------------------
+engine_delta_rows_total = registry.counter(
+    "cilium_tpu_engine_delta_rows_total",
+    "Identity rows updated through the coalesced delta path (one per "
+    "(row, identity, live) event scattered to the device tables)",
+)
+engine_epoch_swaps_total = registry.counter(
+    "cilium_tpu_engine_epoch_swaps_total",
+    "Shadow-built device-table generations atomically swapped in at a "
+    "batch boundary (full rebuilds that did NOT stop the verdict world)",
 )
 jit_shape_buckets_total = registry.counter(
     "cilium_tpu_jit_shape_buckets_total",

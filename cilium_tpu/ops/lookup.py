@@ -68,6 +68,22 @@ def replicate_tables(t: PolicymapTables, sharding=None) -> PolicymapTables:
     return jax.device_put(t, sharding)
 
 
+@jax.jit
+def patch_bitmap_cols(
+    tab: jnp.ndarray,  # [N, W]
+    col_idx: jnp.ndarray,  # [k] int32
+    cols: jnp.ndarray,  # [N, k], dtype of ``tab``
+) -> jnp.ndarray:
+    """Scatter whole columns into a per-identity table — the column
+    dual of materialize._patch_bitmap_rows. Serves both the packed
+    ``id_bits`` word columns and the int32 ``rule_tab`` columns on the
+    O(delta) rule-patch path (a rule touching k columns uploads
+    [N, k] words, not the full table). Duplicate indices are allowed
+    when they carry identical values (callers pad to a power of two by
+    repeating the last column so the jit cache stays bounded)."""
+    return tab.at[:, col_idx].set(cols)
+
+
 @functools.partial(jax.jit, static_argnames=("block", "attrib"))
 def lookup_batch(
     t: PolicymapTables,

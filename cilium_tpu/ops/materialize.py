@@ -27,9 +27,9 @@ import numpy as np
 from typing import Optional
 
 from ..compiler.program import CompiledPolicy, PROTO_TCP_N
-from .bitmap import pack_bool_bits
-from .lookup import PolicymapTables
-from .verdict import ALLOW, AttribTables, DevicePolicy, verdict_batch
+from .bitmap import pack_bool_bits, unpack_bits_u32
+from .lookup import PolicymapTables, patch_bitmap_cols
+from .verdict import ALLOW, AttribTables, DevicePolicy, _mm, verdict_batch
 
 TRAFFIC_INGRESS = 0
 TRAFFIC_EGRESS = 1
@@ -201,6 +201,86 @@ def _sweep_device_attrib(
     return allow, l3a, red, at.rule.reshape(n_seg, n)
 
 
+@functools.partial(jax.jit, static_argnames=("n", "ingress", "nblock"))
+def _sweep_device_matrix(
+    policy: DevicePolicy,
+    seg_row: jnp.ndarray,  # [g] int32
+    seg_port: jnp.ndarray,
+    seg_proto: jnp.ndarray,
+    seg_l4: jnp.ndarray,  # [g] bool
+    n: int,
+    ingress: bool,
+    nblock: int,
+):
+    """Identity-major matrix formulation of the segment sweep.
+
+    The flow-major sweep evaluates each (segment, identity) pair as an
+    independent flow: every peer row re-contracts the [S, S]/[S, K1]
+    relation matrices per segment, costing O(g·N·S²). But within one
+    sweep the segment side (subject selector row, port one-hot, combo
+    and L7-filter coverage) is FIXED per segment — so hoist it: compute
+    the per-peer term vectors once per identity block (O(N·S²) total)
+    and contract them against the [·, g] segment matrices (O(g·N·S)).
+    At the 100k-identity stretch scale that is a ~n_seg× FLOP cut over
+    the flow sweep for identical outputs.
+
+    Bit-identity with _verdict_block: every reduction here is
+    ``any(a ∧ b) == (Σ a·b) > 0`` over 0/1 int8 operands with int32
+    accumulation (S < 2³¹, no overflow), and the one per-flow data
+    dependence — group_ok folding req_ok — is handled by evaluating
+    both req_ok phases and selecting per (peer, segment) cell on the
+    deny matrix. Returns the same packed (allow, l3, redirect)
+    [g, ceil(n/32)] words as _sweep_device."""
+    t = policy.ingress if ingress else policy.egress
+    subj8 = unpack_bits_u32(jnp.take(policy.sel_match, seg_row, axis=0))  # [g, S]
+    pp = (
+        (seg_port[:, None] == t.ports[None, :])
+        & (seg_proto[:, None] == t.protos[None, :])
+        & seg_l4[:, None]
+    ).astype(jnp.int8)  # [g, P4]
+    subj_t8 = subj8.T  # [S, g]
+    combo_t = (_mm(subj8, t.s1_mat) & _mm(pp, t.p1_mat)).astype(jnp.int8).T  # [K1, g]
+    sp7_t = (_mm(subj8, t.s7_mat) & _mm(pp, t.p7_mat)).astype(jnp.int8).T  # [K7, g]
+    has_l4 = seg_l4[None, :]  # [1, g]
+
+    n_pad = -(-n // nblock) * nblock
+    row_blocks = jnp.arange(n_pad, dtype=jnp.int32).reshape(-1, nblock)
+
+    def blk(rows):
+        # (jnp.take clips the padded tail rows; their outputs are
+        # sliced off below)
+        peer8 = unpack_bits_u32(jnp.take(policy.sel_match, rows, axis=0))  # [nb, S]
+        peer_deny = _mm(jnp.int8(1) - peer8, t.deny_t).astype(jnp.int8)  # [nb, S]
+        peer_allow = _mm(peer8, t.allow_t).astype(jnp.int8)
+        peer_en = _mm(peer8, t.en_t).astype(jnp.int8)  # [nb, K1]
+        peer_ee = _mm(peer8, t.ee_t).astype(jnp.int8)
+        deny = _mm(peer_deny, subj_t8)  # [nb, g] bool
+        l3_allow = _mm(peer_allow, subj_t8)
+        en_any = _mm(peer_en, combo_t)  # [nb, g]
+        ee_any = _mm(peer_ee, combo_t)
+        l4_allow = en_any | (~deny & ee_any)
+
+        gpn_hit = _mm(peer8, t.gpn_mat)  # [nb, G]
+        gpe_hit = _mm(peer8, t.gpe_mat)
+        gok_true = (gpn_hit | gpe_hit | t.group_no_peers[None, :]).astype(jnp.int8)
+        gok_false = (gpn_hit | t.group_no_peers[None, :]).astype(jnp.int8)
+        l7_true = _mm(_mm(gok_true, t.g7_mat).astype(jnp.int8), sp7_t)  # [nb, g]
+        l7_false = _mm(_mm(gok_false, t.g7_mat).astype(jnp.int8), sp7_t)
+        l7_present = jnp.where(deny, l7_false, l7_true)
+
+        l3_pass = l3_allow & ~deny
+        allow_b = l3_pass | (has_l4 & l4_allow)
+        red_b = has_l4 & l4_allow & l7_present
+        return allow_b, l3_pass, red_b
+
+    allow_b, l3_b, red_b = jax.lax.map(blk, row_blocks)  # [blocks, nb, g]
+
+    def fin(x):
+        return pack_bool_bits(x.reshape(n_pad, -1)[:n].T)
+
+    return fin(allow_b), fin(l3_b), fin(red_b)
+
+
 def _unpack_rows(words: np.ndarray, n: int) -> np.ndarray:
     """[n_seg, ceil(n/32)] uint32 → [n_seg, n] bool (pack_bool_bits
     inverse, host-side)."""
@@ -213,6 +293,92 @@ def _unpack_rows(words: np.ndarray, n: int) -> np.ndarray:
     return bits[:, :n].astype(bool)
 
 
+# Identity rows per matrix-sweep block: bounds the [nblock, S]
+# peer-term activations while keeping the MXU contraction dims full.
+_MATRIX_NBLOCK = 1024
+
+
+def _sweep_segments(
+    device: DevicePolicy,
+    sr: np.ndarray,  # [n_seg] int32 subject rows
+    sp: np.ndarray,  # [n_seg] int32 ports
+    spr: np.ndarray,  # [n_seg] int32 protos
+    sl: np.ndarray,  # [n_seg] bool has_l4
+    n: int,
+    *,
+    ingress: bool,
+    block: int,
+    attrib_origin: Optional[AttribTables] = None,
+    n_rules: int = 0,
+    sweep: str = "auto",
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Chunked segments × all-identities sweep shared by the full
+    materializer and the delta column-patch path → unpacked
+    (allow_sn, l3_sn, red_sn) [n_seg, n] bool + rule_sn [n_seg, n]
+    int32 (-1 when no attribution ran).
+
+    ``sweep`` picks the kernel: "auto" routes attribution-free sweeps
+    through the identity-major matrix kernel (_sweep_device_matrix —
+    the O(N·S²) formulation); "flow" forces the original per-flow
+    kernel (the parity suite diffs the two bit-for-bit). Attribution
+    sweeps always take the flow kernel: the first-match rule tail needs
+    the per-flow term vectors the matrix form contracts away."""
+    n_seg = len(sr)
+    # Chunk the segment axis so one dispatch's flattened row count
+    # stays bounded (~big-batch sized) regardless of endpoint count ×
+    # identity capacity, then pad each chunk to a bucket (dummy L3
+    # segs against row 0) so repeated materializations reuse the
+    # compiled sweep.
+    budget = max(8, (1 << 23) // max(1, n))
+    seg_chunk = 1 << (budget.bit_length() - 1)  # power of two ≤ budget
+    seg_chunk = min(seg_chunk, _seg_bucket(n_seg))
+    use_matrix = sweep != "flow" and attrib_origin is None
+    aw_parts: List[np.ndarray] = []
+    l3_parts: List[np.ndarray] = []
+    rw_parts: List[np.ndarray] = []
+    rl_parts: List[np.ndarray] = []
+    for lo in range(0, n_seg, seg_chunk):
+        hi = min(lo + seg_chunk, n_seg)
+        pad = min(_seg_bucket(hi - lo), seg_chunk) - (hi - lo)
+        chunk = (
+            # control-plane rebuild: VRAM-bounded chunking over the
+            # segment sweep — a handful of large device calls, not a
+            # per-flow dispatch loop (the serving path never runs this)
+            jnp.asarray(np.pad(sr[lo:hi], (0, pad))),  # policyd-lint: disable=TPU002
+            jnp.asarray(np.pad(sp[lo:hi], (0, pad))),
+            jnp.asarray(np.pad(spr[lo:hi], (0, pad))),
+            jnp.asarray(np.pad(sl[lo:hi], (0, pad))),
+        )
+        if attrib_origin is not None:
+            aw, l3w, rw, rl = _sweep_device_attrib(
+                device, *chunk, attrib_origin, n, ingress, block, n_rules
+            )
+            # control-plane rebuild pull, same cadence as the aw/l3w
+            # pulls below (baselined) — never on the serving path
+            rl_parts.append(np.asarray(rl)[: hi - lo])  # policyd-lint: disable=TPU001
+        elif use_matrix:
+            aw, l3w, rw = _sweep_device_matrix(
+                device, *chunk, n, ingress, _MATRIX_NBLOCK
+            )
+        else:
+            aw, l3w, rw = _sweep_device(device, *chunk, n, ingress, block)
+        aw_parts.append(np.asarray(aw)[: hi - lo])
+        l3_parts.append(np.asarray(l3w)[: hi - lo])
+        rw_parts.append(np.asarray(rw)[: hi - lo])
+    if aw_parts:
+        allow_sn = _unpack_rows(np.concatenate(aw_parts), n)
+        l3_sn = _unpack_rows(np.concatenate(l3_parts), n)
+        red_sn = _unpack_rows(np.concatenate(rw_parts), n)
+    else:  # zero endpoints: nothing to sweep
+        allow_sn = l3_sn = red_sn = np.zeros((0, n), bool)
+    rule_sn = (
+        np.concatenate(rl_parts)
+        if rl_parts
+        else np.full((n_seg, n), -1, np.int32)
+    )
+    return allow_sn, l3_sn, red_sn, rule_sn
+
+
 def materialize_endpoints_state(
     compiled: CompiledPolicy,
     device: DevicePolicy,
@@ -222,6 +388,7 @@ def materialize_endpoints_state(
     block: int = 8192,
     attrib_origin: Optional[AttribTables] = None,
     n_rules: int = 0,
+    sweep: str = "auto",
 ) -> MaterializedState:
     """``attrib_origin`` (with ``n_rules``) switches the sweep to the
     attribution kernel variant: the result additionally carries
@@ -255,56 +422,18 @@ def materialize_endpoints_state(
             seg_l4.append(True)
 
     n_seg = len(seg_row)
-    # Chunk the segment axis so one dispatch's flattened row count
-    # stays bounded (~big-batch sized) regardless of endpoint count ×
-    # identity capacity, then pad each chunk to a bucket (dummy L3
-    # segs against row 0) so repeated materializations reuse the
-    # compiled sweep.
-    budget = max(8, (1 << 23) // max(1, n))
-    seg_chunk = 1 << (budget.bit_length() - 1)  # power of two ≤ budget
-    seg_chunk = min(seg_chunk, _seg_bucket(n_seg))
-    aw_parts: List[np.ndarray] = []
-    l3_parts: List[np.ndarray] = []
-    rw_parts: List[np.ndarray] = []
-    rl_parts: List[np.ndarray] = []
-    sr = np.asarray(seg_row, np.int32)
-    sp = np.asarray(seg_port, np.int32)
-    spr = np.asarray(seg_proto, np.int32)
-    sl = np.asarray(seg_l4, bool)
-    for lo in range(0, n_seg, seg_chunk):
-        hi = min(lo + seg_chunk, n_seg)
-        pad = min(_seg_bucket(hi - lo), seg_chunk) - (hi - lo)
-        chunk = (
-            # control-plane rebuild: VRAM-bounded chunking over the
-            # segment sweep — a handful of large device calls, not a
-            # per-flow dispatch loop (the serving path never runs this)
-            jnp.asarray(np.pad(sr[lo:hi], (0, pad))),  # policyd-lint: disable=TPU002
-            jnp.asarray(np.pad(sp[lo:hi], (0, pad))),
-            jnp.asarray(np.pad(spr[lo:hi], (0, pad))),
-            jnp.asarray(np.pad(sl[lo:hi], (0, pad))),
-        )
-        if attrib_origin is None:
-            aw, l3w, rw = _sweep_device(device, *chunk, n, ingress, block)
-        else:
-            aw, l3w, rw, rl = _sweep_device_attrib(
-                device, *chunk, attrib_origin, n, ingress, block, n_rules
-            )
-            # control-plane rebuild pull, same cadence as the aw/l3w
-            # pulls below (baselined) — never on the serving path
-            rl_parts.append(np.asarray(rl)[: hi - lo])  # policyd-lint: disable=TPU001
-        aw_parts.append(np.asarray(aw)[: hi - lo])
-        l3_parts.append(np.asarray(l3w)[: hi - lo])
-        rw_parts.append(np.asarray(rw)[: hi - lo])
-    if aw_parts:
-        allow_sn = _unpack_rows(np.concatenate(aw_parts), n)
-        l3_sn = _unpack_rows(np.concatenate(l3_parts), n)
-        red_sn = _unpack_rows(np.concatenate(rw_parts), n)
-    else:  # zero endpoints: nothing to sweep
-        allow_sn = l3_sn = red_sn = np.zeros((0, n), bool)
-    rule_sn = (
-        np.concatenate(rl_parts)
-        if rl_parts
-        else np.full((n_seg, n), -1, np.int32)
+    allow_sn, l3_sn, red_sn, rule_sn = _sweep_segments(
+        device,
+        np.asarray(seg_row, np.int32),
+        np.asarray(seg_port, np.int32),
+        np.asarray(seg_proto, np.int32),
+        np.asarray(seg_l4, bool),
+        n,
+        ingress=ingress,
+        block=block,
+        attrib_origin=attrib_origin,
+        n_rules=n_rules,
+        sweep=sweep,
     )
 
     # Column layout: one column per (endpoint, L3) + (endpoint, slot).
@@ -610,3 +739,200 @@ def _pack_rows(rows_bool: np.ndarray) -> np.ndarray:
     32 by construction)."""
     packed = np.packbits(rows_bool, axis=1, bitorder="little")
     return packed.view(np.uint32).reshape(rows_bool.shape[0], rows_bool.shape[1] // 32)
+
+
+def _pack_col_word(cols_bool: np.ndarray) -> np.ndarray:
+    """[N, ≤32] bool column block → [N] uint32 (one packed id_bits
+    word; short tails zero-pad, matching pack_bool_bits)."""
+    n, w = cols_bool.shape
+    if w < 32:
+        cols_bool = np.concatenate(
+            [cols_bool, np.zeros((n, 32 - w), bool)], axis=1
+        )
+    return np.packbits(cols_bool, axis=1, bitorder="little").view(np.uint32)[:, 0]
+
+
+def _pad_cols_pow2(idx: np.ndarray, vals: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad a column scatter to a power-of-two width by repeating the
+    LAST column (duplicate .set with identical values is deterministic)
+    so patch_bitmap_cols compiles per bucket, not per delta width."""
+    k = idx.shape[0]
+    bucket = 1
+    while bucket < k:
+        bucket <<= 1
+    if bucket == k:
+        return idx, vals
+    return (
+        np.concatenate([idx, np.repeat(idx[-1:], bucket - k)]),
+        np.concatenate(
+            [vals, np.repeat(vals[:, -1:], bucket - k, axis=1)], axis=1
+        ),
+    )
+
+
+def patch_endpoints_state(
+    state: MaterializedState,
+    compiled: CompiledPolicy,
+    device: DevicePolicy,
+    touched_sids: Sequence[int],
+    *,
+    block: int = 8192,
+    attrib_origin: Optional[AttribTables] = None,
+    n_rules: int = 0,
+    sweep: str = "auto",
+) -> bool:
+    """O(delta) column rematerialization for a rule append/delete.
+
+    Every verdict term is gated on the SUBJECT selector (deny/allow
+    cells, combos through s1, L7 filters through s7 — see
+    _verdict_block), so a rule delta can only change policymap cells in
+    columns belonging to endpoints whose identity matches one of the
+    rule's subject selectors (``touched_sids``, from the engine's delta
+    log). Re-sweep exactly those endpoints' column segments against the
+    already-patched device tables and scatter the changed id_bits words
+    / rule_tab columns — O(affected · N) instead of the full
+    E × N re-materialization.
+
+    Returns False when the delta is NOT expressible as a column patch
+    and the caller must fall back to ``materialize_endpoints_state``:
+    identity row capacity moved, attribution state mismatched, or an
+    affected endpoint's slot set GREW (a new (port, proto) needs new
+    columns — shrunken slot sets keep their stale columns, which
+    re-sweep to the correct now-denied values). Snapshots of affected
+    endpoints are rebuilt in place so fastpath caches holding
+    references observe the update, mirroring patch_identity_rows."""
+    n = compiled.id_bits.shape[0]
+    if state.allow_nc.shape[0] != n:
+        return False  # row-bucket crossing — full rebuild
+    if (state.rule_nc is not None) != (attrib_origin is not None):
+        return False
+    sids = sorted({int(s) for s in touched_sids})
+    n_ep = len(state.ep_rows)
+    if not sids or n_ep == 0:
+        return True
+    s_words = device.sel_match.shape[1]
+    if any(s >> 5 >= s_words for s in sids):
+        return False  # selector axis outgrew the device tables
+
+    # Affected endpoints: subject row matches any touched selector.
+    # Bounded [E, S/32] control-plane pull of just the endpoint rows —
+    # the O(delta) point of this path (never the [N, S/32] matrix).
+    ep_sel = np.asarray(  # policyd-lint: disable=TPU001
+        jnp.take(
+            device.sel_match, jnp.asarray(state.ep_rows, np.int32), axis=0
+        )
+    )
+    word = np.asarray([s >> 5 for s in sids])
+    bit = np.asarray([s & 31 for s in sids], np.uint32)
+    hit = ((ep_sel[:, word] >> bit[None, :]) & 1).astype(bool).any(axis=1)
+    affected = np.nonzero(hit)[0]
+    if affected.size == 0:
+        return True  # no local endpoint matches the rule's subject
+
+    # Canonical column offsets (the materializer's layout: one L3
+    # column then one per slot, endpoint-major).
+    col_of = np.zeros(n_ep + 1, np.int64)
+    for e in range(n_ep):
+        col_of[e + 1] = col_of[e] + 1 + len(state.ep_slots[e])
+    if int(col_of[n_ep]) != state.n_cols:
+        return False
+
+    # Slot-layout guard: the patch reuses the existing columns.
+    for e in affected:
+        new_slots = _endpoint_slots(compiled, ep_sel[e], state.ingress)
+        if not set(new_slots) <= set(state.ep_slots[e]):
+            return False
+
+    seg_row: List[int] = []
+    seg_port: List[int] = []
+    seg_proto: List[int] = []
+    seg_l4: List[bool] = []
+    for e in affected:
+        row = int(state.ep_rows[e])
+        seg_row.append(row)
+        seg_port.append(0)
+        seg_proto.append(0)
+        seg_l4.append(False)
+        for port, proto in state.ep_slots[e]:
+            seg_row.append(row)
+            seg_port.append(port)
+            seg_proto.append(proto)
+            seg_l4.append(True)
+
+    allow_sn, l3_sn, red_sn, rule_sn = _sweep_segments(
+        device,
+        np.asarray(seg_row, np.int32),
+        np.asarray(seg_port, np.int32),
+        np.asarray(seg_proto, np.int32),
+        np.asarray(seg_l4, bool),
+        n,
+        ingress=state.ingress,
+        block=block,
+        attrib_origin=attrib_origin,
+        n_rules=n_rules,
+        sweep=sweep,
+    )
+
+    live = compiled.row_live
+    direction = TRAFFIC_INGRESS if state.ingress else TRAFFIC_EGRESS
+    touched_cols: List[int] = []
+    seg = 0
+    for e in affected:
+        snap = state.snapshots[e]
+        l3_allow = l3_sn[seg] & live
+        ci = int(col_of[e])
+        state.allow_nc[:, ci] = l3_allow
+        state.red_nc[:, ci] = False
+        if state.rule_nc is not None:
+            state.rule_nc[:, ci] = rule_sn[seg]
+        touched_cols.append(ci)
+        seg += 1
+        entries: Dict[PolicyKey, int] = {}
+        for r_idx in np.nonzero(l3_allow)[0]:
+            entries[PolicyKey(int(compiled.row_ids[r_idx]), 0, 0, direction)] = 0
+        for j, (port, proto_n) in enumerate(state.ep_slots[e]):
+            allow = allow_sn[seg] & live
+            redirect = red_sn[seg] & live
+            cj = ci + 1 + j
+            state.allow_nc[:, cj] = allow
+            state.red_nc[:, cj] = redirect
+            if state.rule_nc is not None:
+                state.rule_nc[:, cj] = rule_sn[seg]
+            touched_cols.append(cj)
+            seg += 1
+            for r_idx in np.nonzero(allow & (~l3_allow | redirect))[0]:
+                key = PolicyKey(int(compiled.row_ids[r_idx]), port, proto_n, direction)
+                entries[key] = int(redirect[r_idx])
+        # in-place: fastpath caches hold references to this dict
+        snap.entries.clear()
+        snap.entries.update(entries)
+
+    # Device scatter: only the packed words the touched columns live
+    # in. Allow word w holds columns 32w..32w+31; the redirect copy of
+    # word w sits c_pad/32 words later (id_bits = allow ‖ redirect).
+    c_pad = state.allow_nc.shape[1]
+    word_idx: List[int] = []
+    word_vals: List[np.ndarray] = []
+    for w in sorted({c >> 5 for c in touched_cols}):
+        cols = slice(w * 32, min((w + 1) * 32, c_pad))
+        word_idx.append(w)
+        word_vals.append(_pack_col_word(state.allow_nc[:, cols]))
+        word_idx.append(c_pad // 32 + w)
+        word_vals.append(_pack_col_word(state.red_nc[:, cols]))
+    idx, vals = _pad_cols_pow2(
+        np.asarray(word_idx, np.int32), np.stack(word_vals, axis=1)
+    )
+    state.tables = state.tables.replace(
+        id_bits=patch_bitmap_cols(
+            state.tables.id_bits, jnp.asarray(idx), jnp.asarray(vals)
+        )
+    )
+    if state.rule_nc is not None and state.rule_tab is not None:
+        ridx, rvals = _pad_cols_pow2(
+            np.asarray(touched_cols, np.int32),
+            state.rule_nc[:, touched_cols],
+        )
+        state.rule_tab = patch_bitmap_cols(
+            state.rule_tab, jnp.asarray(ridx), jnp.asarray(rvals)
+        )
+    return True

@@ -48,6 +48,10 @@ class DaemonConfig:
     # Capacity of the sampled flow-log ring (observe/flows.py) serving
     # GET /flows while FlowAttribution is on.
     flow_ring_capacity: int = 1024
+    # Boot-time value of the EpochSwap runtime option (policyd-delta):
+    # full re-materializations build on a shadow thread and swap in at
+    # a batch boundary instead of stopping the verdict world.
+    policy_epoch_swap: bool = False
 
     def validate(self) -> None:
         if self.enforcement_mode not in ("default", "always", "never"):
@@ -137,6 +141,14 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
             "pipeline cannot resolve a batch (quarantine, ladder "
             "exhaustion), forward instead of the default fail-closed "
             "deny with drop reason pipeline-degraded (155)",
+        ),
+        OptionSpec(
+            "EpochSwap",
+            "Epoch-swapped device tables (policyd-delta): full policy "
+            "re-materializations build into a shadow generation on a "
+            "background thread while batches keep serving the current "
+            "one, then swap atomically at a batch boundary; off runs "
+            "full rebuilds synchronously inside rebuild()",
         ),
         OptionSpec(
             "FaultInjection",

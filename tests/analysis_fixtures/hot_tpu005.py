@@ -1,0 +1,59 @@
+# policyd: hot
+"""TPU005 fixture: synchronous host pulls in refresh-marked functions.
+
+The positive cases never touch a jnp chain — they pull PRE-EXISTING
+device state through names/attrs (``device``, ``sel_match``), which is
+exactly the shape TPU001's flow taint cannot see.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+
+# policyd: refresh-path
+def positive_attr_pull(device):
+    return np.asarray(device.sel_match)  # POS: pull of device table
+
+
+# policyd: refresh-path
+@staticmethod
+def positive_item_decorated(tables):
+    return tables.id_bits.item()  # POS: .item() sync, marker above deco
+
+
+# policyd: refresh-path
+def positive_barrier(x):
+    return x.block_until_ready()  # POS: explicit barrier is a pull
+
+
+# policyd: refresh-path
+def positive_forward_taint(device):
+    tab = device.rule_tab
+    return int(tab[0, 0])  # POS: tainted through the assign
+
+
+def negative_unmarked(device):
+    # NEG: same pull, but no refresh-path marker — TPU005 is opt-in
+    return np.asarray(device.sel_match)
+
+
+# policyd: refresh-path
+def negative_host_data(events):
+    rows = [e[0] for e in events]
+    return np.asarray(rows, np.int32)  # NEG: host list in, host out
+
+
+# policyd: refresh-path
+def negative_upload(device, sm):
+    return device.replace(sel_match=jnp.asarray(sm))  # NEG: upload, no pull
+
+
+# policyd: refresh-path
+def negative_taint_cleared(device):
+    x = device.sel_match
+    x = [1, 2]
+    return np.asarray(x)  # NEG: x was reassigned to host data
+
+
+# policyd: refresh-path
+def negative_suppressed(device):
+    return np.asarray(device.id_bits)  # policyd-lint: disable=TPU005

@@ -1,0 +1,417 @@
+"""policyd-delta: O(delta) materialization and epoch-swapped tables.
+
+The delta refresh path must be VERDICT-identical to a from-scratch
+rebuild at every step: row patches (identity churn), column patches
+(rule appends/deletes via the subject-sid bound), and the epoch-swap
+protocol (full rebuilds on a shadow thread, atomically published at a
+batch boundary). Layout may legitimately diverge after deletes — the
+patch path re-sweeps stale L4 columns to values the exact-entry
+assembly zeroes instead of shrinking the column map — so exact-layout
+assertions gate on ``ep_slots`` equality while the device-mirror and
+end-to-end parity checks always run.
+
+Also pins the fallback edges: log truncation, "full" recompile events,
+snapshot-restored engines (no CompileState), a delta racing the
+restore path's background refresh, shadow-thread faults, and the
+quarantine/basis bumps that must abandon an in-flight epoch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from test_policygen_fuzz import World
+
+from cilium_tpu import faults as _faults
+from cilium_tpu import metrics as _m
+from cilium_tpu.datapath.pipeline import (
+    DROP_DEGRADED,
+    FORWARD,
+    DatapathPipeline,
+    TRAFFIC_EGRESS,
+    TRAFFIC_INGRESS,
+)
+from cilium_tpu.engine import PolicyEngine
+from cilium_tpu.ops.lpm import ip_strings_to_u32
+from cilium_tpu.ops.materialize import (
+    _pack_rows,
+    materialize_endpoints_state,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_hub():
+    _faults.hub.reset()
+    yield
+    _faults.hub.reset()
+
+
+def _fresh_mats(pipe):
+    """Oracle: from-scratch materialization of the pipeline's current
+    engine snapshot (what a cold rebuild would produce)."""
+    compiled, device = pipe.engine.snapshot()
+    return {
+        d: materialize_endpoints_state(
+            compiled, device, pipe._endpoints, ingress=(d == TRAFFIC_INGRESS)
+        )
+        for d in (TRAFFIC_INGRESS, TRAFFIC_EGRESS)
+    }
+
+
+def _assert_state_parity(pipe, ctx=""):
+    """Patched state vs the from-scratch oracle: exact layout when the
+    column maps agree, device id_bits mirroring the host state always."""
+    oracle = _fresh_mats(pipe)
+    for d in (TRAFFIC_INGRESS, TRAFFIC_EGRESS):
+        m, o = pipe._mat[d], oracle[d]
+        if m.ep_slots == o.ep_slots:
+            assert np.array_equal(m.allow_nc, o.allow_nc), (ctx, d, "allow")
+            assert np.array_equal(m.red_nc, o.red_nc), (ctx, d, "red")
+            assert [dict(s.entries) for s in m.snapshots] == [
+                dict(s.entries) for s in o.snapshots
+            ], (ctx, d, "snapshots")
+        want = np.concatenate(
+            [_pack_rows(m.allow_nc), _pack_rows(m.red_nc)], axis=1
+        )
+        assert np.array_equal(np.asarray(m.tables.id_bits), want), (
+            ctx, d, "device id_bits diverged from host state",
+        )
+
+
+def _v4_batch(w, flows, ingress=True):
+    batch = [f for f in flows if f[5] == ingress]
+    ips = ip_strings_to_u32([f[2] for f in batch])
+    eps = np.array([f[0] for f in batch], np.int32)
+    dports = np.array([f[3] for f in batch], np.int32)
+    protos = np.array([f[4] for f in batch], np.int32)
+    return ips, eps, dports, protos
+
+
+class TestMatrixSweepParity:
+    def test_matrix_vs_flow_bit_identical(self):
+        """The identity-major matrix kernel and the flow-major sweep
+        must agree bit-for-bit (any(a & b) == (sum a·b) > 0 for 0/1
+        int8): same allow/redirect maps, same packed device rows."""
+        w = World(3, n_rules=20, n_idents=20, family=4)
+        compiled, device = w.engine.snapshot()
+        eps = [i.id for i in w.ep_idents]
+        for ingress in (True, False):
+            auto = materialize_endpoints_state(
+                compiled, device, eps, ingress=ingress, sweep="auto"
+            )
+            flow = materialize_endpoints_state(
+                compiled, device, eps, ingress=ingress, sweep="flow"
+            )
+            assert auto.ep_slots == flow.ep_slots
+            assert np.array_equal(auto.allow_nc, flow.allow_nc)
+            assert np.array_equal(auto.red_nc, flow.red_nc)
+            assert np.array_equal(
+                np.asarray(auto.tables.id_bits),
+                np.asarray(flow.tables.id_bits),
+            )
+            assert [dict(s.entries) for s in auto.snapshots] == [
+                dict(s.entries) for s in flow.snapshots
+            ]
+
+
+class TestDeltaVsFullFuzz:
+    @pytest.mark.parametrize("seed", [5, 101])
+    def test_mutation_stream_parity(self, seed):
+        """Fuzzed mutation stream: every rebuild (patched or full) must
+        match the from-scratch oracle and the scalar policy oracle."""
+        w = World(seed, n_rules=16, n_idents=20, family=4)
+        pipe = w.pipe
+        pipe.rebuild()
+        d0 = _m.engine_refresh_seconds.get_count({"kind": "delta"})
+        n_patch = 0
+        for step in range(6):
+            base = dict(pipe._mat)
+            kind = w.mutate(step)
+            pipe.rebuild()
+            if all(pipe._mat.get(d) is base.get(d) for d in base):
+                n_patch += 1
+            _assert_state_parity(pipe, ctx=(seed, step, kind))
+            w.check_parity(w.random_flows(120))
+        # the stream must actually exercise the O(delta) path, and the
+        # delta-kind refresh histogram must have seen it
+        assert n_patch >= 3, f"only {n_patch}/6 mutations patched in place"
+        assert _m.engine_refresh_seconds.get_count({"kind": "delta"}) > d0
+
+    def test_coalesced_row_events_single_patch(self):
+        """Many identity deltas between rebuilds must replay as ONE
+        coalesced patch per direction (the engine-side _set_rows2
+        discipline at the pipeline layer) — and still be exact."""
+        w = World(9, n_rules=14, n_idents=16, family=4)
+        pipe = w.pipe
+        # prime: grow the packed label-word bucket past the world's
+        # initial exactly-full capacity so the measured churn below
+        # stays in-bucket (new uid labels otherwise force a full
+        # recompile, which is the OTHER path)
+        primer = [w._alloc_ident() for _ in range(4)]
+        w.engine.refresh()
+        pipe.rebuild()
+        base = dict(pipe._mat)
+        rows0 = _m.engine_delta_rows_total.get()
+        # pile up adds AND releases without rebuilding in between
+        fresh = [w._alloc_ident() for _ in range(3)]
+        w.engine.refresh()
+        w.reg.release(primer[0])
+        w.engine.refresh()
+        d0 = _m.engine_refresh_seconds.get_count({"kind": "delta"})
+        pipe.rebuild()
+        assert all(pipe._mat.get(d) is base[d] for d in base), (
+            "row backlog must patch in place, not re-materialize"
+        )
+        # one rebuild, one delta-kind observation — not one per log entry
+        assert _m.engine_refresh_seconds.get_count({"kind": "delta"}) == d0 + 1
+        assert _m.engine_delta_rows_total.get() > rows0
+        _assert_state_parity(pipe, ctx="coalesced-rows")
+        w.check_parity(w.random_flows(150))
+
+
+class TestFallbackEdges:
+    def test_log_truncation_full_fallback(self):
+        """A truncated delta ring (deltas_since → None) must fall back
+        to a full re-materialization, not serve stale state."""
+        w = World(13, n_rules=14, n_idents=16, family=4)
+        pipe = w.pipe
+        pipe.rebuild()
+        base = dict(pipe._mat)
+        w.engine.DELTA_LOG_CAP = 2  # instance override, force truncation
+        for _ in range(4):
+            ident = w._alloc_ident()
+            w.engine.refresh()
+        assert w.engine.deltas_since(pipe._last_delta_seq) is None
+        pipe.rebuild()
+        assert all(pipe._mat.get(d) is not base[d] for d in base), (
+            "truncated log must force re-materialization"
+        )
+        _assert_state_parity(pipe, ctx="truncated-log")
+        w.check_parity(w.random_flows(150))
+
+    def test_snapshot_restored_engine_full_fallback(self, tmp_path):
+        """A snapshot-restored engine carries no CompileState and logs
+        a "full" delta on restore: a pipeline over it must take the
+        full path and serve correct verdicts immediately."""
+        w = World(17, n_rules=14, n_idents=16, family=4)
+        w.pipe.rebuild()
+        path = str(tmp_path / "engine.npz")
+        w.engine.save_snapshot(path)
+
+        engine2 = PolicyEngine(w.repo, w.reg)
+        assert engine2.restore_snapshot(path, trust_counters=True) is not None
+        pipe2 = DatapathPipeline(engine2, w.ipcache, w.prefilter)
+        pipe2.set_endpoints([i.id for i in w.ep_idents])
+        pipe2.rebuild()
+        # same flows through both pipelines: identical verdicts
+        flows = w.random_flows(200)
+        for ingress in (True, False):
+            bt = _v4_batch(w, flows, ingress)
+            v1, r1 = w.pipe.process(*bt, ingress=ingress)
+            v2, r2 = pipe2.process(*bt, ingress=ingress)
+            np.testing.assert_array_equal(v1, v2)
+            np.testing.assert_array_equal(r1, r2)
+
+    def test_delta_racing_background_refresh(self, tmp_path):
+        """An untrusted restore refreshes in the BACKGROUND; a rule
+        landing during that window must reach the pipeline as a "full"
+        delta (re-materialization), never as a stale patch."""
+        w = World(19, n_rules=14, n_idents=16, family=4)
+        w.pipe.rebuild()
+        path = str(tmp_path / "engine.npz")
+        w.engine.save_snapshot(path)
+
+        engine2 = PolicyEngine(w.repo, w.reg)
+        assert engine2.restore_snapshot(path) is not None  # untrusted
+        pipe2 = DatapathPipeline(engine2, w.ipcache, w.prefilter)
+        pipe2.set_endpoints([i.id for i in w.ep_idents])
+        pipe2.rebuild()
+        base = dict(pipe2._mat)
+        # the racing delta: a rule add while the restored engine's
+        # refresh path is background-kicked
+        w.mutate(1000)  # may or may not be a rule op — force one too
+        from cilium_tpu.policy.api import EndpointSelector, IngressRule, rule
+
+        w.repo.add_list([rule(
+            ["k8s:app=frontend"],
+            ingress=[IngressRule(
+                from_endpoints=(EndpointSelector.make(["k8s:app=backend"]),),
+            )],
+            labels=["k8s:policy=race"],
+        )])
+        engine2.refresh()  # revision<0 → kicks background full refresh
+        assert engine2.wait_refreshed(60)
+        pipe2.rebuild()
+        assert all(pipe2._mat.get(d) is not base[d] for d in base), (
+            "the background recompile's full delta must re-materialize"
+        )
+        # converged: parity against the World's own (synchronous) pipe
+        w.pipe.rebuild()
+        flows = w.random_flows(200)
+        for ingress in (True, False):
+            bt = _v4_batch(w, flows, ingress)
+            v1, _ = w.pipe.process(*bt, ingress=ingress)
+            v2, _ = pipe2.process(*bt, ingress=ingress)
+            np.testing.assert_array_equal(v1, v2)
+
+
+class TestEpochSwap:
+    def test_swap_serves_old_then_publishes(self):
+        """A full recompile with EpochSwap on: the kicking rebuild must
+        keep the old generation live (dispatches uninterrupted), the
+        shadow install must publish on the NEXT rebuild, and verdicts
+        must be correct before, during, and after."""
+        w = World(11, n_rules=16, n_idents=20, family=4)
+        pipe = w.pipe
+        pipe.rebuild()
+        swaps0 = _m.engine_epoch_swaps_total.get()
+        pipe.set_epoch_swap(True)
+        old_mat = dict(pipe._mat)
+        w.engine.refresh(force=True)  # logs a "full" delta
+        pipe.rebuild()  # kicks the shadow; old epoch keeps serving
+        w.check_parity(w.random_flows(100))  # mid-build serving
+        assert pipe.wait_epoch_swap(60), "shadow build timed out"
+        assert pipe.policy_epoch == 1
+        assert _m.engine_epoch_swaps_total.get() == swaps0 + 1
+        pipe.rebuild()  # the batch-boundary publish
+        assert all(pipe._mat[d] is not old_mat[d] for d in old_mat)
+        _assert_state_parity(pipe, ctx="post-swap")
+        w.check_parity(w.random_flows(200))
+        # O(delta) routing keeps working against the swapped epoch
+        for step in range(3):
+            kind = w.mutate(step)
+            pipe.rebuild()
+            w.check_parity(w.random_flows(100))
+
+    def test_swap_off_midflight_abandons(self):
+        """set_epoch_swap(False) during a shadow build bumps the basis
+        generation: the finishing shadow must NOT install, and the next
+        rebuild falls back to the synchronous full path."""
+        w = World(23, n_rules=16, n_idents=20, family=4)
+        pipe = w.pipe
+        pipe.rebuild()
+        pipe.set_epoch_swap(True)
+        w.engine.refresh(force=True)
+        pipe.rebuild()
+        pipe.set_epoch_swap(False)  # abandon
+        pipe.wait_epoch_swap(60)
+        assert pipe.policy_epoch == 0
+        pipe.rebuild()  # synchronous full path
+        _assert_state_parity(pipe, ctx="abandoned-swap")
+        w.check_parity(w.random_flows(200))
+
+    def test_basis_bump_abandons(self):
+        """The _quarantine/_set_level generation bump (a possibly
+        poisoned or re-formed basis) must abandon an in-flight epoch —
+        a swap must never resurrect state built on the old basis."""
+        w = World(37, n_rules=12, n_idents=16, family=4)
+        pipe = w.pipe
+        pipe.rebuild()
+        pipe.set_epoch_swap(True)
+        w.engine.refresh(force=True)
+        pipe.rebuild()
+        with pipe._lock:
+            pipe._swap_gen += 1  # what _quarantine / _set_level do
+        pipe.wait_epoch_swap(60)
+        assert pipe.policy_epoch == 0
+        pipe.rebuild()
+        _assert_state_parity(pipe, ctx="gen-bump")
+        w.check_parity(w.random_flows(200))
+
+    def test_shadow_fault_classification(self):
+        """A transient/poisoned shadow-thread death degrades to the
+        synchronous full path; a programmer error re-raises."""
+        w = World(41, n_rules=12, n_idents=16, family=4)
+        pipe = w.pipe
+        pipe.rebuild()
+        pipe.set_epoch_swap(True)
+        # transient: next full-path rebuild falls back synchronously
+        pipe._shadow_exc = TimeoutError("simulated device loss")
+        w.engine.refresh(force=True)
+        base = dict(pipe._mat)
+        pipe.rebuild()
+        assert pipe._shadow_exc is None
+        assert all(pipe._mat.get(d) is not base[d] for d in base), (
+            "transient shadow death must fall back to the sync full path"
+        )
+        assert pipe.policy_epoch == 0
+        w.check_parity(w.random_flows(120))
+        # programmer error: must escape, not be eaten by self-healing
+        pipe._shadow_exc = ValueError("bug")
+        w.engine.refresh(force=True)
+        with pytest.raises(ValueError):
+            pipe.rebuild()
+
+
+class TestEpochSwapUnderFaults:
+    def test_publish_ct_flush_transient_retries(self):
+        """The publishing rebuild's CT flush is the swap's transactional
+        edge (SITE_CT_EPOCH): a transient fault there retries inside
+        process() and the batch completes on the NEW epoch — zero
+        verdicts lost."""
+        w = World(29, n_rules=14, n_idents=16, family=4)
+        pipe = w.pipe
+        pipe.rebuild()
+        pipe.retry_min_s = pipe.retry_max_s = 0.001
+        pipe.set_epoch_swap(True)
+        w.engine.refresh(force=True)
+        pipe.rebuild()
+        assert pipe.wait_epoch_swap(60) and pipe.policy_epoch == 1
+        _faults.hub.fail(
+            _faults.SITE_CT_EPOCH, _faults.KIND_TRANSIENT, times=1
+        )
+        # process() runs the publishing rebuild internally and retries
+        w.check_parity(w.random_flows(150))
+        assert pipe.failsafe_state()["quarantined_batches"] == 0
+
+    def test_publish_ct_flush_poisoned_fail_closed(self):
+        """A poisoned publish quarantines fail-closed (every verdict
+        accounted, DROP_DEGRADED) and the quarantine's basis bump must
+        not resurrect a half-swapped epoch: the next batch serves the
+        new generation with full parity."""
+        w = World(31, n_rules=14, n_idents=16, family=4)
+        pipe = w.pipe
+        pipe.rebuild()
+        pipe.retry_min_s = pipe.retry_max_s = 0.001
+        pipe.set_epoch_swap(True)
+        w.engine.refresh(force=True)
+        pipe.rebuild()
+        assert pipe.wait_epoch_swap(60) and pipe.policy_epoch == 1
+        _faults.hub.fail(
+            _faults.SITE_CT_EPOCH, _faults.KIND_POISONED, times=1
+        )
+        bt = _v4_batch(w, w.random_flows(150), ingress=True)
+        v, r = pipe.process(*bt, ingress=True)
+        assert v.shape[0] == bt[0].shape[0], "no verdicts lost"
+        assert (v == DROP_DEGRADED).all(), "degraded batch must fail closed"
+        assert not r.any()
+        assert pipe.failsafe_state()["quarantined_batches"] == 1
+        # next batch: healthy, on the new epoch, parity intact
+        w.check_parity(w.random_flows(150))
+
+    def test_complete_fault_during_pending_swap(self):
+        """A COMPLETE-site fault while a shadow build is in flight: the
+        quarantine bumps the basis generation, so the pending epoch is
+        abandoned rather than installed over a re-formed mesh."""
+        w = World(43, n_rules=14, n_idents=16, family=4)
+        pipe = w.pipe
+        pipe.rebuild()
+        pipe.retry_min_s = pipe.retry_max_s = 0.001
+        pipe.set_epoch_swap(True)
+        gen0 = pipe._swap_gen
+        w.engine.refresh(force=True)
+        pipe.rebuild()  # shadow in flight
+        _faults.hub.fail(
+            _faults.SITE_COMPLETE, _faults.KIND_POISONED, times=1
+        )
+        bt = _v4_batch(w, w.random_flows(120), ingress=True)
+        v, _ = pipe.process(*bt, ingress=True)
+        assert (v == DROP_DEGRADED).all()
+        assert pipe._swap_gen > gen0, "quarantine must bump the swap basis"
+        pipe.wait_epoch_swap(60)
+        assert pipe.policy_epoch == 0, "abandoned epoch must not install"
+        # recovery: the next rebuild re-materializes synchronously and
+        # serving converges
+        pipe.rebuild()
+        w.check_parity(w.random_flows(150))

@@ -116,6 +116,26 @@ def test_tpu004_dtype_drift():
     assert len(f) == 2
 
 
+def test_tpu005_refresh_path_pulls():
+    f = analyze_paths([fixture("hot_tpu005.py")])
+    # attr pull / .item() above a decorator / block_until_ready /
+    # forward taint through an assign
+    assert lines_of(f, "TPU005") == [14, 20, 25, 31]
+    assert all(x.severity == "error" for x in f if x.rule == "TPU005")
+    # the unmarked twin, host-data asarray, jnp upload, cleared taint,
+    # and the suppressed line all stay silent — and none of the
+    # positives double-report as TPU001 (the whole point: these pulls
+    # never touch a jnp chain, so TPU001's flow taint can't see them)
+    assert len(f) == 4
+
+
+def test_tpu005_engine_markers_stay_clean():
+    """The real refresh path (engine.py carries the markers) must be
+    pull-free — this is the regression gate policyd-delta bought."""
+    f = analyze_paths([os.path.join(PKG, "engine.py")])
+    assert lines_of(f, "TPU005") == []
+
+
 def test_hot_gating_rules_need_hot_module(tmp_path):
     cold = tmp_path / "cold.py"
     cold.write_text(
