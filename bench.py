@@ -308,6 +308,127 @@ def _bench_kafka_acl() -> float:
     return iters * len(reqs) / (time.time() - t0)
 
 
+def _bench_l7() -> dict:
+    """policyd-l7batch round: fused multi-field dispatch vs the split
+    per-field program on the SAME 16-pattern corpus the full sweep's
+    l7_dfa_rps tracks, per-length-rung rates, pipeline overlap
+    (depth 2 vs 1, packing included), and the kafka ACL rate with and
+    without device literal classification. Runs without the built
+    world — L7 tables are per-(endpoint, port), not per-rule-set."""
+    from cilium_tpu.datapath import l7_pipeline as l7rt
+    from cilium_tpu.datapath.l7_pipeline import L7Pipeline
+    from cilium_tpu.l7.regex_compile import compile_patterns
+    from cilium_tpu.ops.dfa import (
+        L7_LEN_LADDER,
+        DeviceDFATable,
+        device_dfa,
+        dfa_intern_stats,
+        dfa_match_batch,
+        dfa_match_batch_fused,
+        dfa_match_batch_pair,
+        fuse_dfas,
+        strings_to_batch,
+        strings_to_batch_u8,
+    )
+
+    patterns = [f"/api/v{i}/[a-z0-9]*" for i in range(8)] + [
+        f"/svc{i}/.*" for i in range(8)
+    ]
+    mdfa = compile_patterns(patterns)
+    b = 1 << 17
+    iters = 10
+    paths = [f"/api/v{i % 8}/obj{i % 97}".encode() for i in range(b)]
+
+    # split baseline: the exact pre-option program (one field's DFA,
+    # 64-deep unbucketed int32 walk — the definition l7_dfa_rps has
+    # carried since BENCH_r01, packing outside the timed loop)
+    dev = device_dfa(mdfa)
+    sb, lens = strings_to_batch(paths, 64)
+    sbj, lj = jnp.asarray(sb), jnp.asarray(lens)
+    jax.block_until_ready(dfa_match_batch(*dev, sbj, lj, 64)[0])
+    t0 = time.time()
+    for _ in range(iters):
+        lo, _hi = dfa_match_batch(*dev, sbj, lj, 64)
+    jax.block_until_ready(lo)
+    split_rps = iters * b / (time.time() - t0)
+
+    table = DeviceDFATable(("bench-l7",), fuse_dfas([mdfa]))
+    starts = jnp.asarray(np.zeros(b, np.int32))
+
+    # per-rung fused/pair rates, same dispatch-rate definition. The
+    # corpus tops out at 13 bytes; for the taller rungs each path grows
+    # an [a-z0-9]* tail so every row still matches its /api pattern.
+    rung_rps = {}
+    for rung in L7_LEN_LADDER:
+        rp = (
+            paths
+            if rung == L7_LEN_LADDER[0]
+            else [(p + b"x" * rung)[:rung] for p in paths]
+        )
+        usb, ulens = strings_to_batch_u8(rp, rung)
+        usbj, ulj = jnp.asarray(usb), jnp.asarray(ulens)
+        if table.has_pair:
+            def walk(r=rung, sbuf=usbj, lbuf=ulj):
+                return dfa_match_batch_pair(
+                    table.pair, table.accept_lo, table.accept_hi,
+                    starts, sbuf, lbuf, r,
+                )
+        else:
+            def walk(r=rung, sbuf=usbj, lbuf=ulj):
+                return dfa_match_batch_fused(
+                    table.trans, table.accept_lo, table.accept_hi,
+                    starts, sbuf, lbuf, r,
+                )
+        jax.block_until_ready(walk()[0])
+        t0 = time.time()
+        for _ in range(iters):
+            lo, _hi = walk()
+        jax.block_until_ready(lo)
+        rung_rps[str(rung)] = round(iters * b / (time.time() - t0))
+
+    # headline: the corpus's own rung (16) — what check_batch picks
+    fused_rps = float(rung_rps[str(L7_LEN_LADDER[0])])
+
+    # end-to-end submit() rate, packing + host_sync included, and the
+    # overlap ratio the pipeline buys (depth 2 vs fully synchronous)
+    def e2e(depth: int, it: int = 8) -> float:
+        pipe = L7Pipeline(depth=depth)
+        pipe.prewarm(table, [64])
+        for pend in [pipe.submit(table, [(paths, 64)]) for _ in range(2)]:
+            pend.result()  # warm lane buffers before timing
+        t0 = time.time()
+        pending = [pipe.submit(table, [(paths, 64)]) for _ in range(it)]
+        for pend in pending:
+            pend.result()
+        return it * b / (time.time() - t0)
+
+    e2e_d2 = e2e(2)
+    e2e_d1 = e2e(1)
+
+    # kafka in the same round (closes the r03→r04 kafka_acl_rps drop
+    # investigation: both paths, same corpus, one report)
+    kafka_host = _bench_kafka_acl()
+    l7rt.set_device_batch(True)
+    try:
+        kafka_dev = _bench_kafka_acl()
+    finally:
+        l7rt.set_device_batch(False)
+
+    return {
+        "l7_dfa_rps": round(fused_rps),
+        "split_l7_dfa_rps": round(split_rps),
+        "fused_vs_split": round(fused_rps / split_rps, 1),
+        "rung_rps": rung_rps,
+        "pair_table": bool(table.has_pair),
+        "e2e_submit_rps_depth2": round(e2e_d2),
+        "e2e_submit_rps_depth1": round(e2e_d1),
+        "overlap_ratio": round(e2e_d2 / e2e_d1, 2),
+        "kafka_acl_rps": round(kafka_host),
+        "kafka_acl_device_rps": round(kafka_dev),
+        "interned_tables": dfa_intern_stats()[0],
+    }
+
+
 def _bench_native(snaps, idents, nrng: np.random.Generator):
     """Native C++ front-end rate on the SAME materialized state (the
     per-node enforcement loop; SURVEY native census item 1). Returns
@@ -1602,6 +1723,23 @@ def main() -> None:
         float(os.environ.get("BENCH_ATTACH_ATTEMPT_TIMEOUT", 300)),
         local_fallback="--local-fallback" in sys.argv[1:],
     )
+
+    if "--l7" in sys.argv[1:]:
+        # policyd-l7batch round: fused DFA dispatch per length rung,
+        # fused-vs-split speedup, pipeline overlap ratio, and
+        # kafka_acl_rps host/device in one report — no world build
+        # needed (L7 tables are per-endpoint-port). The round driver
+        # diffs l7_dfa_rps against the full sweep's split-path number.
+        out = _bench_l7()
+        attached.set()
+        print(json.dumps({
+            "metric": "L7 fused DFA dispatch rate",
+            "value": out["l7_dfa_rps"],
+            "unit": "rps",
+            **out,
+            "backend": backend,
+        }))
+        return
 
     rng = random.Random(42)
     t0 = time.time()

@@ -165,6 +165,10 @@ class Daemon:
         self.options.set("VerdictSharding", cfg.verdict_sharding)
         self.options.set("EpochSwap", cfg.policy_epoch_swap)
         self.options.on_change(self._on_option_change)
+        # L7DeviceBatch's boot value needs its side effect (the shared
+        # L7 pipeline), so it is seeded AFTER on_change is wired
+        if cfg.l7_device_batch:
+            self.options.set("L7DeviceBatch", True)
         # fleet regeneration is synchronous by default (tests and
         # small deployments observe effects immediately); a busy node
         # sets regen_debounce > 0 to fold bursts of endpoint churn
@@ -762,6 +766,7 @@ class Daemon:
             "Conntrack", "TraceNotification", "DropNotification", "Debug",
             "PhaseTracing", "VerdictSharding", "FlowAttribution",
             "DispatchAutoTune", "FailOpen", "FaultInjection", "EpochSwap",
+            "L7DeviceBatch",
         }
     )
 
@@ -807,6 +812,18 @@ class Daemon:
             # policyd-delta: shadow-built full rebuilds swapped in at
             # a batch boundary; off abandons any in-flight shadow
             self.pipeline.set_epoch_swap(value)
+        elif name == "L7DeviceBatch":
+            # policyd-l7batch: fused, overlapped L7 classification;
+            # off drains the L7 pipeline and policies fall back to the
+            # exact pre-option per-field programs on the next batch
+            from .datapath import l7_pipeline as _l7rt
+            from .option import get_config as _get_config
+
+            _l7rt.set_device_batch(
+                value,
+                tracer=self.pipeline.tracer,
+                depth=_get_config().l7_pipeline_depth,
+            )
         elif name == "FaultInjection":
             # policyd-failsafe: arm/disarm the injection hub; off keeps
             # rules queued so a re-enable resumes a chaos scenario

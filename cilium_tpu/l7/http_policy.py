@@ -11,7 +11,13 @@ set (or the field is a wildcard) in every field's accept mask. Header
 checks are exact matches evaluated host-side (rare in practice).
 Patterns that exceed the DFA state cap fall back to host `re` matching
 — fail-safe, never fail-open.
+
+With the ``L7DeviceBatch`` runtime option on, the three per-field
+dispatches fuse into ONE device walk over an interned stacked table
+(ops.dfa.FusedDFA via datapath.l7_pipeline) — same masks, bit for bit;
+with it off, this module runs the exact pre-option path below.
 """
+# policyd: hot
 
 from __future__ import annotations
 
@@ -22,13 +28,20 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from .. import metrics
-from ..ops.dfa import match_patterns
+from ..datapath import l7_pipeline as l7rt
+from ..ops.dfa import fuse_dfas, intern_fused_table, match_patterns
 from ..policy.api import HTTPRule
-from .regex_compile import MultiDFA, RegexError, compile_patterns
+from .regex_compile import (
+    MultiDFA,
+    RegexError,
+    compile_patterns,
+    compile_patterns_cached,
+)
 
 
 # below this many strings the device DFA dispatch costs more than a
-# host table walk (and may trigger a first-use jit compile mid-request)
+# host table walk (the fused-path rungs are prewarmed at compile()
+# time, so past this floor no request eats a first-use jit compile)
 _DEVICE_BATCH_MIN = 32
 
 
@@ -87,7 +100,9 @@ class _PatternSet:
                 f"({len(self.patterns)})"
             )
         try:
-            self.dfa = compile_patterns(self.patterns)
+            # interned: N endpoints compiling the same pattern set
+            # share one host MultiDFA (and downstream, one device table)
+            self.dfa = compile_patterns_cached(self.patterns)
             self.dfa_pids = list(range(len(self.patterns)))
             return
         except RegexError:
@@ -131,7 +146,7 @@ class _PatternSet:
         n = len(values)
         if not self.patterns:
             return np.zeros(n, np.uint64)
-        out = np.zeros(n, np.uint64)
+        raw: Optional[np.ndarray] = None
         if self.dfa is not None:
             encs = [v.encode() for v in values]
             if n < _DEVICE_BATCH_MIN:
@@ -143,9 +158,26 @@ class _PatternSet:
                 )
             else:
                 raw = match_patterns(self.dfa, encs, max_len)
-                for i, enc in enumerate(encs):
-                    if len(enc) > max_len:
-                        raw[i] = np.uint64(self.dfa.match_str(enc))
+                self.correct_overlong(raw, encs, max_len)
+        return self.finish_masks(raw, values, n)
+
+    def correct_overlong(self, raw: np.ndarray, encs: Sequence[bytes],
+                         max_len: int) -> None:
+        """Rows too long for the fixed-width device walk re-run on the
+        host DFA (linear time, no backtracking) in place of the
+        fail-closed 0 the kernel produced."""
+        for i, enc in enumerate(encs):
+            if len(enc) > max_len:
+                raw[i] = np.uint64(self.dfa.match_str(enc))
+
+    def finish_masks(self, raw: Optional[np.ndarray],
+                     values: Sequence[str], n: int) -> np.ndarray:
+        """DFA accept-bit masks (``raw``, slot-indexed; None = no
+        device DFA) → pattern-id masks, plus the demoted-pattern host
+        `re` overlay. Shared tail of the split and fused paths — the
+        ON/OFF parity tests pin that both produce identical bits."""
+        out = np.zeros(n, np.uint64)
+        if raw is not None:
             if len(self.dfa_pids) == len(self.patterns):
                 out = raw  # identity mapping (no demotions)
             else:
@@ -204,6 +236,83 @@ class HTTPPolicy:
             )
         for ps in (self._methods, self._paths, self._hosts):
             ps.compile()
+        # L7DeviceBatch: fields with a device DFA fuse into one
+        # interned stacked table (built lazily if the option flips on
+        # after construction; prewarmed here when it's already on)
+        self._fused_fields: List[Tuple[_PatternSet, int]] = []
+        self._fused_table = None
+        if l7rt.device_batch_enabled():
+            self._ensure_fused()
+
+    def _ensure_fused(self) -> None:
+        fields = [
+            (ps, cap)
+            for ps, cap in (
+                (self._methods, 16),
+                (self._paths, self.max_len),
+                (self._hosts, self.max_len),
+            )
+            if ps.dfa is not None
+        ]
+        if not fields:
+            return
+        key = (
+            "http",
+            tuple(
+                tuple(ps.patterns[i] for i in ps.dfa_pids) for ps, _ in fields
+            ),
+        )
+        self._fused_table = intern_fused_table(
+            key, lambda: fuse_dfas([ps.dfa for ps, _ in fields])
+        )
+        self._fused_fields = fields
+        pipe = l7rt.shared_pipeline()
+        if pipe is not None:
+            pipe.prewarm(self._fused_table, [cap for _, cap in fields])
+
+    def _fused_masks(self, requests: Sequence[HTTPRequest]):
+        """One device dispatch for every fused field of the batch →
+        (m_mask, p_mask, h_mask), or None when the option raced off.
+        Bit-identical to the split path: same per-field overlong host
+        corrections, demotion remap and host `re` overlay."""
+        pipe = l7rt.shared_pipeline()
+        if pipe is None:
+            return None
+        if self._fused_table is None:
+            self._ensure_fused()
+            if self._fused_table is None:
+                return None
+        n = len(requests)
+        by_field = {
+            id(self._methods): [r.method for r in requests],
+            id(self._paths): [r.path for r in requests],
+            id(self._hosts): [r.host for r in requests],
+        }
+        encs = [
+            [v.encode() for v in by_field[id(ps)]]
+            for ps, _ in self._fused_fields
+        ]
+        pending = pipe.submit(
+            self._fused_table,
+            [(e, cap) for e, (_, cap) in zip(encs, self._fused_fields)],
+            parser="http",
+        )
+        raws = pending.result()
+        out = {}
+        for raw, enc, (ps, cap) in zip(raws, encs, self._fused_fields):
+            ps.correct_overlong(raw, enc, cap)
+            out[id(ps)] = ps.finish_masks(raw, by_field[id(ps)], n)
+        # fields without a device DFA (empty, or fully demoted) keep
+        # their host-only evaluation
+        masks = []
+        for ps, cap in (
+            (self._methods, 16),
+            (self._paths, self.max_len),
+            (self._hosts, self.max_len),
+        ):
+            got = out.get(id(ps))
+            masks.append(got if got is not None else ps.masks(by_field[id(ps)], cap))
+        return tuple(masks)
 
     def __len__(self) -> int:
         return len(self._rules)
@@ -214,9 +323,15 @@ class HTTPPolicy:
         n = len(requests)
         if not self._rules:
             return np.ones(n, bool)
-        m_mask = self._methods.masks([r.method for r in requests], 16)
-        p_mask = self._paths.masks([r.path for r in requests], self.max_len)
-        h_mask = self._hosts.masks([r.host for r in requests], self.max_len)
+        fused = None
+        if l7rt.device_batch_enabled() and n >= _DEVICE_BATCH_MIN:
+            fused = self._fused_masks(requests)
+        if fused is not None:
+            m_mask, p_mask, h_mask = fused
+        else:
+            m_mask = self._methods.masks([r.method for r in requests], 16)
+            p_mask = self._paths.masks([r.path for r in requests], self.max_len)
+            h_mask = self._hosts.masks([r.host for r in requests], self.max_len)
         out = np.zeros(n, bool)
         for i, req in enumerate(requests):
             for cr in self._rules:

@@ -10,16 +10,40 @@ Tensorization: api-key sets become a 32-bit mask per rule; topics and
 client-ids are interned to ids; a batch check is [B, R] broadcast
 compares — fully device-friendly, no string work per request after
 interning.
+
+With ``L7DeviceBatch`` on, the topic/client-id string→id resolution
+rides the same fused DFA path as HTTP (each interned literal becomes
+one pattern; the accept bit IS the id), sharing interned device tables
+across endpoints with the same ACL. Off, the dict-lookup path below
+runs unchanged.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from ..datapath import l7_pipeline as l7rt
+from ..ops.dfa import fuse_dfas, intern_fused_table
 from ..policy.api import KafkaRule
+from .http_policy import _DEVICE_BATCH_MIN
+from .regex_compile import RegexError, compile_patterns_cached
+
+
+def _mask_ids(mask: np.ndarray) -> np.ndarray:
+    """[B] uint64 one-hot accept masks → [B] int32 literal ids (-2 =
+    no match, the dict-lookup miss sentinel). Distinct literals are
+    disjoint, so at most one bit is set; frexp's exponent recovers the
+    bit index exactly (powers of two are exact in float64)."""
+    ids = np.full(mask.shape, -2, np.int32)
+    nz = mask != 0
+    if nz.any():
+        _, e = np.frexp(mask[nz].astype(np.float64))
+        ids[nz] = (e - 1).astype(np.int32)
+    return ids
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,6 +102,80 @@ class KafkaACL:
             for j, (_r, idents) in enumerate(self._rules)
             if idents is not None
         ]
+        # L7DeviceBatch literal classification (built lazily on first
+        # gated batch so the OFF path never touches the device)
+        self._fused_ready = False
+        self._fused_table = None
+        self._fused_fields: List[Tuple[str, int]] = []
+        if l7rt.device_batch_enabled():
+            self._ensure_fused()
+
+    def _ensure_fused(self) -> None:
+        if self._fused_ready:
+            return
+        self._fused_ready = True
+        fields: List[Tuple[str, List[str]]] = []
+        # literal ids are accept-bit positions, so id order must equal
+        # pattern order; one uint64 mask caps each map at 64 literals
+        if self._topic_ids and len(self._topic_ids) <= 64:
+            fields.append(
+                ("topic", sorted(self._topic_ids, key=self._topic_ids.get))
+            )
+        if self._cli_ids and len(self._cli_ids) <= 64:
+            fields.append(
+                ("client_id", sorted(self._cli_ids, key=self._cli_ids.get))
+            )
+        if not fields:
+            return
+        try:
+            dfas = [
+                compile_patterns_cached([re.escape(v) for v in vals])
+                for _, vals in fields
+            ]
+        except RegexError:
+            return  # state cap — the dict path serves this ACL
+        key = ("kafka", tuple((name, tuple(vals)) for name, vals in fields))
+        self._fused_table = intern_fused_table(key, lambda: fuse_dfas(dfas))
+        # a request string longer than every interned literal can't
+        # match one, so the field cap is the longest literal: overlong
+        # rows fail closed to -2, which is exactly the dict miss
+        self._fused_fields = [
+            (name, max(len(v.encode()) for v in vals)) for name, vals in fields
+        ]
+        pipe = l7rt.shared_pipeline()
+        if pipe is not None:
+            pipe.prewarm(self._fused_table, [c for _, c in self._fused_fields])
+
+    def _device_ids(
+        self, requests: Sequence[KafkaRequest]
+    ) -> Optional[Dict[str, np.ndarray]]:
+        """Resolve topic/client-id strings to interned ids on device →
+        {"topic": [B] int32, "client_id": ...} (keys only for fused
+        fields), or None when the device path doesn't apply."""
+        self._ensure_fused()
+        if self._fused_table is None:
+            return None
+        pipe = l7rt.shared_pipeline()
+        if pipe is None:
+            return None
+        by_name = {
+            "topic": lambda r: r.topic,
+            "client_id": lambda r: r.client_id,
+        }
+        encs = [
+            [by_name[name](r).encode() for r in requests]
+            for name, _ in self._fused_fields
+        ]
+        pending = pipe.submit(
+            self._fused_table,
+            [(e, cap) for e, (_, cap) in zip(encs, self._fused_fields)],
+            parser="kafka",
+        )
+        raws = pending.result()
+        return {
+            name: _mask_ids(raw)
+            for raw, (name, _) in zip(raws, self._fused_fields)
+        }
 
     def _intern_topic(self, topic: str) -> int:
         tid = self._topic_ids.get(topic)
@@ -96,9 +194,17 @@ class KafkaACL:
             return np.ones(n, bool)
         api_key = np.array([r.api_key for r in requests], np.int32)
         version = np.array([r.api_version for r in requests], np.int32)
-        topic = np.array(
-            [self._topic_ids.get(r.topic, -2) for r in requests], np.int32
+        dev = (
+            self._device_ids(requests)
+            if l7rt.device_batch_enabled() and n >= _DEVICE_BATCH_MIN
+            else None
         )
+        if dev is not None and "topic" in dev:
+            topic = dev["topic"]
+        else:
+            topic = np.array(
+                [self._topic_ids.get(r.topic, -2) for r in requests], np.int32
+            )
         # [B, R] broadcast compares (the device-friendly form; numpy here
         # because L7 batch sizes are modest — the same expressions jit
         # directly when wired into the proxy fast path).
@@ -115,10 +221,13 @@ class KafkaACL:
         # (an O(B·R) Python loop here dominated the batch rate ~20×);
         # the intern map and rule-side id array are __init__ caches
         if self._rule_cli_id is not None:
-            req_cli_id = np.array(
-                [self._cli_ids.get(r.client_id, -2) for r in requests],
-                np.int32,
-            )
+            if dev is not None and "client_id" in dev:
+                req_cli_id = dev["client_id"]
+            else:
+                req_cli_id = np.array(
+                    [self._cli_ids.get(r.client_id, -2) for r in requests],
+                    np.int32,
+                )
             ok &= (self._rule_cli_id[None, :] < 0) | (
                 self._rule_cli_id[None, :] == req_cli_id[:, None]
             )

@@ -20,6 +20,8 @@ the caller falls back to host-side matching.
 from __future__ import annotations
 
 import dataclasses
+import threading
+from collections import OrderedDict
 from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -414,3 +416,38 @@ def _accept_mask(states: FrozenSet[int], ends: Dict[int, int]) -> int:
         if idx is not None:
             mask |= 1 << idx
     return mask
+
+
+# -- compile interning ------------------------------------------------------
+# Subset construction is the expensive half of policy compile; N
+# endpoints with the same rule set produce the same pattern tuples, so
+# the host MultiDFA is interned by (patterns, max_states) — the same
+# content-addressed discipline ops.dfa uses for the device tables.
+# Successes only: a RegexError must re-raise per call site (demotion
+# probing in http_policy depends on it).
+_COMPILE_CACHE_CAP = 256
+_compile_lock = threading.Lock()
+_compile_cache: "OrderedDict[Tuple, MultiDFA]" = OrderedDict()
+
+
+def compile_patterns_cached(
+    patterns: Sequence[str], max_states: int = MAX_DFA_STATES
+) -> MultiDFA:
+    """``compile_patterns`` with an interned result. Callers must
+    treat the returned MultiDFA as immutable — it is shared."""
+    key = (tuple(patterns), max_states)
+    with _compile_lock:
+        hit = _compile_cache.get(key)
+        if hit is not None:
+            _compile_cache.move_to_end(key)
+            return hit
+    built = compile_patterns(patterns, max_states)
+    with _compile_lock:
+        raced = _compile_cache.get(key)
+        if raced is not None:
+            _compile_cache.move_to_end(key)
+            return raced
+        _compile_cache[key] = built
+        while len(_compile_cache) > _COMPILE_CACHE_CAP:
+            _compile_cache.popitem(last=False)
+    return built

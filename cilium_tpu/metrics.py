@@ -284,6 +284,36 @@ pipeline_mode = registry.gauge(
     "re-formed excluding faulted devices), 2 = host/numpy fallback",
 )
 
+# -- policyd-l7batch (fused L7 classification) families --------------------
+l7_batch_seconds = registry.histogram(
+    "cilium_tpu_l7_batch_seconds",
+    "End-to-end wall time of one L7 classification batch through the "
+    "overlapped submit() pipeline (prep → device walk → mask pull)",
+    buckets=PHASE_BUCKETS,
+)
+l7_dfa_tables_interned = registry.gauge(
+    "cilium_tpu_l7_dfa_tables_interned",
+    "Fused DFA device tables currently interned (shared across every "
+    "endpoint whose policy compiles to the same pattern-set key)",
+)
+l7_dfa_intern_total = registry.counter(
+    "cilium_tpu_l7_dfa_intern_total",
+    "Fused-table intern outcomes (result=hit: an endpoint reused an "
+    "existing device table; miss: a new table was built and "
+    "transferred; evict: LRU displacement past the cap)",
+)
+l7_pad_lanes_total = registry.counter(
+    "cilium_tpu_l7_pad_lanes_total",
+    "L7 ladder padding (kind=lane: rows dispatched to fill a lane "
+    "rung; kind=len_bytes: padded byte-steps under the length rung — "
+    "divide by the live counterpart for the pad-waste fraction)",
+)
+l7_batches_total = registry.counter(
+    "cilium_tpu_l7_batches_total",
+    "L7 request batches classified through the fused device path "
+    "(label parser: http|kafka)",
+)
+
 # -- policyd-flows (verdict attribution) families -------------------------
 rule_hits_total = registry.counter(
     "cilium_tpu_rule_hits_total",

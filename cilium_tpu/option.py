@@ -52,6 +52,14 @@ class DaemonConfig:
     # full re-materializations build on a shadow thread and swap in at
     # a batch boundary instead of stopping the verdict world.
     policy_epoch_swap: bool = False
+    # Boot-time value of the L7DeviceBatch runtime option (policyd-
+    # l7batch): batched L7 classification runs fused (one dispatch for
+    # every request field) through the overlapped submit() pipeline.
+    l7_device_batch: bool = False
+    # In-flight bound for that L7 pipeline (same semantics as
+    # verdict_pipeline_depth: 2 overlaps host packing with the device
+    # walk).
+    l7_pipeline_depth: int = 2
 
     def validate(self) -> None:
         if self.enforcement_mode not in ("default", "always", "never"):
@@ -69,6 +77,8 @@ class DaemonConfig:
             )
         if self.flow_ring_capacity < 1:
             raise ValueError("flow-ring-capacity must be >= 1")
+        if not 1 <= self.l7_pipeline_depth <= 64:
+            raise ValueError("l7-pipeline-depth must be 1-64")
 
 
 _config = DaemonConfig()
@@ -149,6 +159,14 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
             "background thread while batches keep serving the current "
             "one, then swap atomically at a batch boundary; off runs "
             "full rebuilds synchronously inside rebuild()",
+        ),
+        OptionSpec(
+            "L7DeviceBatch",
+            "Fused batched L7 classification (policyd-l7batch): "
+            "method/path/host (and kafka topic/client-id) walk one "
+            "stacked, interned DFA table in a single length-bucketed "
+            "dispatch through an overlapped submit() pipeline; off "
+            "keeps the per-field pre-option programs",
         ),
         OptionSpec(
             "FaultInjection",
