@@ -1070,6 +1070,147 @@ def _bench_chaos(repo, reg, idents, nrng: np.random.Generator, attached):
     }
 
 
+def _bench_mesh(repo, reg, idents, nrng: np.random.Generator, attached):
+    """``--mesh``: policyd-mesh round → result dict for the one-line
+    JSON. The 2D ``flows×ident`` placement against the 1D sharded
+    baseline on the SAME world and batches:
+
+    - mesh shape actually resolved (``{'flows': n/2, 'ident': 2}`` on
+      an even device count) plus the plan generation/device ids;
+    - per-device policymap bytes sharded vs replicated — the point of
+      the ident axis is that table bytes stop scaling with the full
+      identity count (reduction ≈ the ident factor);
+    - verdicts asserted bit-identical 2D vs 1D before any rate is
+      reported, so the number can never come from a diverged program;
+    - ``verdicts_vps_2d`` measured through the real pipelined submit
+      path at depth 2;
+    - the OFF path spy-asserted: with 2D off, a fresh batch shape is
+      traced with the one-hot ident-gather kernel replaced by a
+      tripwire — reaching it would mean the off path compiles the new
+      program.
+
+    Needs ≥2 visible devices to form any mesh; on one device the round
+    reports the degenerate plan instead of failing."""
+    from cilium_tpu.datapath.pipeline import DatapathPipeline
+    from cilium_tpu.engine import PolicyEngine
+    from cilium_tpu.ipcache.ipcache import IPCache
+    from cilium_tpu.ipcache.prefilter import PreFilter
+    from cilium_tpu.ops import lookup as _lookup
+
+    def mk_pipe():
+        eng = PolicyEngine(repo, reg)
+        cache = IPCache()
+        for i, ident in enumerate(idents):
+            cache.upsert(
+                f"10.{(i >> 8) & 255}.{i & 255}.1/32", ident.id, source="k8s"
+            )
+        pipe = DatapathPipeline(
+            eng, cache, PreFilter(), conntrack=None, pipeline_depth=2
+        )
+        pipe.set_endpoints([idents[j].id for j in range(N_ENDPOINTS)])
+        return pipe
+
+    b, k = 1 << 16, 6
+    batches = []
+    for _ in range(k):
+        i_sel = nrng.integers(0, len(idents), b)
+        ips = (
+            np.uint32(10) << 24
+            | ((i_sel >> 8) & 255).astype(np.uint32) << 16
+            | (i_sel & 255).astype(np.uint32) << 8
+            | 1
+        ).astype(np.uint32)
+        eps = nrng.integers(0, N_ENDPOINTS, b).astype(np.int32)
+        dports = nrng.choice(np.array([80, 443, 8080, 53, 22], np.int32), b)
+        protos = np.where(dports == 53, 17, 6).astype(np.int32)
+        batches.append((ips, eps, dports, protos))
+
+    def timed_run(pipe):
+        pipe.process(*batches[0])  # warm this mode's program
+        t0 = time.time()
+        pend = [pipe.submit(*bt) for bt in batches]
+        out = [p.result() for p in pend]
+        return time.time() - t0, out
+
+    attached.stage("mesh-1d")
+    pipe_1d = mk_pipe()
+    pipe_1d.set_sharding(True)
+    pipe_1d.rebuild()
+    t_1d, out_1d = timed_run(pipe_1d)
+
+    attached.stage("mesh-2d")
+    pipe_2d = mk_pipe()
+    pipe_2d.set_sharding(True)
+    pipe_2d.set_mesh_2d(True)
+    pipe_2d.rebuild()
+    plan = pipe_2d._plan
+    t_2d, out_2d = timed_run(pipe_2d)
+
+    attached.stage("mesh-parity")
+    for (v1, r1), (v2, r2) in zip(out_1d, out_2d):
+        np.testing.assert_array_equal(v1, v2)
+        np.testing.assert_array_equal(r1, r2)
+
+    # per-device policymap bytes: replicated = every device holds the
+    # whole table; ident-sharded = rows divide over the ident axis
+    pm_total = sum(
+        int(np.prod(m.tables.id_bits.shape)) * 4
+        for m in pipe_2d._mat.values()
+    )
+    ident = plan.ident_size if plan.is_2d else 1
+    pm_sharded = pm_total // ident
+
+    # rule_tab only materializes under FlowAttribution — flip it on for
+    # one batch to measure the [N, C] origin table under the same plan
+    attached.stage("mesh-ruletab")
+    pipe_2d.set_attribution(True)
+    pipe_2d.rebuild()
+    pipe_2d.process(*batches[0])
+    rt_total = sum(
+        int(np.prod(m.rule_tab.shape)) * 4
+        for m in pipe_2d._mat.values()
+        if m.rule_tab is not None
+    )
+    rt_sharded = rt_total // ident
+
+    # OFF-path spy: a NEW batch shape (fresh trace) with 2D off must
+    # never reach the ident-gather kernel
+    attached.stage("mesh-offspy")
+    def _trip(*a, **kw):
+        raise AssertionError("ident gather reached with MeshSharding2D off")
+    real = _lookup.ident_gather_rows
+    _lookup.ident_gather_rows = _trip
+    try:
+        spy = (
+            batches[0][0][: b // 2 + 3],
+            batches[0][1][: b // 2 + 3],
+            batches[0][2][: b // 2 + 3],
+            batches[0][3][: b // 2 + 3],
+        )
+        pipe_1d.process(*spy)
+        off_spy = "clean"
+    finally:
+        _lookup.ident_gather_rows = real
+
+    return {
+        "mesh_axes": dict(plan.axes),
+        "mesh_devices": list(plan.device_ids),
+        "ident_factor": ident,
+        "plan_generation": plan.generation,
+        "mesh_2d_formed": bool(plan.is_2d),
+        "verdicts_vps_1d": round(k * b / t_1d),
+        "verdicts_vps_2d": round(k * b / t_2d),
+        "parity_2d_vs_1d": True,  # asserted above, batch-for-batch
+        "pm_bytes_per_device_replicated": pm_total,
+        "pm_bytes_per_device_sharded": pm_sharded,
+        "pm_bytes_reduction_x": round(pm_total / max(1, pm_sharded), 2),
+        "rt_bytes_per_device_replicated": rt_total,
+        "rt_bytes_per_device_sharded": rt_sharded,
+        "off_path_spy": off_spy,
+        "placement": pipe_2d.placement_state(),
+    }
+
+
 def _bench_native_e2e(snaps, idents, nrng: np.random.Generator):
     """The native front-end's FULL per-node pipeline (conntrack probe →
     identity LPM → policymap, bpf_lxc.c end to end) — (mixed_vps,
@@ -1778,6 +1919,25 @@ def main() -> None:
             "metric": f"chaos recovery at {N_RULES} rules",
             "value": out["recovery_s"],
             "unit": "s",
+            **out,
+            "backend": backend,
+            "build_s": round(t_build, 2),
+        }))
+        return
+
+    if "--mesh" in sys.argv[1:]:
+        # policyd-mesh round: 2D flows×ident placement vs the 1D
+        # sharded baseline — the round driver gates on bit-identical
+        # parity, a clean off-path spy, and the per-device table-bytes
+        # reduction tracking the ident factor
+        out = _bench_mesh(
+            repo, reg, idents, np.random.default_rng(21), attached
+        )
+        attached.set()
+        print(json.dumps({
+            "metric": f"2D mesh verdicts/sec at {N_RULES} rules",
+            "value": out["verdicts_vps_2d"],
+            "unit": "verdicts/s",
             **out,
             "backend": backend,
             "build_s": round(t_build, 2),

@@ -1085,6 +1085,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"{adj.get('up', 0)} up / {adj.get('down', 0)} "
                         f"down step(s)"
                     )
+                pl = out.get("placement")
+                if pl and pl.get("axes"):
+                    # a formed mesh changes what a dispatch span covers
+                    # (flow shards, and under 2D an ident-axis reduce)
+                    ax = pl["axes"]
+                    shape = "×".join(
+                        f"{k}={v}" for k, v in sorted(ax.items())
+                    )
+                    print(
+                        f"placement: mesh {{{shape}}} over "
+                        f"{len(pl.get('devices', ()))} device(s), "
+                        f"generation {pl.get('generation')}"
+                        + (
+                            ", identity tables SHARDED over ident"
+                            if pl.get("ident_sharded")
+                            else ""
+                        )
+                    )
                 fs = out.get("failsafe")
                 if fs and fs.get("degraded"):
                     # a degraded ladder changes what the spans MEAN

@@ -98,6 +98,19 @@ class Daemon:
         self.services = ServiceManager()
         self.monitor = MonitorHub()
         cfg = get_config()
+        # placement intent (policyd-mesh): device subset / 2D axes /
+        # per-host process index resolve into the pipeline's MeshPlan
+        from .datapath.placement import PlacementConfig
+
+        placement = PlacementConfig(
+            device_ids=(
+                tuple(int(x) for x in cfg.mesh_devices.split(","))
+                if cfg.mesh_devices
+                else None
+            ),
+            ident_axis=cfg.mesh_ident_axis,
+            process_index=cfg.mesh_process_index,
+        )
         self.pipeline = DatapathPipeline(
             self.engine, self.ipcache, self.prefilter,
             conntrack=self.conntrack, lb=self.services,
@@ -107,6 +120,8 @@ class Daemon:
             flow_ring=FlowRing(capacity=cfg.flow_ring_capacity),
             pipeline_max_depth=cfg.verdict_pipeline_max_depth,
             epoch_swap=cfg.policy_epoch_swap,
+            placement=placement,
+            mesh_2d=cfg.mesh_sharding_2d,
         )
         # ONE controller registry for the whole daemon (pkg/controller;
         # `cilium status --all-controllers` reads it) — the endpoint
@@ -163,6 +178,7 @@ class Daemon:
         # boot value rides DaemonConfig; the pipeline already took it
         # via its ctor, so seed the map BEFORE wiring on_change
         self.options.set("VerdictSharding", cfg.verdict_sharding)
+        self.options.set("MeshSharding2D", cfg.mesh_sharding_2d)
         self.options.set("EpochSwap", cfg.policy_epoch_swap)
         self.options.on_change(self._on_option_change)
         # L7DeviceBatch's boot value needs its side effect (the shared
@@ -764,9 +780,9 @@ class Daemon:
     _MUTABLE_OPTIONS = frozenset(
         {
             "Conntrack", "TraceNotification", "DropNotification", "Debug",
-            "PhaseTracing", "VerdictSharding", "FlowAttribution",
-            "DispatchAutoTune", "FailOpen", "FaultInjection", "EpochSwap",
-            "L7DeviceBatch",
+            "PhaseTracing", "VerdictSharding", "MeshSharding2D",
+            "FlowAttribution", "DispatchAutoTune", "FailOpen",
+            "FaultInjection", "EpochSwap", "L7DeviceBatch",
         }
     )
 
@@ -795,6 +811,12 @@ class Daemon:
             # flow-sharded dispatch; placement changes on next rebuild
             # (a single-device node accepts the option as a no-op)
             self.pipeline.set_sharding(value)
+        elif name == "MeshSharding2D":
+            # policyd-mesh: 2D flows×ident mesh with ident-sharded
+            # device tables; the placement plan re-resolves on the
+            # next rebuild (a node without an even device factor
+            # degrades to the 1D plan — accepted as a no-op)
+            self.pipeline.set_mesh_2d(value)
         elif name == "FlowAttribution":
             # policyd-flows: per-flow rule attribution + flow-log ring;
             # the verdict program recompiles with the origin tail on
@@ -1069,6 +1091,10 @@ class Daemon:
             # a real degradation needs to say WHICH path produced the
             # spans (device phases vanish at host level)
             "failsafe": self.pipeline.failsafe_state(),
+            # policyd-mesh: the placement plan (mesh axes, generation,
+            # device set) — sharded vs replicated tables change what a
+            # dispatch span covers (per-device bytes, ident reduce)
+            "placement": self.pipeline.placement_state(),
             "traces": tr.traces(limit),
         }
 

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .placement import EMPTY_PLAN, MeshPlan, PlacementConfig, resolve_plan
 from .. import faults as _faults
 from .. import metrics as _metrics
 from ..engine import PolicyEngine
@@ -42,7 +43,12 @@ from ..observe.flows import SAMPLE_CAP as _FLOW_SAMPLE_CAP, FlowRecord, FlowRing
 from ..observe.tracer import NOOP_BATCH as _NOOP_BATCH, Tracer
 from ..ipcache.ipcache import IPCache
 from ..ipcache.prefilter import PreFilter
-from ..ops.lookup import PolicymapTables, lookup_batch, replicate_tables
+from ..ops.lookup import (
+    PolicymapTables,
+    lookup_batch,
+    replicate_tables,
+    shard_tables_ident,
+)
 from ..ops.lpm import (
     DENY_BIT,
     MERGED_VALUE_MASK,
@@ -58,6 +64,7 @@ from ..ops.lpm import (
 from ..ops.materialize import (
     EndpointPolicySnapshot,
     MaterializedState,
+    PlacedTables,
     TRAFFIC_EGRESS,
     TRAFFIC_INGRESS,
     materialize_endpoints_state,
@@ -208,6 +215,7 @@ def _verdict_tail(
     attrib: bool = False,
     rule_tab: Optional[jnp.ndarray] = None,
     n_rules: int = 0,
+    ident_gather: bool = False,
 ):
     """Shared post-LPM tail (policy lookup, prefilter override,
     counter matmul) — traced inside both jitted entry points so the
@@ -222,12 +230,13 @@ def _verdict_tail(
     pulled d2h only in the completion half, like the counters."""
     if not attrib:
         dec, red = lookup_batch(
-            policymap, ep_idx, peer_row, dport, proto, block=block
+            policymap, ep_idx, peer_row, dport, proto, block=block,
+            ident_gather=ident_gather,
         )
     else:
         dec, red, rule, l4x = lookup_batch(
             policymap, ep_idx, peer_row, dport, proto, block=block,
-            attrib=True, rule_tab=rule_tab,
+            attrib=True, rule_tab=rule_tab, ident_gather=ident_gather,
         )
     verdict = jnp.where(denied_pf, jnp.int8(DROP_PREFILTER), dec)
     redirect = red & ~denied_pf
@@ -282,7 +291,7 @@ def _v6_lpm_stage(t, peer_bytes, levels: int, prefilter: bool, fused: bool):
     jax.jit,
     static_argnames=(
         "ep_count", "block", "levels", "prefilter", "fused", "attrib",
-        "n_rules",
+        "n_rules", "ident_gather",
     ),
 )
 def process_flows(
@@ -300,6 +309,7 @@ def process_flows(
     attrib: bool = False,
     rule_tab: Optional[jnp.ndarray] = None,  # [N, C_pad] int32
     n_rules: int = 0,
+    ident_gather: bool = False,
 ):
     """→ (verdict[B] int8, redirect[B] bool, counters [EP, 3] int32);
     with ``attrib=True`` additionally (rule[B] int32, l4_covered[B]
@@ -329,7 +339,7 @@ def process_flows(
     return _verdict_tail(
         t.policymap, denied_pf, peer_row, ep_idx, dport, proto, ep_count,
         block, attrib=attrib, rule_tab=rule_tab if attrib else None,
-        n_rules=n_rules,
+        n_rules=n_rules, ident_gather=ident_gather,
     )
 
 
@@ -339,7 +349,7 @@ process_ipv4 = process_flows
 
 @functools.partial(
     jax.jit, static_argnames=("ep_count", "block", "prefilter", "attrib",
-                              "n_rules")
+                              "n_rules", "ident_gather")
 )
 def process_flows_wide(
     t: WideDatapathTables,
@@ -354,6 +364,7 @@ def process_flows_wide(
     attrib: bool = False,
     rule_tab: Optional[jnp.ndarray] = None,  # [N, C_pad] int32
     n_rules: int = 0,
+    ident_gather: bool = False,
 ):
     """IPv4 fast path over the wide tries — semantics identical to
     process_flows(levels=4), including the overlay row_override and
@@ -367,7 +378,7 @@ def process_flows_wide(
     return _verdict_tail(
         t.policymap, denied_pf, peer_row, ep_idx, dport, proto, ep_count,
         block, attrib=attrib, rule_tab=rule_tab if attrib else None,
-        n_rules=n_rules,
+        n_rules=n_rules, ident_gather=ident_gather,
     )
 
 
@@ -651,6 +662,8 @@ class DatapathPipeline:
         pipeline_max_depth: int = 4,
         autotune: bool = False,
         epoch_swap: bool = False,
+        placement: Optional[PlacementConfig] = None,
+        mesh_2d: bool = False,
     ) -> None:
         self.engine = engine
         self.ipcache = ipcache
@@ -726,15 +739,21 @@ class DatapathPipeline:
         self._pf_empty: Tuple[bool, bool] = (True, True)
         self._v6_fused = False  # v6 merged deny+identity trie present
         # ATOMIC read snapshot for the lock-free dispatch paths:
-        # (tables, pf_empty, v6_fused, flow_sharding, ndev, attrib)
-        # swap together — reading them as separate attributes could
-        # pair a new flag with old tables (e.g. fused=True against
+        # (tables, pf_empty, v6_fused, flow_sharding, ndev, attrib,
+        # ident2d) swap together — reading them as separate attributes
+        # could pair a new flag with old tables (e.g. fused=True against
         # placeholder merged arrays, which would resolve every v6 flow
         # to world with no denies, or a flow sharding against tables
         # placed for a different mesh, or a rule table from an older
         # rule set against newer policymaps). ``attrib`` is None (off)
-        # or ({direction: rule_tab [N, C_pad]}, n_rules).
-        self._dp_state: Tuple = ({}, (True, True), False, None, 1, None)
+        # or ({direction: rule_tab [N, C_pad]}, n_rules). ``ident2d``
+        # selects the ident-sharded gather program — it must pair with
+        # tables actually placed under P("ident"), never cross-read.
+        # ``ndev`` is the FLOWS-axis size, not the total device count:
+        # on a 2D mesh a batch splits over flows only.
+        self._dp_state: Tuple = (
+            {}, (True, True), False, None, 1, None, False,
+        )
         self._tries: Optional[Tuple] = None  # ((pf4, ip4), (pf6, ip6), world_row)
         self.counters = np.zeros((0, 3), np.int64)
         # -- bounded in-flight dispatch queue -------------------------
@@ -785,12 +804,26 @@ class DatapathPipeline:
         # "flows" axis. The dispatch-visible sharding rides _dp_state
         # so it can never pair with tables placed for a different mesh.
         self._sharding_requested = bool(sharding)
+        # -- placement subsystem (datapath/placement.py) --------------
+        # the resolved MeshPlan owns mesh construction, axis shardings
+        # and the generation counter; _mesh/_flow_sharding/
+        # _table_sharding are kept as synced mirrors (tests and older
+        # call sites read them directly).
+        self._placement = placement
+        self._mesh2d_requested = bool(mesh_2d)
+        self._plan: MeshPlan = EMPTY_PLAN
         self._mesh: Optional[Mesh] = None
         self._flow_sharding: Optional[NamedSharding] = None
         self._table_sharding: Optional[NamedSharding] = None
-        # direction → (source policymap, replicated copy): re-place
-        # only when materialization swaps the source object
-        self._placed_pm: Dict[int, Tuple[object, object]] = {}
+        # direction → (plan generation, source policymap, placed copy):
+        # re-place when materialization swaps the source object OR the
+        # plan generation moved (a ladder demotion / placement change
+        # must never serve tables placed on a stale mesh)
+        self._placed_pm: Dict[int, Tuple[int, object, object]] = {}
+        # source sel_match → (generation, ident-placed copy): the 2D
+        # plan row-shards the [N, S/32] selector-match bitmaps the
+        # materializer sweeps gather from
+        self._placed_sel: Tuple[int, object, object] = (0, None, None)
         # -- verdict attribution (FlowAttribution) --------------------
         # requested state; takes effect on the next rebuild (the sweep
         # must re-run with the attribution kernel variant to populate
@@ -800,9 +833,9 @@ class DatapathPipeline:
         # rule index → origin label (repo.origin_names()), refreshed
         # with the rule tables; read lock-free in the completion half
         self._attrib_names: List[str] = []
-        # direction → (source rule_tab, replicated copy) — the
-        # _placed_pm pattern for the attribution gather table
-        self._placed_rt: Dict[int, Tuple[object, object]] = {}
+        # direction → (plan generation, source rule_tab, placed copy) —
+        # the _placed_pm pattern for the attribution gather table
+        self._placed_rt: Dict[int, Tuple[int, object, object]] = {}
         # -- policyd-failsafe: self-healing / degradation ladder ------
         # ladder level (index into _MODE_NAMES): 0 = full device
         # complement, 1 = single-device, 2 = host fallback. Transitions
@@ -903,10 +936,34 @@ class DatapathPipeline:
             self._tries = None
             self._placed_pm.clear()
             self._placed_rt.clear()
+            self._placed_sel = (0, None, None)
             self._swap_gen += 1  # placement basis moved: abandon shadows
         # telemetry/warm caches: best-effort sets the lock-free dispatch
         # paths also mutate bare (GIL-atomic; a racing add only costs
         # one redundant compile or a miscounted cache-hit metric)
+        self._seen_shapes.clear()
+        self._warm_buckets.clear()
+
+    def set_mesh_2d(self, on: bool) -> None:
+        """Toggle 2D flows×ident mesh sharding (the MeshSharding2D
+        runtime option). Takes effect on the next rebuild through the
+        placement plan: the device grid splits into flows×ident axes
+        and the identity dimension of the policymaps / rule tables /
+        sel_match bitmaps shards over ``ident``. OFF compiles the exact
+        pre-option 1D/replicated programs (the ident-gather variant is
+        unreachable — pinned spy-style like FlowAttribution). Clears
+        placed tables and the shape/warm caches, same discipline as
+        set_sharding."""
+        with self._lock:
+            if bool(on) == self._mesh2d_requested:
+                return
+            self._mesh2d_requested = bool(on)
+            self._tables = {}
+            self._tries = None
+            self._placed_pm.clear()
+            self._placed_rt.clear()
+            self._placed_sel = (0, None, None)
+            self._swap_gen += 1  # placement basis moved: abandon shadows
         self._seen_shapes.clear()
         self._warm_buckets.clear()
 
@@ -1026,29 +1083,33 @@ class DatapathPipeline:
                     free.append(bufs)
 
     def _refresh_mesh_locked(self) -> None:
-        """Form/drop the verdict mesh to match the sharding request
+        """Resolve the placement plan to match the sharding/2D requests
         (held-lock helper for rebuild). Devices in _excluded_devices
         (a degradation-ladder descent) never join the mesh; with an
-        empty exclusion set this is exactly the pre-failsafe behavior
-        — one mesh over all visible devices, formed once."""
-        devs = jax.devices()
-        if self._excluded_devices:
-            devs = [d for d in devs if d.id not in self._excluded_devices]
-            if not devs:  # never exclude everything
-                devs = jax.devices()[:1]
-        want = self._sharding_requested and len(devs) > 1
-        if want:
-            if self._mesh is None or tuple(
-                d.id for d in self._mesh.devices.flat
-            ) != tuple(d.id for d in devs):
-                # Mesh normalizes the device list itself — no host pull
-                self._mesh = Mesh(devs, ("flows",))
-                self._flow_sharding = NamedSharding(self._mesh, P("flows"))
-                self._table_sharding = NamedSharding(self._mesh, P())
-        elif self._mesh is not None:
-            self._mesh = None
-            self._flow_sharding = None
-            self._table_sharding = None
+        empty exclusion set and no PlacementConfig this is exactly the
+        pre-placement behavior — one 1D mesh over all visible devices,
+        formed once (resolve_plan returns the previous plan unchanged
+        when nothing moved, so mesh identity is stable). The legacy
+        _mesh/_flow_sharding/_table_sharding attributes are mirrors of
+        the plan, kept for tests and older call sites."""
+        plan = resolve_plan(
+            self._placement,
+            sharding=self._sharding_requested,
+            mesh_2d=self._mesh2d_requested,
+            excluded=frozenset(self._excluded_devices),
+            prev=self._plan,
+        )
+        if plan is not self._plan:
+            self._plan = plan
+            _metrics.mesh_axis_size.set(
+                float(plan.axes.get("flows", 0)), {"axis": "flows"}
+            )
+            _metrics.mesh_axis_size.set(
+                float(plan.axes.get("ident", 0)), {"axis": "ident"}
+            )
+        self._mesh = plan.mesh
+        self._flow_sharding = plan.flow_sharding
+        self._table_sharding = plan.table_sharding
 
     # -- policyd-failsafe: ladder + classified error handling ----------
     def set_fail_open(self, on: bool) -> None:
@@ -1077,6 +1138,26 @@ class DatapathPipeline:
             "fault_injection": _faults.hub.active,
         }
 
+    def placement_state(self) -> Dict:
+        """Placement snapshot for GET /traces and the CLI traces
+        header: the resolved plan's generation, axes, and device set
+        plus the operator's requests. Resolves the plan first so a
+        just-patched option reports the mesh it WILL run on, not the
+        one the last dispatch used."""
+        with self._lock:
+            self._refresh_mesh_locked()
+        plan = self._plan
+        return {
+            "generation": plan.generation,
+            "axes": dict(plan.axes),
+            "devices": list(plan.device_ids),
+            "flows_size": plan.flows_size,
+            "mesh_2d_requested": self._mesh2d_requested,
+            "sharding_requested": self._sharding_requested,
+            "ident_sharded": plan.is_2d,
+            "excluded_devices": sorted(self._excluded_devices),
+        }
+
     def _set_level(self, level: int) -> None:
         """Move the degradation ladder (descent on a tripped breaker,
         re-promotion probe on a clean streak). Clears placed tables and
@@ -1098,14 +1179,21 @@ class DatapathPipeline:
                 # Which chip faulted is not attributable host-side (the
                 # pull fails for the whole mesh program), so keep the
                 # first and exclude the rest — the recovery probe
-                # re-admits them after a clean streak.
-                self._excluded_devices.update(
-                    d.id for d in jax.devices()[1:]
+                # re-admits them after a clean streak. The excluded set
+                # derives from the ACTIVE plan's device ids, not
+                # jax.devices(): a placement-restricted daemon must
+                # never demote onto a device it was configured not to
+                # use (the plan's first device stays; everything else
+                # the plan was using leaves the mesh).
+                plan_ids = self._plan.device_ids or tuple(
+                    d.id for d in jax.devices()
                 )
+                self._excluded_devices.update(plan_ids[1:])
             self._tables = {}
             self._tries = None
             self._placed_pm.clear()
             self._placed_rt.clear()
+            self._placed_sel = (0, None, None)
             self._breaker_faults = 0
             self._clean_batches = 0
             # a ladder move re-forms the mesh: a shadow generation
@@ -1250,6 +1338,10 @@ class DatapathPipeline:
             trie_versions = (self.ipcache.version, self.prefilter.revision)
             delta_target = self.engine.delta_seq
             compiled, device = self.engine.snapshot()
+            # 2D plan: the materializer sweeps/patches read an ident-
+            # sharded sel_match (generation-cached; the engine's own
+            # copy is untouched)
+            device = self._ident_placed_device(device)
             delta_target = max(delta_target, self.engine.delta_seq)
             ep_sig = tuple(self._endpoints)
             # captured before the trie block updates _trie_versions;
@@ -1478,7 +1570,10 @@ class DatapathPipeline:
                     policymap=pm,
                 )
             self._tables = tables
-            ndev = 1 if self._mesh is None else int(self._mesh.size)
+            # flows-axis size, NOT total device count: bucket-ladder
+            # rung rounding and chunk spans split over "flows" only
+            # (1D: the two are equal; 2D: ndev = devices / ident)
+            ndev = self._plan.flows_size
             # attribution element: present only when EVERY direction's
             # state carries a rule table (a race with a rule mutation
             # can leave one direction plain for a cycle — the racing
@@ -1497,7 +1592,26 @@ class DatapathPipeline:
                     attrib_el = (rtabs, self._attrib_n_rules)
             self._dp_state = (
                 tables, self._pf_empty, self._v6_fused,
-                self._flow_sharding, ndev, attrib_el,
+                self._flow_sharding, ndev, attrib_el, self._plan.is_2d,
+            )
+            # per-device table-bytes telemetry: under a 2D plan the
+            # identity tables split by the ident factor (within the
+            # last shard's padding); replicated/1D reports full bytes
+            ident = self._plan.ident_size if self._plan.is_2d else 1
+            pm_bytes = sum(
+                int(np.prod(m.tables.id_bits.shape)) * 4
+                for m in self._mat.values()
+            )
+            rt_bytes = sum(
+                int(np.prod(m.rule_tab.shape)) * 4
+                for m in self._mat.values()
+                if m.rule_tab is not None
+            )
+            _metrics.sharded_table_bytes.set(
+                float(pm_bytes // ident), {"family": "policymap"}
+            )
+            _metrics.sharded_table_bytes.set(
+                float(rt_bytes // ident), {"family": "rule_tab"}
             )
             if self.counters.shape[0] != len(self._endpoints):
                 self.counters = np.zeros((len(self._endpoints), 3), np.int64)
@@ -1557,22 +1671,29 @@ class DatapathPipeline:
                 touched_sids.update(payload[1])
         if row_events:
             for direction, mat in self._mat.items():
+                # patch the mesh-placed copies through the SAME scatter
+                # (PlacedTables holder) so 2D/replicated placement
+                # survives the O(delta) path without a re-place
+                placed = self._placed_holder(direction, mat)
                 patch_identity_rows(
                     mat, compiled, device, row_events,
                     attrib_origin=ao[direction == TRAFFIC_INGRESS],
-                    n_rules=nr,
+                    n_rules=nr, placed=placed,
                 )
+                self._rekey_placed(direction, mat, placed)
         if touched_sids:
             for direction, mat in self._mat.items():
+                placed = self._placed_holder(direction, mat)
                 if not patch_endpoints_state(
                     mat, compiled, device, sorted(touched_sids),
                     attrib_origin=ao[direction == TRAFFIC_INGRESS],
-                    n_rules=nr,
+                    n_rules=nr, placed=placed,
                 ):
                     # partial patches are harmless: every cell they
                     # wrote already holds its final value, and the
                     # full rebuild replaces the state wholesale
                     return None
+                self._rekey_placed(direction, mat, placed)
             # appends grow the rule set: keep the completion half's
             # rule-index → origin map in step with the patched tables
             if nr:
@@ -1585,34 +1706,102 @@ class DatapathPipeline:
         return saw_row_event, bool(touched_sids)
 
     def _replicated_policymap(self, direction: int, pm: PolicymapTables):
-        """Mesh-replicated copy of one direction's policymap, cached on
-        the source object so row patches (which swap the arrays) re-place
-        while steady-state rebuilds reuse the committed copy."""
-        if self._table_sharding is None:
+        """Mesh-placed copy of one direction's policymap, cached on the
+        source object AND the plan generation: row patches (which swap
+        the arrays) and placement changes (ladder demotion/re-promotion,
+        runtime 2D toggles) re-place, while steady-state rebuilds reuse
+        the committed copy. Under a 2D plan the identity axis shards
+        (shard_tables_ident); 1D replicates, exactly as before."""
+        plan = self._plan
+        if plan.table_sharding is None:
             return pm
-        src, placed = self._placed_pm.get(direction, (None, None))
-        if src is pm:
+        gen, src, placed = self._placed_pm.get(direction, (-1, None, None))
+        if src is pm and gen == plan.generation:
             return placed
-        placed = replicate_tables(pm, self._table_sharding)
-        self._placed_pm[direction] = (pm, placed)
+        if plan.is_2d:
+            placed = shard_tables_ident(
+                pm, plan.ident_sharding, plan.table_sharding
+            )
+        else:
+            placed = replicate_tables(pm, plan.table_sharding)
+        self._placed_pm[direction] = (plan.generation, pm, placed)
         return placed
 
     def _replicated_rule_tab(self, direction: int, rt):
-        """Mesh-replicated copy of one direction's attribution rule
-        table — the _replicated_policymap pattern (the rule gather
-        reads arbitrary identity rows per flow, so the table must be
-        whole on every device a flow shard lands on)."""
-        if self._table_sharding is None:
+        """Mesh-placed copy of one direction's attribution rule table —
+        the _replicated_policymap pattern (generation-keyed). 1D keeps
+        it whole on every device the flow shards land on; the 2D plan
+        row-shards it like id_bits (the rule gather becomes the same
+        ident-axis one-hot contraction)."""
+        plan = self._plan
+        if plan.table_sharding is None:
             return rt
-        src, placed = self._placed_rt.get(direction, (None, None))
-        if src is rt:
+        gen, src, placed = self._placed_rt.get(direction, (-1, None, None))
+        if src is rt and gen == plan.generation:
             return placed
         # identity-cached: the transfer fires only when a rebuild
         # swapped the rule table (same cadence + same _lock as the
         # sibling _replicated_policymap's replicate_tables placement)
-        placed = jax.device_put(rt, self._table_sharding)  # policyd-lint: disable=LOCK002
-        self._placed_rt[direction] = (rt, placed)
+        sh = plan.ident_sharding if plan.is_2d else plan.table_sharding
+        placed = jax.device_put(rt, sh)  # policyd-lint: disable=LOCK002
+        self._placed_rt[direction] = (plan.generation, rt, placed)
         return placed
+
+    def _ident_placed_device(self, device):
+        """DevicePolicy view with sel_match re-placed under the 2D
+        plan's ident sharding (generation-cached on the source array).
+        Non-2D plans return the snapshot untouched. The engine's own
+        device object is never mutated — the pipeline's sweeps just
+        read through a sharded copy so the [N, S/32] selector-match
+        matrix also stops replicating at scale."""
+        plan = self._plan
+        if not plan.is_2d:
+            return device
+        gen, src, placed = self._placed_sel
+        if src is not device.sel_match or gen != plan.generation:
+            placed = jax.device_put(  # policyd-lint: disable=LOCK002
+                device.sel_match, plan.ident_sharding
+            )
+            self._placed_sel = (plan.generation, device.sel_match, placed)
+        return device.replace(sel_match=placed)
+
+    def _placed_holder(self, direction: int, mat) -> Optional[PlacedTables]:
+        """PlacedTables view of the direction's CURRENT placed-table
+        cache entries, for the O(delta) patch paths to scatter into.
+        None when nothing valid is cached (unplaced pipeline, source
+        swap, or plan-generation move) — the next rebuild re-places
+        wholesale instead."""
+        plan = self._plan
+        if plan.table_sharding is None:
+            return None
+        gen, src, ppm = self._placed_pm.get(direction, (-1, None, None))
+        if src is not mat.tables or gen != plan.generation:
+            return None
+        holder = PlacedTables(tables=ppm)
+        rgen, rsrc, prt = self._placed_rt.get(direction, (-1, None, None))
+        if (
+            mat.rule_tab is not None
+            and rsrc is mat.rule_tab
+            and rgen == plan.generation
+        ):
+            holder.rule_tab = prt
+        return holder
+
+    def _rekey_placed(self, direction: int, mat, holder) -> None:
+        """Re-key the placed caches after an in-place patch: the patch
+        swapped both the host-materialized arrays AND the placed copies
+        (same scatter), so the cache entries move to the new source
+        objects without any re-place transfer."""
+        if holder is None:
+            return
+        plan = self._plan
+        self._placed_pm[direction] = (
+            plan.generation, mat.tables, holder.tables
+        )
+        if holder.rule_tab is not None and mat.rule_tab is not None:
+            self._placed_rt[direction] = (
+                plan.generation, mat.rule_tab, holder.rule_tab
+            )
 
     def _attrib_origins(self, compiled):
         """({ingress_bool: AttribTables|None}, n_rules) for the current
@@ -2144,6 +2333,7 @@ class DatapathPipeline:
         self, t, peer_bytes, ep_idx, dports, protos, row_override,
         lo, hi, padded, *, family, pf_stage, ep_count, v6_fused,
         flow_sharding, rule_tab=None, n_rules=0, staging=None,
+        ident_gather=False,
     ):
         """Pad + upload + enqueue ONE chunk; returns the UN-PULLED
         device (verdict, redirect, counters) triple. Under sharding
@@ -2197,13 +2387,13 @@ class DatapathPipeline:
                 t, peer, ei, dp, pr, ep_count=ep_count,
                 prefilter=pf_stage, row_override=ro,
                 attrib=rule_tab is not None, rule_tab=rule_tab,
-                n_rules=n_rules,
+                n_rules=n_rules, ident_gather=ident_gather,
             )
         return process_flows(
             t, peer, ei, dp, pr, ep_count=ep_count, levels=16,
             prefilter=pf_stage, fused=v6_fused, row_override=ro,
             attrib=rule_tab is not None, rule_tab=rule_tab,
-            n_rules=n_rules,
+            n_rules=n_rules, ident_gather=ident_gather,
         )
 
     # -- policyd-failsafe: ladder level 2 (host fallback) ---------------
@@ -2366,6 +2556,7 @@ class DatapathPipeline:
         # describe
         (
             tables_map, pf_empty, v6_fused, flow_sharding, ndev, attrib_el,
+            ident2d,
         ) = self._dp_state
         t = tables_map[(direction, family)]
         rule_tab = None
@@ -2397,7 +2588,7 @@ class DatapathPipeline:
                 key = (
                     direction, family, padded, pf_stage, ep_count,
                     row_override is not None, v6_fused, ndev > 1,
-                    rule_tab is not None,
+                    rule_tab is not None, ident2d,
                 )
                 if key in self._seen_shapes:
                     _metrics.jit_shape_buckets_total.inc(
@@ -2430,7 +2621,7 @@ class DatapathPipeline:
                     lo, hi, padded, family=family, pf_stage=pf_stage,
                     ep_count=ep_count, v6_fused=v6_fused,
                     flow_sharding=flow_sharding, rule_tab=rule_tab,
-                    n_rules=n_rules, staging=staging,
+                    n_rules=n_rules, staging=staging, ident_gather=ident2d,
                 )
                 for lo, hi, padded in spans
             ]
@@ -3058,7 +3249,10 @@ class DatapathPipeline:
         # same atomic snapshot rule as _dispatch (fused flag must match
         # the tables it was computed with); the fused CT program is not
         # attributed — its drops keep the generic policy reason
-        tables_map, pf_empty, v6_fused, _fs, _ndev, _at = self._dp_state
+        # the fused CT path keeps the plain jnp.take gather even under
+        # a 2D plan (GSPMD all-gathers the sharded table — correct,
+        # just unoptimized; the CT program is not ident-aware yet)
+        tables_map, pf_empty, v6_fused, _fs, _ndev, _at, _i2d = self._dp_state
         t = tables_map[(direction, family)]
         b = peer_bytes.shape[0]
         pad = _bucket(b) - b

@@ -1,0 +1,222 @@
+"""Placement subsystem — device selection and mesh planning.
+
+The pipeline historically formed an implicit 1D ``Mesh(jax.devices(),
+("flows",))`` inline: every visible device joined the mesh and every
+table was fully replicated onto all of them. That is the single-host,
+single-tenant assumption. This module makes placement an explicit,
+testable object:
+
+  PlacementConfig  — what the operator asked for (device subset,
+                     2D ``flows×ident`` axes, per-host process index)
+  MeshPlan         — what the pipeline actually runs on (the mesh,
+                     the axis shardings, a generation counter)
+
+``resolve_plan`` is the only constructor of MeshPlans. It is pure with
+respect to its inputs (config + requested modes + excluded set +
+previous plan), so the failsafe ladder, the runtime options, and the
+daemon boot path all re-form the mesh through one piece of logic. The
+generation counter bumps whenever the resolved device set or axis
+layout changes — callers key placed-table caches on it so a ladder
+demotion/re-promotion can never serve tables placed on a stale mesh.
+
+2D sharding splits the device grid into ``flows × ident``: flow
+batches shard over the ``flows`` axis exactly as before, while the
+identity dimension (dim 0) of the policymap bitmaps / rule tables /
+sel_match matrices shards over ``ident`` — per-device table bytes
+stop scaling with the full identity count. LPM trie nodes stay
+replicated (their gathers are row-random per flow, not identity-
+indexed). With ``ident`` of size 1 or 2D off, the plan degenerates to
+the exact historical 1D/replicated layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementConfig:
+    """Operator-facing placement intent (DaemonConfig → pipeline ctor).
+
+    ``device_ids``: explicit device subset (None = all visible).
+    ``ident_axis``: requested size of the ``ident`` mesh axis when 2D
+    sharding is on; the resolver shrinks it to the largest factor of
+    the eligible device count that fits (≥2, else the plan stays 1D).
+    ``process_index``: on multi-host platforms, restrict the plan to
+    devices owned by this process (single-host: 0 matches everything;
+    a non-matching index falls back to the unfiltered set rather than
+    an empty mesh so a misconfigured daemon degrades, not crashes).
+    """
+
+    device_ids: Optional[Tuple[int, ...]] = None
+    ident_axis: int = 2
+    process_index: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A resolved placement: the mesh the pipeline runs on right now.
+
+    ``flow_sharding`` places per-flow batch arrays (``P("flows")``),
+    ``table_sharding`` replicates across the whole mesh (``P()``), and
+    ``ident_sharding`` — non-None only on a 2D plan — row-shards
+    ``[N, *]`` identity tables (``P("ident", None)``). ``flows_size``
+    is the flows-axis extent: the bucket-ladder rung rounding and the
+    per-shard span math use it, NOT the total device count (on a
+    ``{'flows': 4, 'ident': 2}`` mesh a batch splits 4 ways, not 8).
+    """
+
+    generation: int
+    mesh: Optional[Mesh]
+    flow_sharding: Optional[NamedSharding]
+    table_sharding: Optional[NamedSharding]
+    ident_sharding: Optional[NamedSharding]
+    flows_size: int
+    device_ids: Tuple[int, ...]
+    axes: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    @property
+    def is_2d(self) -> bool:
+        return self.ident_sharding is not None
+
+    @property
+    def ident_size(self) -> int:
+        return self.axes.get("ident", 1)
+
+
+#: The pre-mesh state: host-only, no placement at all. Callers start
+#: from this so the first resolve always bumps to generation 1.
+EMPTY_PLAN = MeshPlan(
+    generation=0,
+    mesh=None,
+    flow_sharding=None,
+    table_sharding=None,
+    ident_sharding=None,
+    flows_size=1,
+    device_ids=(),
+    axes={},
+)
+
+
+def _ident_factor(n: int, want: int) -> int:
+    """Largest factor of ``n`` that is ≤ ``want`` and ≥ 2 (1 = no 2D
+    split possible — odd/prime device counts fall back to 1D)."""
+    best = 1
+    for f in range(2, max(2, want) + 1):
+        if f <= n and n % f == 0:
+            best = f
+    return best
+
+
+def eligible_devices(
+    config: Optional[PlacementConfig],
+    excluded: FrozenSet[int] = frozenset(),
+):
+    """Devices the plan may use, in a deterministic order: the config
+    subset (or all visible), filtered to this host's process, minus
+    the failsafe-excluded set. Never returns empty: exclusion of every
+    eligible device falls back to the FIRST CONFIG-ELIGIBLE device —
+    not ``jax.devices()[0]`` — so a placement-restricted daemon never
+    demotes onto hardware it was told not to touch."""
+    all_devs = jax.devices()
+    if config is not None and config.device_ids:
+        wanted = set(config.device_ids)
+        devs = [d for d in all_devs if d.id in wanted]
+        if not devs:  # config names no visible device: degrade to all
+            devs = list(all_devs)
+    else:
+        devs = list(all_devs)
+    if config is not None:
+        proc = [d for d in devs if d.process_index == config.process_index]
+        if proc:
+            devs = proc
+    live = [d for d in devs if d.id not in excluded]
+    if not live:
+        live = devs[:1]
+    return live
+
+
+def resolve_plan(
+    config: Optional[PlacementConfig],
+    *,
+    sharding: bool,
+    mesh_2d: bool = False,
+    excluded: FrozenSet[int] = frozenset(),
+    prev: Optional[MeshPlan] = None,
+) -> MeshPlan:
+    """Resolve the placement intent into a MeshPlan.
+
+    Returns ``prev`` unchanged (same generation) when the resolved
+    device tuple AND axis layout match it — mesh identity is stable
+    across no-op refreshes so jit caches and placed tables survive.
+    Any real change (device lost to the ladder, sharding/2D toggled,
+    config swap) produces a new plan with ``prev.generation + 1``.
+    """
+    prev = prev or EMPTY_PLAN
+    devs = eligible_devices(config, excluded)
+    n = len(devs)
+
+    want_mesh = sharding and n > 1
+    ident = 0
+    if want_mesh and mesh_2d:
+        want = config.ident_axis if config is not None else 2
+        f = _ident_factor(n, want)
+        if f >= 2 and n // f >= 1:
+            ident = f
+
+    if ident >= 2:
+        axes = {"flows": n // ident, "ident": ident}
+    elif want_mesh:
+        axes = {"flows": n}
+    else:
+        axes = {}
+
+    ids = tuple(d.id for d in devs)
+    if ids == prev.device_ids and axes == prev.axes:
+        return prev
+
+    gen = prev.generation + 1
+    if not want_mesh:
+        return MeshPlan(
+            generation=gen,
+            mesh=None,
+            flow_sharding=None,
+            table_sharding=None,
+            ident_sharding=None,
+            flows_size=1,
+            device_ids=ids,
+            axes={},
+        )
+
+    if ident >= 2:
+        grid = np.array(devs).reshape(n // ident, ident)
+        mesh = Mesh(grid, ("flows", "ident"))
+        return MeshPlan(
+            generation=gen,
+            mesh=mesh,
+            flow_sharding=NamedSharding(mesh, P("flows")),
+            table_sharding=NamedSharding(mesh, P()),
+            # one spec serves every [N, *] rank-2 identity table
+            # (id_bits, rule_tab, sel_match): rows shard, words stay
+            ident_sharding=NamedSharding(mesh, P("ident", None)),
+            flows_size=n // ident,
+            device_ids=ids,
+            axes=axes,
+        )
+
+    mesh = Mesh(np.array(devs), ("flows",))
+    return MeshPlan(
+        generation=gen,
+        mesh=mesh,
+        flow_sharding=NamedSharding(mesh, P("flows")),
+        table_sharding=NamedSharding(mesh, P()),
+        ident_sharding=None,
+        flows_size=n,
+        device_ids=ids,
+        axes=axes,
+    )

@@ -284,6 +284,21 @@ pipeline_mode = registry.gauge(
     "re-formed excluding faulted devices), 2 = host/numpy fallback",
 )
 
+# -- policyd-mesh (placement + identity sharding) families -----------------
+mesh_axis_size = registry.gauge(
+    "cilium_tpu_mesh_axis_size",
+    "Resolved verdict-mesh axis extents (label axis: flows|ident; 0 = "
+    "axis absent — no mesh or no 2D split). flows × ident = devices in "
+    "the active placement plan",
+)
+sharded_table_bytes = registry.gauge(
+    "cilium_tpu_sharded_table_bytes",
+    "PER-DEVICE bytes of the identity-indexed device tables under the "
+    "active placement (label family: policymap|rule_tab; a 2D "
+    "flows×ident plan divides the replicated footprint by the ident "
+    "axis size, within last-shard padding)",
+)
+
 # -- policyd-l7batch (fused L7 classification) families --------------------
 l7_batch_seconds = registry.histogram(
     "cilium_tpu_l7_batch_seconds",

@@ -68,6 +68,62 @@ def replicate_tables(t: PolicymapTables, sharding=None) -> PolicymapTables:
     return jax.device_put(t, sharding)
 
 
+def shard_tables_ident(
+    t: PolicymapTables, ident_sharding, replicated
+) -> PolicymapTables:
+    """Commit a policymap with the identity axis SHARDED: the [N, 2W]
+    bitmap rows split across the mesh's ``ident`` axis (each device
+    holds N/ident rows) while the [C] column metadata — tiny, read by
+    every flow — stays replicated. The row-gather then runs as a
+    one-hot contraction over the sharded N dim (``ident_gather_rows``)
+    with GSPMD inserting the ident-axis reduce; per-device policymap
+    bytes drop by the ident factor."""
+    return jax.device_put(
+        t,
+        PolicymapTables(
+            col_ep=replicated,
+            col_port=replicated,
+            col_proto=replicated,
+            col_is_l3=replicated,
+            id_bits=ident_sharding,
+        ),
+    )
+
+
+def _onehot_rows_i32(tab_i32: jnp.ndarray, src: jnp.ndarray) -> jnp.ndarray:
+    """[N, W] int32 table, [b] int32 row ids → [b, W] int32 row gather
+    expressed as a one-hot matmul. Bit-exact vs ``jnp.take``: each
+    one-hot row has EXACTLY one 1 (src is a valid row index), so every
+    output word is 0+...+word+...+0 = word — integer adds, no rounding.
+    The contraction runs over N, which under ``P("ident", None)`` is
+    the sharded dim: XLA keeps each device's partial product local and
+    all-reduces over the ident axis, i.e. the gather visits only the
+    rows a device owns. (``jnp.take`` on a sharded operand would
+    all-gather the whole table first, defeating the sharding.)"""
+    n = tab_i32.shape[0]
+    onehot = (src[:, None] == jnp.arange(n, dtype=src.dtype)[None, :]).astype(
+        jnp.int32
+    )
+    return jax.lax.dot_general(
+        onehot,
+        tab_i32,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+
+def ident_gather_rows(tab: jnp.ndarray, src: jnp.ndarray) -> jnp.ndarray:
+    """Sharded-friendly row gather for identity tables. uint32 bitmap
+    words round-trip through a BITCAST to int32 (astype would be a
+    value conversion with implementation-defined wrap; bitcast is the
+    identity on the wire) so the one-hot contraction stays on the
+    integer MXU path."""
+    if tab.dtype == jnp.uint32:
+        out = _onehot_rows_i32(jax.lax.bitcast_convert_type(tab, jnp.int32), src)
+        return jax.lax.bitcast_convert_type(out, jnp.uint32)
+    return _onehot_rows_i32(tab.astype(jnp.int32), src)
+
+
 @jax.jit
 def patch_bitmap_cols(
     tab: jnp.ndarray,  # [N, W]
@@ -84,7 +140,9 @@ def patch_bitmap_cols(
     return tab.at[:, col_idx].set(cols)
 
 
-@functools.partial(jax.jit, static_argnames=("block", "attrib"))
+@functools.partial(
+    jax.jit, static_argnames=("block", "attrib", "ident_gather")
+)
 def lookup_batch(
     t: PolicymapTables,
     ep_idx: jnp.ndarray,  # [B] int32 local endpoint index
@@ -94,6 +152,7 @@ def lookup_batch(
     block: int = 16384,
     attrib: bool = False,
     rule_tab: jnp.ndarray = None,  # [N, C_pad] int32 (attrib only)
+    ident_gather: bool = False,
 ):
     """→ (decision[B] int8, redirect[B] bool).
 
@@ -116,7 +175,15 @@ def lookup_batch(
 
     def one(args):
         ep, port, prt, src = args
-        both = unpack_bits_u32(jnp.take(t.id_bits, src, axis=0)).astype(bool)
+        # ident_gather (static): the 2D-mesh row fetch — a one-hot
+        # contraction over the ident-sharded N dim instead of a take
+        # (which would all-gather the table). False traces the exact
+        # historical program: MeshSharding2D's OFF path is pinned.
+        if ident_gather:
+            rows = ident_gather_rows(t.id_bits, src)
+        else:
+            rows = jnp.take(t.id_bits, src, axis=0)
+        both = unpack_bits_u32(rows).astype(bool)
         allow_bits = both[:, : w * 32]
         red_bits = both[:, w * 32:]
         colsel = (ep[:, None] == t.col_ep[None, :]) & (
@@ -156,7 +223,10 @@ def lookup_batch(
                 ),
             ),
         )
-        rule_rows = jnp.take(rule_tab, src, axis=0)  # [b, C_pad]
+        if ident_gather:
+            rule_rows = ident_gather_rows(rule_tab, src)  # [b, C_pad]
+        else:
+            rule_rows = jnp.take(rule_tab, src, axis=0)  # [b, C_pad]
         rule_at = jnp.take_along_axis(
             rule_rows, jnp.clip(col, 0, None)[:, None], axis=1
         )[:, 0]

@@ -583,6 +583,20 @@ def _patch_bitmap_rows(
     return id_bits.at[idx].set(comb_rows)
 
 
+@dataclasses.dataclass
+class PlacedTables:
+    """Mutable holder for the mesh-placed copies of a materialized
+    direction's device tables (the pipeline's per-direction cache).
+    The patch paths scatter the SAME idx/vals into these copies so the
+    O(delta) discipline survives placement: a jit ``.at[].set`` on a
+    sharded operand keeps the operand's sharding (GSPMD propagates it
+    through the scatter), so a row patch under ``P("ident", None)``
+    stays O(delta) per device — no re-place, no all-gather."""
+
+    tables: PolicymapTables
+    rule_tab: Optional[jnp.ndarray] = None
+
+
 def patch_identity_rows(
     state: MaterializedState,
     compiled: CompiledPolicy,
@@ -592,6 +606,7 @@ def patch_identity_rows(
     block: int = 8192,
     attrib_origin: Optional[AttribTables] = None,
     n_rules: int = 0,
+    placed: Optional[PlacedTables] = None,
 ) -> None:
     """Apply identity-churn row updates to a materialized policymap.
 
@@ -728,10 +743,25 @@ def patch_identity_rows(
         state.tables.id_bits, jnp.asarray(idx), jnp.asarray(comb_rows)
     )
     state.tables = state.tables.replace(id_bits=new_bits)
-    if state.rule_nc is not None and state.rule_tab is not None:
-        state.rule_tab = _patch_bitmap_rows(
-            state.rule_tab, jnp.asarray(idx), jnp.asarray(state.rule_nc[idx])
+    if placed is not None:
+        # same scatter onto the mesh-placed copy: sharding propagates
+        # through .at[].set, so the placed tables stay placed
+        placed.tables = placed.tables.replace(
+            id_bits=_patch_bitmap_rows(
+                placed.tables.id_bits,
+                jnp.asarray(idx),
+                jnp.asarray(comb_rows),
+            )
         )
+    if state.rule_nc is not None and state.rule_tab is not None:
+        rvals = jnp.asarray(state.rule_nc[idx])
+        state.rule_tab = _patch_bitmap_rows(
+            state.rule_tab, jnp.asarray(idx), rvals
+        )
+        if placed is not None and placed.rule_tab is not None:
+            placed.rule_tab = _patch_bitmap_rows(
+                placed.rule_tab, jnp.asarray(idx), rvals
+            )
 
 
 def _pack_rows(rows_bool: np.ndarray) -> np.ndarray:
@@ -780,6 +810,7 @@ def patch_endpoints_state(
     attrib_origin: Optional[AttribTables] = None,
     n_rules: int = 0,
     sweep: str = "auto",
+    placed: Optional[PlacedTables] = None,
 ) -> bool:
     """O(delta) column rematerialization for a rule append/delete.
 
@@ -927,6 +958,12 @@ def patch_endpoints_state(
             state.tables.id_bits, jnp.asarray(idx), jnp.asarray(vals)
         )
     )
+    if placed is not None:
+        placed.tables = placed.tables.replace(
+            id_bits=patch_bitmap_cols(
+                placed.tables.id_bits, jnp.asarray(idx), jnp.asarray(vals)
+            )
+        )
     if state.rule_nc is not None and state.rule_tab is not None:
         ridx, rvals = _pad_cols_pow2(
             np.asarray(touched_cols, np.int32),
@@ -935,4 +972,8 @@ def patch_endpoints_state(
         state.rule_tab = patch_bitmap_cols(
             state.rule_tab, jnp.asarray(ridx), jnp.asarray(rvals)
         )
+        if placed is not None and placed.rule_tab is not None:
+            placed.rule_tab = patch_bitmap_cols(
+                placed.rule_tab, jnp.asarray(ridx), jnp.asarray(rvals)
+            )
     return True

@@ -45,6 +45,21 @@ class DaemonConfig:
     # batches split across jax.devices(), tables replicated). Only
     # takes effect with >1 visible device.
     verdict_sharding: bool = False
+    # Boot-time value of the MeshSharding2D runtime option (policyd-
+    # mesh): the verdict mesh splits into explicit flows×ident axes
+    # and the identity dimension of the policymaps / rule tables /
+    # sel_match bitmaps shards over "ident". Requires VerdictSharding
+    # and ≥2 eligible devices with an even factor.
+    mesh_sharding_2d: bool = False
+    # Requested ident-axis extent for the 2D mesh; the placement plan
+    # shrinks it to the largest factor of the eligible device count.
+    mesh_ident_axis: int = 2
+    # Explicit device subset for the placement plan: comma-separated
+    # device ids ("" = all visible devices).
+    mesh_devices: str = ""
+    # On multi-host platforms, restrict the plan to devices owned by
+    # this process index (single-host: 0 matches everything).
+    mesh_process_index: int = 0
     # Capacity of the sampled flow-log ring (observe/flows.py) serving
     # GET /flows while FlowAttribution is on.
     flow_ring_capacity: int = 1024
@@ -79,6 +94,21 @@ class DaemonConfig:
             raise ValueError("flow-ring-capacity must be >= 1")
         if not 1 <= self.l7_pipeline_depth <= 64:
             raise ValueError("l7-pipeline-depth must be 1-64")
+        if not 2 <= self.mesh_ident_axis <= 64:
+            raise ValueError("mesh-ident-axis must be 2-64")
+        if self.mesh_process_index < 0:
+            raise ValueError("mesh-process-index must be >= 0")
+        if self.mesh_devices:
+            try:
+                ids = [int(x) for x in self.mesh_devices.split(",")]
+            except ValueError:
+                raise ValueError(
+                    "mesh-devices must be comma-separated device ids"
+                )
+            if len(ids) != len(set(ids)) or any(i < 0 for i in ids):
+                raise ValueError(
+                    "mesh-devices must be distinct non-negative ids"
+                )
 
 
 _config = DaemonConfig()
@@ -131,6 +161,16 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
             "VerdictSharding",
             "Flow-sharded verdict dispatch across jax.devices() "
             "(tables replicated, batches split; needs >1 device)",
+        ),
+        OptionSpec(
+            "MeshSharding2D",
+            "2D flows×ident verdict mesh (policyd-mesh): the placement "
+            "plan splits the device grid into explicit flows and ident "
+            "axes and shards the identity dimension of the policymap / "
+            "rule-table / sel_match device tables over ident (per-device "
+            "table bytes divide by the ident factor); off keeps the "
+            "exact 1D/replicated pre-option programs",
+            requires=("VerdictSharding",),
         ),
         OptionSpec(
             "FlowAttribution",

@@ -246,6 +246,7 @@ class TestSurfaces:
                            "flow_attribution": False,
                            "autotune": None,
                            "failsafe": d.pipeline.failsafe_state(),
+                           "placement": d.pipeline.placement_state(),
                            "traces": []}
             # healthy baseline: the failsafe block reports level 0
             assert out["failsafe"]["mode"] == "sharded"

@@ -1,0 +1,462 @@
+"""policyd-mesh: the placement subsystem and 2D flows×ident sharding.
+
+The load-bearing guarantees:
+
+- ``resolve_plan`` is the single constructor of meshes: device subsets,
+  process filtering, failsafe exclusion, and the 2D ident factoring all
+  resolve through it, and the generation counter bumps exactly when the
+  device set or axis layout changes;
+- 2D ``flows×ident`` dispatch (identity tables row-sharded over the
+  ident axis, gathers turned into one-hot contractions with an
+  ident-axis reduce) is verdict-, redirect-, and counter-identical to
+  the 1D sharded path and the unsharded path — including the widest
+  variants (FlowAttribution, depth-2 submit, CT replay) and across
+  O(delta) patches applied through the sharded placement;
+- the OFF path compiles the exact pre-option programs: the ident-gather
+  kernel is unreachable and the traced phase set is unchanged;
+- the failsafe single-device demotion derives its exclusion set from
+  the ACTIVE MeshPlan — a placement-restricted daemon never demotes
+  onto hardware it was told not to touch — and the placed-table caches
+  are keyed on plan generation so a ladder move can never serve tables
+  placed on a stale mesh.
+
+Runs on the virtual 8-device CPU mesh from conftest.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from __graft_entry__ import _build_datapath_world, _make_ip_flows
+from test_policygen_fuzz import World
+
+from cilium_tpu import faults as _faults
+from cilium_tpu.datapath.conntrack import FlowConntrack
+from cilium_tpu.datapath.pipeline import DatapathPipeline
+from cilium_tpu.datapath.placement import (
+    EMPTY_PLAN,
+    PlacementConfig,
+    _ident_factor,
+    resolve_plan,
+)
+from cilium_tpu.ops import lookup as _lookup
+from cilium_tpu.ops.lookup import ident_gather_rows
+
+
+@pytest.fixture(autouse=True)
+def _clean_hub():
+    _faults.hub.reset()
+    yield
+    _faults.hub.reset()
+
+
+def _batches(idents, k: int, b: int, seed0: int):
+    return [_make_ip_flows(idents, b, seed=seed0 + i) for i in range(k)]
+
+
+def _mesh_world(seed=3, *, depth=1, ct=False, placement=None):
+    pipe, engine, idents = _build_datapath_world(seed=seed)
+    out = DatapathPipeline(
+        engine, pipe.ipcache, pipe.prefilter,
+        conntrack=FlowConntrack(capacity_bits=12) if ct else None,
+        pipeline_depth=depth, placement=placement,
+    )
+    out.set_endpoints([i.id for i in idents[:4]])
+    out.rebuild()
+    return out, idents
+
+
+# ---------------------------------------------------------------------------
+class TestResolvePlan:
+    def test_1d_plan_over_all_devices(self):
+        plan = resolve_plan(None, sharding=True)
+        n = len(jax.devices())
+        assert plan.generation == 1
+        assert plan.axes == {"flows": n}
+        assert plan.flows_size == n
+        assert not plan.is_2d and plan.ident_size == 1
+        assert plan.table_sharding.spec == P()
+
+    def test_2d_plan_factors_ident(self):
+        plan = resolve_plan(None, sharding=True, mesh_2d=True)
+        n = len(jax.devices())
+        assert plan.is_2d
+        assert plan.axes == {"flows": n // 2, "ident": 2}
+        assert plan.flows_size == n // 2
+        # one spec serves every [N, *] identity table: rows shard
+        assert plan.ident_sharding.spec == P("ident", None)
+
+    def test_requested_ident_axis_shrinks_to_factor(self):
+        cfg = PlacementConfig(ident_axis=4)
+        plan = resolve_plan(cfg, sharding=True, mesh_2d=True)
+        n = len(jax.devices())
+        assert plan.axes == {"flows": n // 4, "ident": 4}
+        assert _ident_factor(6, 4) == 3
+        assert _ident_factor(7, 4) == 1  # prime → no 2D split
+
+    def test_odd_device_count_falls_back_to_1d(self):
+        cfg = PlacementConfig(device_ids=(0, 1, 2))
+        plan = resolve_plan(cfg, sharding=True, mesh_2d=True)
+        assert not plan.is_2d
+        assert plan.axes == {"flows": 3}
+
+    def test_plan_identity_is_stable(self):
+        """Same inputs re-resolved return the SAME plan object — jit
+        caches and placed tables survive no-op refreshes."""
+        p1 = resolve_plan(None, sharding=True, mesh_2d=True)
+        p2 = resolve_plan(None, sharding=True, mesh_2d=True, prev=p1)
+        assert p2 is p1
+
+    def test_generation_bumps_on_every_real_change(self):
+        p1 = resolve_plan(None, sharding=True)
+        p2 = resolve_plan(None, sharding=True, mesh_2d=True, prev=p1)
+        assert p2.generation == p1.generation + 1
+        p3 = resolve_plan(
+            None, sharding=True, mesh_2d=True,
+            excluded=frozenset({jax.devices()[-1].id}), prev=p2,
+        )
+        assert p3.generation == p2.generation + 1
+        assert len(p3.device_ids) == len(jax.devices()) - 1
+
+    def test_device_subset_config(self):
+        cfg = PlacementConfig(device_ids=(2, 3, 4, 5), ident_axis=2)
+        plan = resolve_plan(cfg, sharding=True, mesh_2d=True)
+        assert plan.device_ids == (2, 3, 4, 5)
+        assert plan.axes == {"flows": 2, "ident": 2}
+
+    def test_exclusion_falls_back_to_config_eligible_device(self):
+        """Excluding every eligible device must degrade onto the first
+        CONFIG-eligible device, not jax.devices()[0]."""
+        cfg = PlacementConfig(device_ids=(2, 3, 4, 5))
+        plan = resolve_plan(
+            cfg, sharding=True, excluded=frozenset({2, 3, 4, 5})
+        )
+        assert plan.device_ids == (2,)
+        assert plan.mesh is None  # one device → no mesh
+
+    def test_no_sharding_means_no_mesh(self):
+        plan = resolve_plan(None, sharding=False, mesh_2d=True)
+        assert plan.mesh is None and plan.flows_size == 1
+        assert plan.axes == {}
+        assert EMPTY_PLAN.generation == 0
+
+
+# ---------------------------------------------------------------------------
+class TestIdentGather:
+    def test_one_hot_gather_matches_take(self):
+        """The contraction-based gather is bit-exact vs jnp.take for
+        both uint32 bitmaps (bitcast round-trip, no wrap semantics)
+        and int32 rule tables — replicated and ident-sharded."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        tab_u = rng.integers(0, 2**32, size=(96, 6), dtype=np.uint64)
+        tab_u = tab_u.astype(np.uint32)
+        tab_i = rng.integers(-2**31, 2**31 - 1, size=(96, 6)).astype(np.int32)
+        src = rng.integers(0, 96, size=41).astype(np.int32)
+
+        got_u = np.asarray(ident_gather_rows(jnp.asarray(tab_u), jnp.asarray(src)))
+        got_i = np.asarray(ident_gather_rows(jnp.asarray(tab_i), jnp.asarray(src)))
+        np.testing.assert_array_equal(got_u, tab_u[src])
+        np.testing.assert_array_equal(got_i, tab_i[src])
+
+        plan = resolve_plan(None, sharding=True, mesh_2d=True)
+        sharded = jax.device_put(jnp.asarray(tab_u), plan.ident_sharding)
+        got_s = np.asarray(ident_gather_rows(sharded, jnp.asarray(src)))
+        np.testing.assert_array_equal(got_s, tab_u[src])
+
+
+# ---------------------------------------------------------------------------
+class TestMeshParity:
+    @pytest.fixture(autouse=True)
+    def _need_devices(self):
+        if len(jax.devices()) < 4:
+            pytest.skip("needs >=4 devices for a 2D flows×ident mesh")
+
+    @pytest.mark.parametrize("b", [512, 509])
+    def test_2d_matches_1d_and_unsharded(self, b):
+        """2D dispatch (sharded tables, ident-reduce gathers) vs 1D
+        sharded vs unsharded — even and odd batch sizes (odd forces
+        pad-to-flows-axis-multiple)."""
+        pipe_2d, _, idents = _build_datapath_world(seed=3)
+        pipe_2d.set_sharding(True)
+        pipe_2d.set_mesh_2d(True)
+        pipe_2d.rebuild()
+        assert pipe_2d._plan.is_2d
+        pipe_1d, _, _ = _build_datapath_world(seed=3)
+        pipe_1d.set_sharding(True)
+        pipe_1d.rebuild()
+        pipe_u, _, _ = _build_datapath_world(seed=3)
+
+        for seed in (20, 21):
+            p, e, d, pr = _make_ip_flows(idents, b, seed=seed)
+            v2, r2 = pipe_2d.process(p, e, d, pr)
+            v1, r1 = pipe_1d.process(p, e, d, pr)
+            vu, ru = pipe_u.process(p, e, d, pr)
+            np.testing.assert_array_equal(v2, v1)
+            np.testing.assert_array_equal(v2, vu)
+            np.testing.assert_array_equal(r2, r1)
+            np.testing.assert_array_equal(r2, ru)
+        np.testing.assert_array_equal(pipe_2d.counters, pipe_u.counters)
+
+    def test_2d_ct_pipelined_matches_sync(self):
+        """2D sharding + depth-2 submit + CT pre-pass with a replayed
+        batch (established-entry hits) vs fully synchronous 1-device."""
+        pipe_s, idents = _mesh_world(depth=2, ct=True)
+        pipe_s.set_sharding(True)
+        pipe_s.set_mesh_2d(True)
+        pipe_s.rebuild()
+        pipe_u, _ = _mesh_world(depth=1, ct=True)
+
+        rng = np.random.default_rng(5)
+        batches = _batches(idents, 4, 250, seed0=30)
+        sports = [rng.integers(1024, 4096, 250).astype(np.int32)
+                  for _ in batches]
+        batches.append(batches[0])
+        sports.append(sports[0])
+
+        pend = [pipe_s.submit(p, e, d, pr, sports=sp)
+                for (p, e, d, pr), sp in zip(batches, sports)]
+        got = [pb.result() for pb in pend]
+        for (p, e, d, pr), sp, (v_s, red_s) in zip(batches, sports, got):
+            v_u, red_u = pipe_u.process(p, e, d, pr, sports=sp)
+            np.testing.assert_array_equal(v_s, v_u)
+            np.testing.assert_array_equal(red_s, red_u)
+        np.testing.assert_array_equal(pipe_s.counters, pipe_u.counters)
+        assert len(pipe_s.conntrack) == len(pipe_u.conntrack)
+
+    def test_2d_attribution_wide_path(self):
+        """The widest program variant — FlowAttribution + 2D sharding +
+        depth 2 — still matches the plain synchronous path, and the
+        sel_match matrix really sits ident-sharded on device."""
+        wide, idents = _mesh_world(seed=5, depth=2, ct=True)
+        wide.set_sharding(True)
+        wide.set_mesh_2d(True)
+        wide.set_attribution(True)
+        wide.rebuild()
+        plain, _ = _mesh_world(seed=5, depth=1, ct=True)
+
+        _gen, _src, placed_sel = wide._placed_sel
+        assert placed_sel is not None
+        assert placed_sel.sharding.spec == P("ident", None)
+
+        rng = np.random.default_rng(7)
+        batches = _batches(idents, 4, 512, seed0=60)
+        batches.append(batches[0])
+        sports = [rng.integers(1024, 4096, 512).astype(np.int32)
+                  for _ in batches]
+        sports[-1] = sports[0]
+
+        pend = [wide.submit(p, e, d, pr, sports=s)
+                for (p, e, d, pr), s in zip(batches, sports)]
+        got = [pb.result() for pb in pend]
+        for (p, e, d, pr), s, (v1, r1) in zip(batches, sports, got):
+            v0, r0 = plain.process(p, e, d, pr, sports=s)
+            np.testing.assert_array_equal(v0, v1)
+            np.testing.assert_array_equal(r0, r1)
+        assert wide.flow_ring.recorded > 0
+
+    def test_delta_patches_preserve_ident_sharding(self):
+        """A fuzzed mutation stream against a 2D pipeline: every
+        O(delta) patch must land in the ident-sharded placed tables
+        (same rows as the host state, sharding spec intact) and the
+        scalar policy oracle must agree throughout."""
+        w = World(5, n_rules=16, n_idents=20, family=4)
+        pipe = w.pipe
+        pipe.set_sharding(True)
+        pipe.set_mesh_2d(True)
+        pipe.rebuild()
+        assert pipe._plan.is_2d
+
+        n_patch = 0
+        for step in range(6):
+            base = dict(pipe._mat)
+            w.mutate(step)
+            pipe.rebuild()
+            if all(pipe._mat.get(d) is base.get(d) for d in base):
+                n_patch += 1
+            w.check_parity(w.random_flows(120))
+            for d, m in pipe._mat.items():
+                gen, src, placed = pipe._placed_pm.get(d, (-1, None, None))
+                if src is m.tables:
+                    assert gen == pipe._plan.generation
+                    assert placed.id_bits.sharding.spec == P("ident", None)
+                    np.testing.assert_array_equal(
+                        np.asarray(placed.id_bits),
+                        np.asarray(m.tables.id_bits),
+                    )
+        assert n_patch >= 3, f"only {n_patch}/6 mutations patched in place"
+
+    def test_mesh_2d_toggles_off(self):
+        pipe, _, idents = _build_datapath_world(seed=3)
+        pipe.set_sharding(True)
+        pipe.set_mesh_2d(True)
+        pipe.rebuild()
+        assert pipe._plan.is_2d
+        pipe.set_mesh_2d(False)
+        pipe.rebuild()
+        assert not pipe._plan.is_2d
+        assert pipe._plan.axes == {"flows": len(jax.devices())}
+        ref, _, _ = _build_datapath_world(seed=3)
+        p, e, d, pr = _make_ip_flows(idents, 128, seed=1)
+        v, r = pipe.process(p, e, d, pr)
+        v0, r0 = ref.process(p, e, d, pr)
+        np.testing.assert_array_equal(v, v0)
+        np.testing.assert_array_equal(r, r0)
+
+
+# ---------------------------------------------------------------------------
+class TestOffPathProgram:
+    def test_off_never_invokes_ident_gather(self, monkeypatch):
+        """With MeshSharding2D off the one-hot gather kernel must be
+        unreachable — 1D sharded and unsharded dispatch both keep the
+        plain jnp.take programs."""
+        def _boom(*a, **k):
+            raise AssertionError("ident gather invoked with 2D off")
+        monkeypatch.setattr(_lookup, "ident_gather_rows", _boom)
+
+        pipe_u, _, idents = _build_datapath_world(seed=3)
+        pipe_s, _, _ = _build_datapath_world(seed=3)
+        pipe_s.set_sharding(True)
+        pipe_s.rebuild()
+        for p, e, d, pr in _batches(idents, 2, 192, seed0=40):
+            v_u, _ = pipe_u.process(p, e, d, pr)
+            v_s, _ = pipe_s.process(p, e, d, pr)
+            np.testing.assert_array_equal(v_u, v_s)
+
+    def test_off_path_phase_set_unchanged(self):
+        """A pipeline that had 2D toggled on and back off must trace
+        the exact same phase set as one that never meshed 2D — the off
+        path runs the program shipped before policyd-mesh."""
+        a, idents = _mesh_world(ct=True)
+        a.set_sharding(True)
+        a.rebuild()
+        b, _ = _mesh_world(ct=True)
+        b.set_sharding(True)
+        b.set_mesh_2d(True)
+        b.set_mesh_2d(False)
+        b.rebuild()
+        a.tracer.enable()
+        b.tracer.enable()
+        for p, e, d, pr in _batches(idents, 2, 256, seed0=40):
+            va, _ = a.process(p, e, d, pr)
+            vb, _ = b.process(p, e, d, pr)
+            np.testing.assert_array_equal(va, vb)
+        names_a = {ph[0] for t in a.tracer.traces() for ph in t["phases"]}
+        names_b = {ph[0] for t in b.tracer.traces() for ph in t["phases"]}
+        assert names_a == names_b
+
+
+# ---------------------------------------------------------------------------
+class TestLadderPlacement:
+    def _trippy(self, placement=None, mesh_2d=False):
+        base, engine, idents = _build_datapath_world(seed=3)
+        pipe = DatapathPipeline(
+            engine, base.ipcache, base.prefilter,
+            sharding=True, placement=placement, mesh_2d=mesh_2d,
+        )
+        pipe.set_endpoints([i.id for i in idents[:4]])
+        pipe.rebuild()
+        pipe.breaker_threshold = 2
+        pipe.recover_after_clean = 3
+        pipe.retry_min_s = pipe.retry_max_s = 0.001
+        return pipe, idents
+
+    def test_single_device_demotion_respects_placement(self):
+        """The ladder's single-device exclusion derives from the ACTIVE
+        MeshPlan: a pipeline restricted to devices (2,3,4,5) demotes
+        onto device 2, never jax.devices()[0]."""
+        cfg = PlacementConfig(device_ids=(2, 3, 4, 5))
+        pipe, idents = self._trippy(placement=cfg)
+        assert pipe.placement_state()["devices"] == [2, 3, 4, 5]
+        bt = _make_ip_flows(idents, 96, seed=5)
+        ref_v, ref_r = pipe.process(*bt)
+
+        for _ in range(2):
+            _faults.hub.fail(_faults.SITE_COMPLETE, _faults.KIND_POISONED, 1)
+            pipe.process(*bt)
+        assert pipe.pipeline_mode == "single-device"
+        assert sorted(
+            pipe.placement_state()["excluded_devices"]
+        ) == [3, 4, 5]
+        v, r = pipe.process(*bt)  # next dispatch re-resolves the plan
+        np.testing.assert_array_equal(v, ref_v)
+        np.testing.assert_array_equal(r, ref_r)
+        assert pipe.placement_state()["devices"] == [2]
+
+    def test_ladder_reforms_2d_mesh_and_rekeys_caches(self):
+        """Demote a 2D pipeline to single-device and re-promote: the
+        mesh re-forms through resolve_plan each way, the generation
+        counter moves, and the placed-table caches only ever serve
+        entries keyed to the CURRENT generation."""
+        pipe, idents = self._trippy(mesh_2d=True)
+        assert pipe._plan.is_2d
+        gen0 = pipe._plan.generation
+        bt = _make_ip_flows(idents, 96, seed=5)
+        ref_v, _ = pipe.process(*bt)
+        assert all(g == gen0 for g, _s, _p in pipe._placed_pm.values())
+
+        for _ in range(2):
+            _faults.hub.fail(_faults.SITE_COMPLETE, _faults.KIND_POISONED, 1)
+            pipe.process(*bt)
+        assert pipe.pipeline_mode == "single-device"
+        v, _ = pipe.process(*bt)  # next dispatch re-resolves the plan
+        np.testing.assert_array_equal(v, ref_v)
+        assert pipe._plan.generation > gen0
+        assert not pipe._plan.is_2d
+        gen1 = pipe._plan.generation
+        assert all(g == gen1 for g, _s, _p in pipe._placed_pm.values())
+
+        rounds = 0
+        while pipe.pipeline_mode != "sharded" and rounds < 32:
+            pipe.process(*bt)
+            rounds += 1
+        assert pipe.pipeline_mode == "sharded"
+        v, _ = pipe.process(*bt)  # re-forms the mesh on this dispatch
+        np.testing.assert_array_equal(v, ref_v)
+        assert pipe._plan.is_2d  # 2D re-forms on re-promotion
+        assert pipe._plan.generation > gen1
+        gen2 = pipe._plan.generation
+        assert all(g == gen2 for g, _s, _p in pipe._placed_pm.values())
+
+
+# ---------------------------------------------------------------------------
+class TestDaemonWiring:
+    def test_option_requires_and_traces_placement(self, tmp_path):
+        """MeshSharding2D force-enables VerdictSharding, flows into the
+        pipeline, and the placement block shows up on GET /traces."""
+        from cilium_tpu.daemon import Daemon
+
+        d = Daemon(state_dir=str(tmp_path), conntrack=False)
+        try:
+            out = d.config_patch({"MeshSharding2D": "true"})
+            assert "MeshSharding2D" in out["changed"]
+            assert d.options.get("VerdictSharding") is True
+            assert d.pipeline._mesh2d_requested is True
+            tr = d.traces()
+            pl = tr["placement"]
+            assert pl["mesh_2d_requested"] is True
+            d.config_patch({"MeshSharding2D": "false"})
+            assert d.pipeline._mesh2d_requested is False
+        finally:
+            d.shutdown()
+
+    def test_config_validation(self):
+        from cilium_tpu.option import DaemonConfig
+
+        DaemonConfig(mesh_devices="0,2,4").validate()
+        with pytest.raises(ValueError):
+            DaemonConfig(mesh_ident_axis=1).validate()
+        with pytest.raises(ValueError):
+            DaemonConfig(mesh_devices="0,0").validate()
+        with pytest.raises(ValueError):
+            DaemonConfig(mesh_devices="a,b").validate()
+        with pytest.raises(ValueError):
+            DaemonConfig(mesh_process_index=-1).validate()
