@@ -1042,6 +1042,9 @@ def _bench_chaos(repo, reg, idents, nrng: np.random.Generator, attached):
     _faults.hub.fail(_faults.SITE_ATTACH, _faults.KIND_TRANSIENT, times=1)
     reattached = _attach_backend(attached, 60.0, attempts=2)
 
+    # overload: oversubscribed storm with queue_full + stall injected
+    overload = _chaos_overload(eng, cache, idents, nrng, attached)
+
     snap = _faults.hub.snapshot()
     _faults.hub.reset()
     sites = sorted({k.split(":")[0] for k in snap["injected"]})
@@ -1067,7 +1070,219 @@ def _bench_chaos(repo, reg, idents, nrng: np.random.Generator, attached):
         "final_mode": pipe.pipeline_mode,
         "reattached": reattached,
         "failsafe": pipe.failsafe_state(),
+        "overload": overload,
     }
+
+
+def _chaos_overload(eng, cache, idents, nrng, attached):
+    """Overload sub-round of ``--chaos``: a 10x-oversubscribed submit
+    storm against a pipeline with AdmissionControl + Prefilter armed, a
+    250ms verdict deadline, and the stuck-dispatch watchdog at 100ms,
+    with queue_full + stall faults injected mid-storm. Gates:
+
+    - ``verdicts_lost`` computed from the returned result() arrays (a
+      shed flow still comes back with a verdict) — must be 0;
+    - per-submit wall time stays bounded (``queue_wait_p99_ms``): the
+      gate sheds or defers instead of letting callers pile up behind
+      the device;
+    - shed flows carry DROP_PREFILTER and land in the reason-144
+      counter (``reason_144_flows`` vs ``shed_verdict_flows``);
+    - the stall injections trip the breaker, and clean traffic after
+      the storm re-promotes the ladder to ``pipeline_mode=sharded``."""
+    from cilium_tpu import faults as _faults
+    from cilium_tpu import metrics as _m
+    from cilium_tpu.datapath.pipeline import (
+        DROP_PREFILTER,
+        DatapathPipeline,
+        ipv4_to_bytes,
+    )
+    from cilium_tpu.ipcache.prefilter import PreFilter
+
+    attached.stage("chaos-overload")
+    pipe = DatapathPipeline(
+        eng, cache, PreFilter(), conntrack=None, pipeline_depth=2,
+        admission=True, prefilter_shed=True, deadline_ms=250.0,
+    )
+    pipe.set_endpoints([idents[j].id for j in range(N_ENDPOINTS)])
+    pipe.breaker_threshold = 2
+    pipe.recover_after_clean = 3
+    pipe.retry_min_s = pipe.retry_max_s = 0.001
+
+    b = 1 << 11
+    n_world = (b * 4) // 5  # 80% unknown sources on ephemeral ports
+    storm = []
+    for _ in range(20):  # depth 2 -> 10x oversubscription
+        i_sel = nrng.integers(0, len(idents), b - n_world)
+        legit = (
+            np.uint32(10) << 24
+            | ((i_sel >> 8) & 255).astype(np.uint32) << 16
+            | (i_sel & 255).astype(np.uint32) << 8
+            | 1
+        ).astype(np.uint32)
+        world = (
+            nrng.integers(11, 200, n_world).astype(np.uint32) << 24
+            | nrng.integers(0, 1 << 24, n_world).astype(np.uint32)
+        )
+        ips = np.concatenate([world, legit])
+        eps = nrng.integers(0, N_ENDPOINTS, b).astype(np.int32)
+        dports = np.concatenate([
+            nrng.integers(32768, 61000, n_world).astype(np.int32),
+            nrng.choice(np.array([80, 443], np.int32), b - n_world),
+        ])
+        storm.append((ips, eps, dports, np.full(b, 6, np.int32)))
+
+    # warm the verdict jit AND the shed walk before arming the 100ms
+    # watchdog — first-compile pulls take seconds on CPU and must not
+    # read as wedges
+    v_warm, _ = pipe.process(*storm[0])
+    pipe._shed_walk(
+        ipv4_to_bytes(storm[0][0]), storm[0][2], storm[0][3], family=4
+    )
+    pipe.set_stall_ms(100.0)
+
+    reason0 = _m.drop_reasons_total.get({"reason": "prefilter"})
+    _faults.hub.fail(_faults.SITE_QUEUE_FULL, _faults.KIND_TRANSIENT, times=4)
+    _faults.hub.fail(_faults.SITE_STALL, _faults.KIND_TRANSIENT, times=2)
+
+    submitted = 0
+    submit_walls = []
+    pendings = []
+    for bt in storm:
+        submitted += bt[0].shape[0]
+        t0 = time.monotonic()
+        pendings.append(pipe.submit(*bt))
+        submit_walls.append(time.monotonic() - t0)
+
+    resolved = 0
+    shed_verdicts = 0
+    for pend in pendings:
+        v, _red = pend.result()
+        resolved += int(v.shape[0])
+        shed_verdicts += int((v == DROP_PREFILTER).sum())
+
+    # the stall injections fed the breaker — clean traffic must walk
+    # the ladder back up without a restart
+    attached.stage("chaos-overload-recover")
+    recovery_rounds = 0
+    while pipe.pipeline_mode != "sharded" and recovery_rounds < 64:
+        pipe.process(*storm[recovery_rounds % 2])
+        recovery_rounds += 1
+    v_after, _ = pipe.process(*storm[0])
+
+    adm = pipe.admission_state()
+    pipe.set_stall_ms(0)
+    return {
+        "oversubscription": len(storm) * b // (2 * b),
+        "submitted": submitted,
+        "verdicts_lost": submitted - resolved,
+        "queue_wait_p99_ms": round(
+            float(np.percentile(np.array(submit_walls), 99)) * 1e3, 2
+        ),
+        "shed_verdict_flows": shed_verdicts,
+        "reason_144_flows": int(
+            _m.drop_reasons_total.get({"reason": "prefilter"}) - reason0
+        ),
+        "admission_limit": adm["limit"],
+        "admission_shed": adm["shed"],
+        "watchdog_stalls": (adm.get("watchdog") or {}).get("stalls", 0),
+        "overload_recovery_rounds": recovery_rounds,
+        "final_mode": pipe.pipeline_mode,
+        "recovered_parity": bool(np.array_equal(v_after, v_warm)),
+    }
+
+
+def _bench_overload(repo, reg, idents, nrng: np.random.Generator, attached):
+    """``--overload``: policyd-overload round → result dict for the
+    one-line JSON. A deny-heavy DoS mix (90% unknown world sources on
+    ephemeral ports, 10% legitimate identities on service ports)
+    measured two ways on the SAME batches:
+
+    - ``full_vps``: the complete verdict path at pipeline depth 2;
+    - ``prefilter_shed_vps``: the coarse [identity, proto/port-class]
+      shed gather the admission gate runs ahead of the full path.
+
+    The round driver gates on ``shed_over_full_ratio >= 3`` — the shed
+    stage only earns its place in the gate if it disposes of the DoS
+    bulk at a multiple of full-pipeline rate — and on ``shed_sound``:
+    no flow the full path would FORWARD may appear in the shed mask
+    (the gate re-labels deny-for-sure flows only)."""
+    from cilium_tpu.datapath.pipeline import (
+        FORWARD,
+        DatapathPipeline,
+        ipv4_to_bytes,
+    )
+    from cilium_tpu.engine import PolicyEngine
+    from cilium_tpu.ipcache.ipcache import IPCache
+    from cilium_tpu.ipcache.prefilter import PreFilter
+
+    eng = PolicyEngine(repo, reg)
+    cache = IPCache()
+    for i, ident in enumerate(idents):
+        cache.upsert(
+            f"10.{(i >> 8) & 255}.{i & 255}.1/32", ident.id, source="k8s"
+        )
+    pipe = DatapathPipeline(
+        eng, cache, PreFilter(), conntrack=None, pipeline_depth=2,
+        prefilter_shed=True,
+    )
+    pipe.set_endpoints([idents[j].id for j in range(N_ENDPOINTS)])
+    attached.stage("overload-build")
+
+    b = 1 << 14
+    n_legit = b // 10
+    n_world = b - n_legit
+    world = (
+        nrng.integers(11, 200, n_world).astype(np.uint32) << 24
+        | nrng.integers(0, 1 << 24, n_world).astype(np.uint32)
+    )
+    i_sel = nrng.integers(0, len(idents), n_legit)
+    legit = (
+        np.uint32(10) << 24
+        | ((i_sel >> 8) & 255).astype(np.uint32) << 16
+        | (i_sel & 255).astype(np.uint32) << 8
+        | 1
+    ).astype(np.uint32)
+    ips = np.concatenate([world, legit])
+    eps = nrng.integers(0, N_ENDPOINTS, b).astype(np.int32)
+    dports = np.concatenate([
+        nrng.integers(32768, 61000, n_world).astype(np.int32),
+        nrng.choice(np.array([80, 443], np.int32), n_legit),
+    ])
+    protos = np.full(b, 6, np.int32)
+    peer_bytes = ipv4_to_bytes(ips)
+
+    attached.stage("overload-full-path")
+    v_full, _ = pipe.process(ips, eps, dports, protos)  # warm
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        pipe.process(ips, eps, dports, protos)
+    full_vps = b * iters / (time.perf_counter() - t0)
+
+    attached.stage("overload-shed-walk")
+    mask = pipe._shed_walk(peer_bytes, dports, protos, family=4)  # warm
+    if mask is None:
+        raise RuntimeError("prefilter shed table not published")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        pipe._shed_walk(peer_bytes, dports, protos, family=4)
+    shed_vps = b * iters / (time.perf_counter() - t0)
+
+    # soundness before any rate is reported: the shed mask may only
+    # cover flows the full path denies
+    shed_sound = not bool(np.any(mask & (v_full == FORWARD)))
+    return {
+        "full_vps": round(full_vps),
+        "prefilter_shed_vps": round(shed_vps),
+        "shed_over_full_ratio": round(shed_vps / full_vps, 2),
+        "shed_fraction": round(float(mask.mean()), 4),
+        "shed_sound": shed_sound,
+        "deny_fraction": round(float((v_full != FORWARD).mean()), 4),
+        "batch": b,
+        "pipeline_depth": 2,
+        "admission": pipe.admission_state(),
+    }
+
 
 
 def _bench_mesh(repo, reg, idents, nrng: np.random.Generator, attached):
@@ -1728,6 +1943,9 @@ def _attach_watchdog(timeout_s: float) -> _AttachStages:
             "vs_baseline": 0.0,
             "attach_stage": st.last,
             "attach_history": st.history,
+            # never comparable to device rates AND machine-greppable:
+            # a wedged round must still leave one parseable record
+            "backend": "attach-timeout",
             "error": (
                 f"TPU attach did not complete within {timeout_s:.0f}s "
                 f"(axon tunnel wedged?) — last completed stage: "
@@ -1763,6 +1981,11 @@ def _attach_backend(
 
         def probe():
             try:
+                if os.environ.get("BENCH_FAKE_HUNG_ATTACH"):
+                    # regression hook (r05's wedge): park exactly like a
+                    # dead axon tunnel so tests can drive the timeout
+                    # path without real hardware
+                    time.sleep(3600)
                 from cilium_tpu import faults as _faults
 
                 if _faults.hub.active:
@@ -1803,6 +2026,7 @@ def _attach_backend(
             "vs_baseline": 0.0,
             "attach_stage": attached.last,
             "attach_history": attached.history,
+            "backend": "attach-timeout",
             "error": (
                 f"TPU attach failed after {attempts} bounded attempt(s) "
                 f"({attempt_timeout_s:.0f}s each) — last stage: "
@@ -1919,6 +2143,23 @@ def main() -> None:
             "metric": f"chaos recovery at {N_RULES} rules",
             "value": out["recovery_s"],
             "unit": "s",
+            **out,
+            "backend": backend,
+            "build_s": round(t_build, 2),
+        }))
+        return
+
+    if "--overload" in sys.argv[1:]:
+        # policyd-overload round: deny-heavy DoS mix — the round driver
+        # gates on shed_over_full_ratio >= 3 and shed_sound
+        out = _bench_overload(
+            repo, reg, idents, np.random.default_rng(21), attached
+        )
+        attached.set()
+        print(json.dumps({
+            "metric": f"prefilter shed rate at {N_RULES} rules",
+            "value": out["prefilter_shed_vps"],
+            "unit": "flows/s",
             **out,
             "backend": backend,
             "build_s": round(t_build, 2),

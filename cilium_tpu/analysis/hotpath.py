@@ -43,6 +43,15 @@ ROBUST001  bare/broad ``except`` (no type, ``Exception``, or
         — on the verdict path a swallowed error leaves the in-flight
         FIFO, CT epoch, and staging free-lists in an undefined state
         (policyd-failsafe exists because of exactly these blocks).
+ROBUST002  unbounded blocking wait in a hot module: ``.join()`` /
+        ``.wait()`` / ``.acquire()`` / queue-style ``.get()`` with
+        neither a timeout argument nor ``block=False`` parks the
+        calling thread forever behind a wedged device call — the
+        policyd-overload watchdog can fire events and abandon batches
+        but cannot unwind a thread stuck in an untimed C wait. Bound
+        the wait (timeout + retry loop) or suppress with a written
+        justification. ``with lock:`` blocks are Family B's domain
+        (LOCK rules) and are not flagged here.
 """
 
 from __future__ import annotations
@@ -763,6 +772,75 @@ def _check_broad_except(mod: ModuleSource, findings: List[Finding]) -> None:
             )
 
 
+# ROBUST002: method names whose zero-arg / block=True form waits
+# without bound. str.join(iterable) and dict.get(key[, default])
+# always carry a non-bool positional, which is how they stay exempt.
+BLOCKING_WAIT_METHODS = {"join", "wait", "acquire", "get"}
+
+
+def _const_bool(node: ast.AST) -> Optional[bool]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def _is_unbounded_wait(node: ast.Call) -> bool:
+    """True when the call blocks with no timeout:
+
+    - ``x.join()`` / ``x.wait()``: any positional is the timeout (or
+      str.join's iterable) → only the zero-arg, no-``timeout``-kwarg
+      form is unbounded;
+    - ``x.acquire()`` / ``x.acquire(True)``: a second positional is
+      the timeout; ``acquire(False)`` / ``blocking=False`` polls;
+    - ``x.get()`` / ``x.get(True)`` / ``x.get(block=True)``: a
+      non-bool positional means dict-style ``get(key)`` (exempt);
+      ``block=False`` raises Empty instead of blocking.
+    """
+    kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+    if "timeout" in kwargs:
+        return False
+    meth = node.func.attr
+    if meth in ("join", "wait"):
+        return not node.args
+    if meth == "acquire":
+        if len(node.args) >= 2:
+            return False  # positional timeout
+        if node.args and _const_bool(node.args[0]) is False:
+            return False
+        if _const_bool(kwargs.get("blocking", ast.Constant(value=True))) is False:
+            return False
+        return True
+    # queue-style get
+    if node.args and _const_bool(node.args[0]) is not True:
+        return False  # dict-style get(key) / non-blocking get(False)
+    if _const_bool(kwargs.get("block", ast.Constant(value=True))) is False:
+        return False
+    return True
+
+
+def _check_blocking_waits(mod: ModuleSource, findings: List[Finding]) -> None:
+    """ROBUST002: untimed blocking waits in hot modules."""
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in BLOCKING_WAIT_METHODS
+            and _is_unbounded_wait(node)
+        ):
+            findings.append(
+                mod.finding(
+                    "ROBUST002",
+                    SEV_WARNING,
+                    node.lineno,
+                    f".{node.func.attr}() without a timeout in a hot "
+                    "module blocks the thread forever behind a wedged "
+                    "device call (the watchdog cannot unwind an untimed "
+                    "C wait) — bound it with timeout= in a retry loop, "
+                    "or suppress with a justification",
+                )
+            )
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -783,4 +861,5 @@ def analyze_hotpath(mod: ModuleSource) -> List[Finding]:
                     _RefreshPull(mod, imports, node, findings)
         _check_dtype_drift(mod, imports, mod.tree, findings)
         _check_broad_except(mod, findings)
+        _check_blocking_waits(mod, findings)
     return findings

@@ -1103,6 +1103,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                             else ""
                         )
                     )
+                adm = out.get("admission")
+                if adm and adm.get("enabled"):
+                    # an active gate means some flows in this window
+                    # never produced device spans at all — waterfalls
+                    # undercount offered load without this context
+                    wd = adm.get("watchdog") or {}
+                    line = (
+                        f"admission control is ON: limit "
+                        f"{adm.get('limit')}/{adm.get('max_depth')}, "
+                        f"queue depth {adm.get('queue_depth', 0)}, "
+                        f"shed ratio {adm.get('shed_ratio', 0.0)}"
+                    )
+                    if adm.get("prefilter"):
+                        shed = adm.get("shed", {})
+                        line += (
+                            f", prefilter shed "
+                            f"{shed.get('prefilter', 0)} flow(s)"
+                        )
+                    print(line)
+                    if wd.get("last_stall"):
+                        ls = wd["last_stall"]
+                        print(
+                            f"watchdog: {wd.get('stalls', 0)} stall(s), "
+                            f"last at site {ls.get('site')!r} after "
+                            f"{ls.get('age_ms')}ms"
+                        )
                 fs = out.get("failsafe")
                 if fs and fs.get("degraded"):
                     # a degraded ladder changes what the spans MEAN

@@ -122,6 +122,11 @@ class Daemon:
             epoch_swap=cfg.policy_epoch_swap,
             placement=placement,
             mesh_2d=cfg.mesh_sharding_2d,
+            # policyd-overload: the deadline and stall budgets are boot
+            # config; the AdmissionControl/Prefilter gates themselves
+            # are runtime options (default off)
+            deadline_ms=cfg.verdict_deadline_ms,
+            stall_ms=cfg.dispatch_stall_ms,
         )
         # ONE controller registry for the whole daemon (pkg/controller;
         # `cilium status --all-controllers` reads it) — the endpoint
@@ -783,6 +788,7 @@ class Daemon:
             "PhaseTracing", "VerdictSharding", "MeshSharding2D",
             "FlowAttribution", "DispatchAutoTune", "FailOpen",
             "FaultInjection", "EpochSwap", "L7DeviceBatch",
+            "AdmissionControl", "Prefilter",
         }
     )
 
@@ -846,6 +852,15 @@ class Daemon:
                 tracer=self.pipeline.tracer,
                 depth=_get_config().l7_pipeline_depth,
             )
+        elif name == "AdmissionControl":
+            # policyd-overload: the AIMD admission gate; off keeps the
+            # submit path at one attribute read (exact pre-option path)
+            self.pipeline.set_admission(value)
+        elif name == "Prefilter":
+            # policyd-overload: the coarse shed table compiles +
+            # publishes on the next rebuild; off publishes None and the
+            # shed kernels never trace
+            self.pipeline.set_prefilter_shed(value)
         elif name == "FaultInjection":
             # policyd-failsafe: arm/disarm the injection hub; off keeps
             # rules queued so a re-enable resumes a chaos scenario
@@ -1095,6 +1110,10 @@ class Daemon:
             # device set) — sharded vs replicated tables change what a
             # dispatch span covers (per-device bytes, ident reduce)
             "placement": self.pipeline.placement_state(),
+            # policyd-overload: gate limit, shed accounting, watchdog —
+            # spans read during an overload spike need to say which
+            # flows never reached the device path at all
+            "admission": self.pipeline.admission_state(),
             "traces": tr.traces(limit),
         }
 
@@ -1153,6 +1172,9 @@ class Daemon:
             # 1/2 names the mode (sharded|single-device|host)
             "pipeline_mode": self.pipeline.pipeline_mode,
             "pipeline_degraded": self.pipeline.pipeline_mode != "sharded",
+            # policyd-overload: /healthz answers "is the gate shedding"
+            # (queue depth, shed ratio, last stall) without a second RPC
+            "admission": self.pipeline.admission_state(),
         }
 
     def _peek_features(self):
@@ -1310,6 +1332,9 @@ class Daemon:
         return n
 
     def shutdown(self) -> None:
+        # stop the stall watchdog FIRST: the drain below legitimately
+        # blocks on slow completions and must not race an abandonment
+        self.pipeline.set_stall_ms(0)
         # complete in-flight verdict batches first: their finish halves
         # publish events/counters that the subsystems below consume
         self.pipeline.drain()

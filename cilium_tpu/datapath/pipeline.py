@@ -34,6 +34,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .admission import (
+    AdmissionController,
+    N_SHED_CLASSES,
+    REASON_SHED_DEADLINE,
+    REASON_SHED_PREFILTER,
+    Watchdog,
+    compile_shed_table,
+    flow_class,
+)
 from .placement import EMPTY_PLAN, MeshPlan, PlacementConfig, resolve_plan
 from .. import faults as _faults
 from .. import metrics as _metrics
@@ -491,6 +500,50 @@ def _pack_v4_u32(peer_bytes: np.ndarray) -> np.ndarray:
     return (b[:, 0] << 24) | (b[:, 1] << 16) | (b[:, 2] << 8) | b[:, 3]
 
 
+# -- policyd-overload: prefilter shed walk ---------------------------------
+# The coarse admission prefilter (PAPER.md layer 1's XDP prefilter
+# role, drop reason 144): ONE identity LPM walk + ONE gather from the
+# [N, 9] drop table compiled by admission.compile_shed_table. Runs only
+# from the admission gate when the queue is over budget — it is not on
+# the normal verdict path, so the Prefilter OFF program set is exactly
+# the pre-option one. Deliberately skips the deny-trie stage and the
+# policymap: cheapness is the point (shed rate must be a multiple of
+# full-pipeline rate on deny-heavy mixes), and the table alone is
+# deny-for-sure so skipping stages can only shed less, never wrongly.
+
+
+@jax.jit
+def shed_flows_wide(
+    t: "WideDatapathTables",
+    shed_tab: jnp.ndarray,  # [N, 9] uint8 (admission.compile_shed_table)
+    peer_u32: jnp.ndarray,  # [B] uint32 host-order peer addresses
+    dport: jnp.ndarray,  # [B] int32
+    proto: jnp.ndarray,  # [B] int32
+) -> jnp.ndarray:
+    """→ shed[B] bool: every flagged flow is deny-for-sure under the
+    current realized policy (IPv4)."""
+    _, hit = _v4_lpm_stage(t, peer_u32, False)
+    row = jnp.where(hit > 0, hit - 1, t.world_row)
+    cls = flow_class(dport, proto).astype(jnp.int32)
+    return jnp.take(shed_tab.reshape(-1), row * N_SHED_CLASSES + cls) != 0
+
+
+@functools.partial(jax.jit, static_argnames=("levels",))
+def shed_flows(
+    t: "DatapathTables",
+    shed_tab: jnp.ndarray,
+    peer_bytes: jnp.ndarray,  # [B, levels] int32 address bytes
+    dport: jnp.ndarray,
+    proto: jnp.ndarray,
+    levels: int = 16,
+) -> jnp.ndarray:
+    """IPv6 twin of shed_flows_wide (stride-8 elided identity walk)."""
+    _, hit = _v6_lpm_stage(t, peer_bytes, levels, False, False)
+    row = jnp.where(hit > 0, hit - 1, t.world_row)
+    cls = flow_class(dport, proto).astype(jnp.int32)
+    return jnp.take(shed_tab.reshape(-1), row * N_SHED_CLASSES + cls) != 0
+
+
 def _pad_flows(pad: int, peer_bytes, *arrays, row_override=None):
     """Zero-pad a flow batch's arrays to a shape bucket (row_override
     pads with -1: padded lanes must derive-by-LPM, never trust)."""
@@ -578,7 +631,15 @@ class PendingBatch:
     def result(self):
         if not self._event.is_set():
             self._pipe._complete_until(self)
-            self._event.wait()
+            # Timed loop, not a bare wait: if the completing thread
+            # wedges on a stuck device pull, the watchdog resolves this
+            # batch degraded and sets the event — but a daemon run
+            # without a watchdog must still never park a caller
+            # unwakeably on a lost completion (policyd-overload
+            # ROBUST002 discipline: no untimed blocking waits on the
+            # hot path).
+            while not self._event.wait(0.5):
+                pass
         if self._exc is not None:
             raise self._exc
         return self._value
@@ -590,7 +651,10 @@ class _InFlight:
     when the batch COMPLETES. ``finish=None`` marks a batch that ran
     synchronously (the donated-state device-CT path)."""
 
-    __slots__ = ("pending", "finish", "bt", "enq_ns", "occ", "b", "rev")
+    __slots__ = (
+        "pending", "finish", "bt", "enq_ns", "occ", "b", "rev", "t0",
+        "abandoned",
+    )
 
     def __init__(
         self, pending: PendingBatch, finish, bt,
@@ -609,6 +673,69 @@ class _InFlight:
         # from these when the finish closure is unrecoverable
         self.b = b
         self.rev = rev
+        # policyd-overload: submit time (monotonic; 0 = not tracked —
+        # set only while admission control or the watchdog is on) and
+        # the watchdog's abandonment mark. Once abandoned, the batch is
+        # already resolved degraded — a late-returning finish must not
+        # overwrite the published result.
+        self.t0 = 0.0
+        self.abandoned = False
+
+
+class _GatedPending(PendingBatch):
+    """Admission-gate handle for a PARTIALLY shed batch: the deny-for-
+    sure flows were resolved at the gate (reason 144), the kept flows
+    ride an inner PendingBatch through the unchanged submit path.
+    result() merges the two back into the caller's original [B] order —
+    from the outside the batch is indistinguishable from an ungated
+    one, just with some lanes pre-verdicted."""
+
+    __slots__ = ("_inner", "_keep", "_shed_v", "_b", "_rev", "_merge")
+
+    def __init__(
+        self,
+        pipe: "DatapathPipeline",
+        inner: PendingBatch,
+        keep_idx: np.ndarray,  # [K] indices of kept flows in the batch
+        shed_verdict: np.ndarray,  # [B] int8, shed lanes pre-filled
+        b: int,
+        rev: bool,
+    ) -> None:
+        super().__init__(pipe)
+        self._inner = inner
+        self._keep = keep_idx
+        self._shed_v = shed_verdict
+        self._b = b
+        self._rev = rev
+        self._merge = threading.Lock()
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set() or self._inner.done
+
+    def result(self):
+        with self._merge:
+            if not self._event.is_set():
+                try:
+                    out = self._inner.result()
+                except BaseException as e:
+                    self._exc = e
+                    self._event.set()
+                    raise
+                v = self._shed_v
+                red = np.zeros(self._b, bool)
+                v[self._keep] = out[0]
+                red[self._keep] = out[1]
+                if self._rev:
+                    rev = np.zeros(self._b, np.uint16)
+                    rev[self._keep] = out[2]
+                    self._value = (v, red, rev)
+                else:
+                    self._value = (v, red)
+                self._event.set()
+        if self._exc is not None:
+            raise self._exc
+        return self._value
 
 
 class _Enqueued:
@@ -664,6 +791,10 @@ class DatapathPipeline:
         epoch_swap: bool = False,
         placement: Optional[PlacementConfig] = None,
         mesh_2d: bool = False,
+        admission: bool = False,
+        prefilter_shed: bool = False,
+        deadline_ms: float = 0.0,
+        stall_ms: float = 0.0,
     ) -> None:
         self.engine = engine
         self.ipcache = ipcache
@@ -740,7 +871,7 @@ class DatapathPipeline:
         self._v6_fused = False  # v6 merged deny+identity trie present
         # ATOMIC read snapshot for the lock-free dispatch paths:
         # (tables, pf_empty, v6_fused, flow_sharding, ndev, attrib,
-        # ident2d) swap together — reading them as separate attributes
+        # ident2d, shed) swap together — reading them as separate attributes
         # could pair a new flag with old tables (e.g. fused=True against
         # placeholder merged arrays, which would resolve every v6 flow
         # to world with no denies, or a flow sharding against tables
@@ -751,8 +882,10 @@ class DatapathPipeline:
         # tables actually placed under P("ident"), never cross-read.
         # ``ndev`` is the FLOWS-axis size, not the total device count:
         # on a 2D mesh a batch splits over flows only.
+        # (policyd-overload widened the tuple with the placed prefilter
+        # shed table — None while the Prefilter option is off.)
         self._dp_state: Tuple = (
-            {}, (True, True), False, None, 1, None, False,
+            {}, (True, True), False, None, 1, None, False, None,
         )
         self._tries: Optional[Tuple] = None  # ((pf4, ip4), (pf6, ip6), world_row)
         self.counters = np.zeros((0, 3), np.int64)
@@ -888,6 +1021,35 @@ class DatapathPipeline:
         self._swap_gen = 0  # basis generation a shadow build binds to
         self._shadow_thread: Optional[threading.Thread] = None
         self._shadow_exc: Optional[BaseException] = None
+        # -- policyd-overload: admission control + watchdog -----------
+        # AdmissionControl runtime option: None (off) keeps _submit at
+        # one `self._admission is None` read per batch — the exact
+        # pre-option path. The deadline is boot config, consulted only
+        # while the controller exists.
+        self.deadline_ms = max(0.0, float(deadline_ms))
+        self._admission: Optional[AdmissionController] = None
+        if admission:
+            self.set_admission(True)
+        # Prefilter runtime option: when on, rebuild() compiles the
+        # coarse [identity, proto/port-class] drop table from the
+        # ingress policymap mirror and publishes it THROUGH _dp_state
+        # (placed with the same table sharding as the tries, so it
+        # rides the MeshPlan). Off publishes None and no shed kernel
+        # ever traces.
+        self._shed_requested = bool(prefilter_shed)
+        # (plan generation, placed device table) — recompiled when the
+        # policymap mirror or the placement moved
+        self._shed_cache: Optional[Tuple[int, object]] = None
+        # stuck-dispatch watchdog (dispatch_stall_ms > 0): monitors the
+        # actively-completing batch + registered external waits and
+        # drives the quarantine/breaker path instead of hanging
+        self._watchdog: Optional[Watchdog] = None
+        # (inf, t0) while a completion pull is running; the watchdog's
+        # only view into "actively stuck" (set/cleared only while the
+        # watchdog exists — the off path never writes it per batch)
+        self._completing: Optional[Tuple] = None
+        if stall_ms > 0:
+            self.set_stall_ms(stall_ms)
         _metrics.pipeline_mode.set(0.0)
 
     def set_endpoints(self, endpoints: Sequence) -> None:
@@ -1157,6 +1319,278 @@ class DatapathPipeline:
             "ident_sharded": plan.is_2d,
             "excluded_devices": sorted(self._excluded_devices),
         }
+
+    # -- policyd-overload: admission control + watchdog ----------------
+    def set_admission(self, on: bool) -> None:
+        """Toggle the AdmissionControl runtime option. Off (default)
+        keeps the submit path at ONE attribute read per batch
+        (``self._admission is None``) — the exact pre-option programs;
+        on installs the AIMD gate bounded by pipeline_max_depth and
+        keyed on the boot verdict deadline."""
+        if on:
+            if self._admission is None:
+                self._admission = AdmissionController(
+                    max_depth=max(
+                        self.pipeline_depth, self.pipeline_max_depth
+                    ),
+                    deadline_ms=self.deadline_ms,
+                )
+        else:
+            self._admission = None
+
+    def set_prefilter_shed(self, on: bool) -> None:
+        """Toggle the Prefilter runtime option: whether rebuild()
+        compiles + publishes the coarse [identity, class] shed table.
+        The next rebuild's single _dp_state publish makes the change
+        dispatch-visible; off publishes None and the shed kernels never
+        trace."""
+        self._shed_requested = bool(on)
+
+    def set_stall_ms(self, stall_ms: float) -> None:
+        """(Re)arm the stuck-dispatch watchdog; 0 stops it."""
+        wd = self._watchdog
+        if wd is not None:
+            wd.stop()
+            self._watchdog = None
+        if stall_ms and stall_ms > 0:
+            self._watchdog = Watchdog(self, float(stall_ms))
+            self._watchdog.start()
+
+    def admission_state(self) -> Dict:
+        """Overload snapshot for GET /healthz, GET /traces, and the CLI
+        traces header: gate limit + shed accounting, queue depth, and
+        the watchdog's last stall."""
+        adm = self._admission
+        wd = self._watchdog
+        out: Dict = {
+            "enabled": adm is not None,
+            "prefilter": self._shed_requested,
+            "queue_depth": len(self._inflight),
+            "deadline_ms": self.deadline_ms,
+        }
+        if adm is not None:
+            out.update(adm.snapshot())
+        else:
+            out["shed_ratio"] = 0.0
+        out["watchdog"] = wd.snapshot() if wd is not None else None
+        return out
+
+    def _shed_walk(
+        self, peer_bytes: np.ndarray, dports, protos, *, family: int
+    ) -> Optional[np.ndarray]:
+        """[B] bool deny-for-sure mask from the published shed table
+        (one device gather + the LPM identity walk), or None when no
+        table is live (Prefilter off, pre-first-rebuild, host-mode
+        ladder). Reflects the policy as of the LAST rebuild — the same
+        one-batch staleness window every in-flight dispatch has. The
+        jit keys on the raw batch shape (no bucketing): the gate is
+        only reached over budget, where a recompile-per-new-size is
+        noise next to the queue it is shedding."""
+        state = self._dp_state
+        shed_tab = state[7]
+        if shed_tab is None:
+            return None
+        t = state[0].get((TRAFFIC_INGRESS, family))
+        if t is None:
+            return None
+        dp = jnp.asarray(np.asarray(dports, np.int32))
+        pr = jnp.asarray(np.asarray(protos, np.int32))
+        if family == 4:
+            peer_u32 = _pack_v4_u32(np.asarray(peer_bytes, np.int32))
+            mask = shed_flows_wide(t, shed_tab, jnp.asarray(peer_u32), dp, pr)
+        else:
+            mask = shed_flows(
+                t, shed_tab, jnp.asarray(np.asarray(peer_bytes, np.int32)),
+                dp, pr, levels=16,
+            )
+        # intended host boundary: the gate partitions the batch on the
+        # host, so the [B] bool mask is pulled once per shed decision —
+        # the same one-batched-pull contract the verdict path carries
+        return np.asarray(mask)  # policyd-lint: disable=TPU001
+
+    def _resolve_at_gate(
+        self,
+        peer_bytes: np.ndarray,
+        ep_idx: np.ndarray,
+        dports: np.ndarray,
+        protos: np.ndarray,
+        idx: np.ndarray,
+        *,
+        verdict_code: int,
+        ingress: bool,
+        family: int,
+    ) -> None:
+        """Account + emit for flows resolved AT the admission gate
+        (shed or deadline-degraded) — the same per-endpoint counters,
+        verdicts_total series, drop-reason series, and DropNotify
+        events the device path would have produced, so a shed flow is
+        observable everywhere a dropped one is (never a silent drop)."""
+        if idx.size == 0:
+            return
+        v = np.full(idx.size, verdict_code, np.int8)
+        with self._lock:
+            if self.counters.shape[0] == max(1, len(self._endpoints)):
+                cls = 0 if verdict_code == FORWARD else 2
+                np.add.at(self.counters, (ep_idx[idx], cls), 1)
+        if verdict_code == DROP_PREFILTER:
+            _metrics.drop_reasons_total.inc(
+                {"reason": "prefilter"}, float(idx.size)
+            )
+        elif verdict_code == DROP_DEGRADED:
+            _metrics.drop_reasons_total.inc(
+                {"reason": "pipeline-degraded"}, float(idx.size)
+            )
+        self._account_batch(v)
+        self._emit_flow_events(
+            peer_bytes[idx], ep_idx[idx], dports[idx], protos[idx], v,
+            ingress=ingress, family=family,
+        )
+
+    def _admission_gate(
+        self,
+        peer_bytes: np.ndarray,
+        ep_idx: np.ndarray,
+        dports: np.ndarray,
+        protos: np.ndarray,
+        sports: Optional[np.ndarray],
+        *,
+        ingress: bool,
+        family: int,
+        peer_words,
+        want_rev_nat: bool,
+        tunnel_identities,
+    ) -> Optional[PendingBatch]:
+        """The over-budget path of the admission gate. Returns None
+        when the batch is admitted UNCHANGED (the caller proceeds down
+        the exact ungated submit path), else a fully- or
+        partially-resolved PendingBatch:
+
+        1. deny-for-sure flows (shed-table match) resolve NOW with
+           DROP_PREFILTER (monitor reason 144) — no queue, no device
+           round-trip beyond the one cheap gather;
+        2. the remainder DEFERS bounded: this thread drains its own
+           in-flight queue until the gate opens or the deadline budget
+           is spent (an empty queue always admits — nothing left to
+           wait on);
+        3. a spent deadline resolves the remainder through the
+           failsafe semantics — FORWARD under FailOpen, else
+           DROP_DEGRADED (155). Never an unbounded queue, never a
+           silent drop."""
+        adm = self._admission
+        t_gate = time.monotonic()
+        forced = False
+        if _faults.hub.active:
+            try:
+                _faults.hub.check(_faults.SITE_QUEUE_FULL)
+            except _faults.FaultError:
+                # an overload signal, not a device fault: halve the
+                # limit and force THIS batch through the shed path (the
+                # breaker/ladder stays out of it — shedding load must
+                # not also degrade the mesh)
+                adm.note_queue_full()
+                forced = True
+        depth = len(self._inflight)
+        _metrics.admission_queue_depth.set(float(depth))
+        if not forced and not adm.over_budget(depth):
+            adm.note_admitted(peer_bytes.shape[0])
+            return None
+        b = peer_bytes.shape[0]
+        ep_idx = np.asarray(ep_idx, np.int32)
+        dports = np.asarray(dports, np.int32)
+        protos = np.asarray(protos, np.int32)
+        # 1) prefilter shed — ingress only (the table is compiled from
+        # the ingress policymaps, like the device pf stage) and never
+        # for overlay flows whose tunnel identity overrides the LPM row
+        shed_mask = None
+        if ingress and tunnel_identities is None and b:
+            try:
+                shed_mask = self._shed_walk(
+                    peer_bytes, dports, protos, family=family
+                )
+            except BaseException as e:
+                kind = _faults.classify(e)
+                if kind == _faults.KIND_ERROR:
+                    raise
+                # the shed walk is an optimization: a faulted gather
+                # must never fail the submission itself
+                self._note_fault(e, kind)
+                shed_mask = None
+        if shed_mask is not None and shed_mask.any():
+            shed_idx = np.nonzero(shed_mask)[0]
+            keep_idx = np.nonzero(~shed_mask)[0]
+            self._resolve_at_gate(
+                peer_bytes, ep_idx, dports, protos, shed_idx,
+                verdict_code=DROP_PREFILTER, ingress=ingress, family=family,
+            )
+            adm.note_shed(REASON_SHED_PREFILTER, int(shed_idx.size))
+        else:
+            shed_idx = np.empty(0, np.int64)
+            keep_idx = np.arange(b)
+        # 2) bounded deferral for the remainder
+        admitted = keep_idx.size > 0
+        if admitted:
+            budget_s = adm.deadline_s or None
+            while adm.over_budget(len(self._inflight)):
+                if (
+                    budget_s is not None
+                    and time.monotonic() - t_gate >= budget_s
+                ):
+                    admitted = False
+                    break
+                if not self._complete_oldest():
+                    break
+        _metrics.queue_wait_seconds.observe(time.monotonic() - t_gate)
+        if keep_idx.size == 0:
+            # whole batch shed: resolved handle, nothing ever queued
+            pending = PendingBatch(self)
+            v = np.full(b, DROP_PREFILTER, np.int8)
+            red = np.zeros(b, bool)
+            pending._value = (
+                (v, red, np.zeros(b, np.uint16)) if want_rev_nat
+                else (v, red)
+            )
+            pending._event.set()
+            return pending
+        if not admitted:
+            # 3) deadline spent: failsafe resolution for the remainder
+            code = FORWARD if self._fail_open else DROP_DEGRADED
+            self._resolve_at_gate(
+                peer_bytes, ep_idx, dports, protos, keep_idx,
+                verdict_code=code, ingress=ingress, family=family,
+            )
+            adm.note_shed(REASON_SHED_DEADLINE, int(keep_idx.size))
+            v = np.empty(b, np.int8)
+            v[shed_idx] = DROP_PREFILTER
+            v[keep_idx] = code
+            red = np.zeros(b, bool)
+            pending = PendingBatch(self)
+            pending._value = (
+                (v, red, np.zeros(b, np.uint16)) if want_rev_nat
+                else (v, red)
+            )
+            pending._event.set()
+            return pending
+        adm.note_admitted(int(keep_idx.size))
+        if keep_idx.size == b:
+            # nothing shed and the gate opened: the caller proceeds
+            # down the UNCHANGED submit path (bit-identical programs)
+            return None
+        inner = self._submit(
+            peer_bytes[keep_idx], ep_idx[keep_idx], dports[keep_idx],
+            protos[keep_idx],
+            None if sports is None else np.asarray(sports)[keep_idx],
+            ingress=ingress, family=family,
+            peer_words=(
+                None if peer_words is None
+                else (peer_words[0][keep_idx], peer_words[1][keep_idx])
+            ),
+            want_rev_nat=want_rev_nat,
+            tunnel_identities=None,
+            gate=False,
+        )
+        shed_v = np.zeros(b, np.int8)
+        shed_v[shed_idx] = DROP_PREFILTER
+        return _GatedPending(self, inner, keep_idx, shed_v, b, want_rev_nat)
 
     def _set_level(self, level: int) -> None:
         """Move the degradation ladder (descent on a tripped breaker,
@@ -1590,9 +2024,39 @@ class DatapathPipeline:
                     )
                 if rtabs:
                     attrib_el = (rtabs, self._attrib_n_rules)
+            # prefilter shed element (policyd-overload): the coarse
+            # [identity, proto/port-class] deny-for-sure table, compiled
+            # from the ingress policymap host mirror and placed with the
+            # same table sharding as the tries so it rides the MeshPlan.
+            # Cached across rebuilds that change neither the policymap
+            # basis nor the placement; Prefilter off publishes None and
+            # the shed kernels never trace.
+            shed_el = None
+            if self._shed_requested:
+                mat_in = self._mat.get(TRAFFIC_INGRESS)
+                if mat_in is not None:
+                    gen = self._plan.generation
+                    if (
+                        self._shed_cache is None
+                        or self._shed_cache[0] != gen
+                        or mat_fresh
+                        or saw_row_event
+                        or saw_rule_delta
+                    ):
+                        shed_tab = compile_shed_table(
+                            mat_in.allow_nc, mat_in.ep_slots
+                        )
+                        self._shed_cache = (
+                            gen,
+                            place_table(shed_tab, self._table_sharding),
+                        )
+                    shed_el = self._shed_cache[1]
+            else:
+                self._shed_cache = None
             self._dp_state = (
                 tables, self._pf_empty, self._v6_fused,
                 self._flow_sharding, ndev, attrib_el, self._plan.is_2d,
+                shed_el,
             )
             # per-device table-bytes telemetry: under a 2D plan the
             # identity tables split by the ident factor (within the
@@ -1824,7 +2288,19 @@ class DatapathPipeline:
         self._attrib_names = (
             self.engine.repo.origin_names() if nr else []
         )
-        self._mat = self._build_mats(compiled, device, self._endpoints, ao, nr)
+        # a full sweep is the slowest thing rebuild() can do — with the
+        # watchdog armed, register it so a wedged device compile shows
+        # up as a classified stall instead of a silent hang
+        wd = self._watchdog
+        if wd is not None:
+            with wd.watching("compile"):
+                self._mat = self._build_mats(
+                    compiled, device, self._endpoints, ao, nr
+                )
+        else:
+            self._mat = self._build_mats(
+                compiled, device, self._endpoints, ao, nr
+            )
 
     @staticmethod
     def _build_mats(compiled, device, endpoints, ao, nr):
@@ -2556,7 +3032,7 @@ class DatapathPipeline:
         # describe
         (
             tables_map, pf_empty, v6_fused, flow_sharding, ndev, attrib_el,
-            ident2d,
+            ident2d, _shed,
         ) = self._dp_state
         t = tables_map[(direction, family)]
         rule_tab = None
@@ -2754,24 +3230,49 @@ class DatapathPipeline:
             if tuner is not None and inf.enq_ns
             else 0
         )
+        adm = self._admission
+        wd = self._watchdog
         try:
+            # the watchdog's stall clock starts when a thread ACTIVELY
+            # pulls this batch — un-pulled in-flight batches are the
+            # pipeline's normal lazy shape, not stalls
+            if wd is not None:
+                self._completing = (inf, time.monotonic())
             # classified completion (policyd-failsafe): transient
             # faults retry bounded, poisoned batches quarantine into a
             # degraded RESULT, and only programmer errors come back as
             # an exception for result() to surface raw
             value, exc = self._finish_guarded(inf)
-            inf.pending._value = value
-            inf.pending._exc = exc
+            # publish under the queue lock, where the watchdog decides
+            # abandonment: a batch it already resolved degraded must
+            # not have its (late, possibly-poisoned) result overwrite
+            # the published one
+            with self._queue_lock:
+                if not inf.abandoned:
+                    inf.pending._value = value
+                    inf.pending._exc = exc
         finally:
+            if wd is not None:
+                self._completing = None
             inf.pending._event.set()
             if inf.bt is not _NOOP_BATCH:
                 inf.bt.end(self.monitor)
+        if adm is not None and inf.t0:
+            adm.observe_completion(time.monotonic() - inf.t0)
         if t0:
             new_depth = tuner.observe(
                 self.pipeline_depth, inf.b, inf.enq_ns,
                 time.perf_counter_ns() - t0, inf.occ,
             )
-            if new_depth is not None:
+            # tuner armistice (policyd-overload): while the admission
+            # gate shed recently, the depth controller must not probe
+            # the queue UP — two controllers pushing the same knob in
+            # opposite directions oscillate
+            if new_depth is not None and not (
+                new_depth > self.pipeline_depth
+                and adm is not None
+                and adm.shedding()
+            ):
                 self._apply_depth(new_depth)
         return True
 
@@ -2806,6 +3307,7 @@ class DatapathPipeline:
         peer_words: Optional[Tuple[np.ndarray, np.ndarray]] = None,
         want_rev_nat: bool = False,
         tunnel_identities: Optional[np.ndarray] = None,
+        gate: bool = True,
     ) -> PendingBatch:
         """Trace shell + queue admission around _submit_inner: the
         disabled cost is ONE ``tracer.active`` attribute read per batch
@@ -2814,7 +3316,22 @@ class DatapathPipeline:
         returns — it stays open and ends when the batch completes, so
         spans attach to the batch that completes, not the one being
         prepared — and admission beyond pipeline_depth completes the
-        oldest batch first (the bounded in-flight queue)."""
+        oldest batch first (the bounded in-flight queue).
+
+        ``gate=False`` is the admission gate's internal re-entry for
+        the kept remainder of a partially-shed batch — it must not be
+        gated twice."""
+        # policyd-overload admission gate: one attribute read when the
+        # AdmissionControl option is off — the exact pre-option path
+        if gate and self._admission is not None:
+            gated = self._admission_gate(
+                peer_bytes, ep_idx, dports, protos, sports,
+                ingress=ingress, family=family, peer_words=peer_words,
+                want_rev_nat=want_rev_nat,
+                tunnel_identities=tunnel_identities,
+            )
+            if gated is not None:
+                return gated
         tr = self.tracer
         # tuner timing: the enqueue half is everything up to queue
         # admission (prepare + CT pre-pass + h2d + async enqueue) —
@@ -2875,6 +3392,8 @@ class DatapathPipeline:
                 return pending
         if bt is not _NOOP_BATCH:
             tr.detach(bt)
+        if self._admission is not None or self._watchdog is not None:
+            inf.t0 = time.monotonic()
         if inf.finish is None:
             # ran synchronously (device-CT donated-state path)
             if bt is not _NOOP_BATCH:
@@ -3252,7 +3771,9 @@ class DatapathPipeline:
         # the fused CT path keeps the plain jnp.take gather even under
         # a 2D plan (GSPMD all-gathers the sharded table — correct,
         # just unoptimized; the CT program is not ident-aware yet)
-        tables_map, pf_empty, v6_fused, _fs, _ndev, _at, _i2d = self._dp_state
+        tables_map, pf_empty, v6_fused, _fs, _ndev, _at, _i2d, _sh = (
+            self._dp_state
+        )
         t = tables_map[(direction, family)]
         b = peer_bytes.shape[0]
         pad = _bucket(b) - b

@@ -6,7 +6,8 @@ pinned staging free-lists, a verdict mesh — and none of that state is
 exercised by tests unless something actually fails mid-batch. This
 module is the failure source: a process-wide registry of NAMED
 injection sites wired into the hot path (h2d staging, XLA dispatch,
-completion pull, CT-epoch advance, kvstore pump, TPU attach) that
+completion pull, CT-epoch advance, kvstore pump, TPU attach, the
+admission gate's queue-full probe, the watchdog's stall sweep) that
 raises classified faults on demand, deterministically.
 
 Cost model (the hub's ``active`` pattern, observe/tracer.py): the hot
@@ -50,10 +51,13 @@ SITE_COMPLETE = "complete"  # host pull of un-pulled device results
 SITE_CT_EPOCH = "ct_epoch"  # conntrack basis advance in rebuild()
 SITE_KVSTORE = "kvstore"    # SharedStore.pump event drain
 SITE_ATTACH = "attach"      # backend handshake / first compile
+SITE_QUEUE_FULL = "queue_full"  # admission gate: forces over-budget
+SITE_STALL = "stall"        # watchdog sweep: synthesizes a stuck batch
 
 SITES: Tuple[str, ...] = (
     SITE_H2D, SITE_DISPATCH, SITE_COMPLETE,
     SITE_CT_EPOCH, SITE_KVSTORE, SITE_ATTACH,
+    SITE_QUEUE_FULL, SITE_STALL,
 )
 
 KIND_TRANSIENT = "transient"

@@ -343,3 +343,31 @@ drop_reasons_total = registry.counter(
     "policyd-flows taxonomy in monitor/events.py; generic codes when "
     "FlowAttribution is off)",
 )
+
+# -- policyd-overload (admission control + watchdog) families --------------
+admission_shed_total = registry.counter(
+    "cilium_tpu_admission_shed_total",
+    "Flows resolved by the admission gate instead of the full verdict "
+    "path (label reason: prefilter = coarse drop-table match, code 144; "
+    "deadline = deferred past the batch deadline and resolved via the "
+    "fail-closed 155 / FailOpen semantics)",
+)
+queue_wait_seconds = registry.histogram(
+    "cilium_tpu_queue_wait_seconds",
+    "Wall time a submitted batch spent gated at admission before "
+    "entering the verdict pipeline (only recorded while "
+    "AdmissionControl is on; ungated batches observe ~0)",
+    buckets=PHASE_BUCKETS,
+)
+admission_queue_depth = registry.gauge(
+    "cilium_tpu_admission_queue_depth",
+    "In-flight verdict batches as seen by the admission controller at "
+    "its last gate decision (vs its AIMD limit, see GET /healthz)",
+)
+watchdog_stalls_total = registry.counter(
+    "cilium_tpu_watchdog_stalls_total",
+    "Stuck operations detected by the dispatch watchdog (label site: "
+    "the faults.py site the stalled operation registered under — "
+    "dispatch for in-flight batches, attach/compile for registered "
+    "external waits, stall for injected sweeps)",
+)

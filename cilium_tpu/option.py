@@ -75,6 +75,18 @@ class DaemonConfig:
     # verdict_pipeline_depth: 2 overlaps host packing with the device
     # walk).
     l7_pipeline_depth: int = 2
+    # Per-batch verdict deadline in milliseconds (policyd-overload).
+    # 0 disables deadlines: the admission controller still bounds the
+    # queue by its AIMD limit but never sheds on latency budget. With a
+    # deadline set, batches the controller cannot place within budget
+    # route through the prefilter shed stage instead of queueing.
+    verdict_deadline_ms: float = 0.0
+    # Stuck-dispatch threshold in milliseconds (policyd-overload). 0
+    # disables the watchdog thread; >0 starts a monitor that treats any
+    # in-flight batch (or registered attach/compile wait) older than
+    # this as stalled, classifies it via faults.classify(), and drives
+    # the failsafe quarantine + degradation ladder instead of hanging.
+    dispatch_stall_ms: float = 0.0
 
     def validate(self) -> None:
         if self.enforcement_mode not in ("default", "always", "never"):
@@ -94,6 +106,10 @@ class DaemonConfig:
             raise ValueError("flow-ring-capacity must be >= 1")
         if not 1 <= self.l7_pipeline_depth <= 64:
             raise ValueError("l7-pipeline-depth must be 1-64")
+        if self.verdict_deadline_ms < 0:
+            raise ValueError("verdict-deadline-ms must be >= 0")
+        if self.dispatch_stall_ms < 0:
+            raise ValueError("dispatch-stall-ms must be >= 0")
         if not 2 <= self.mesh_ident_axis <= 64:
             raise ValueError("mesh-ident-axis must be 2-64")
         if self.mesh_process_index < 0:
@@ -212,8 +228,30 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
             "FaultInjection",
             "Enable the cilium_tpu/faults.py hub: deterministic, seeded "
             "fault injection at the named verdict-path sites (h2d, "
-            "dispatch, complete, ct_epoch, kvstore, attach); off keeps "
-            "the hot path at one attribute read per site",
+            "dispatch, complete, ct_epoch, kvstore, attach, queue_full, "
+            "stall); off keeps the hot path at one attribute read per "
+            "site",
+        ),
+        OptionSpec(
+            "AdmissionControl",
+            "Deadline-aware admission control (policyd-overload): an "
+            "AIMD controller keyed on queue wait + EWMA completion "
+            "latency bounds the submit queue; over budget, flows route "
+            "through the prefilter shed stage (if Prefilter is on) or "
+            "defer within the verdict-deadline-ms budget, resolving "
+            "via the fail-closed 155 / FailOpen semantics — never "
+            "silently dropped. Off keeps the exact pre-option submit "
+            "path",
+        ),
+        OptionSpec(
+            "Prefilter",
+            "Device prefilter shed stage (policyd-overload): a coarse "
+            "[identity, proto/port-class] drop table compiled from "
+            "deny-heavy policy, walked as one cheap gather AHEAD of "
+            "the full verdict path so DoS-heavy mixes shed at a "
+            "multiple of full-pipeline rate with drop reason 144; off "
+            "compiles no shed table and the full path is bit-identical "
+            "to pre-option programs",
         ),
     )
 }

@@ -1,0 +1,58 @@
+# policyd: hot
+"""ROBUST002 fixture: unbounded blocking waits in a hot module.
+
+The positive cases park the calling thread forever behind a wedged
+device call; the negatives carry a timeout, poll instead of blocking,
+or are dict/str lookalikes that share a method name with the real
+blocking primitives.
+"""
+
+
+def positive_join(t):
+    t.join()  # POS: thread join without timeout
+
+
+def positive_wait(ev):
+    ev.wait()  # POS: Event.wait without timeout
+
+
+def positive_acquire(lock):
+    lock.acquire()  # POS: blocking acquire, no timeout
+
+
+def positive_queue_get(q):
+    return q.get()  # POS: queue get blocks forever on empty
+
+
+def positive_get_block_true(q):
+    return q.get(True)  # POS: explicit block=True, still unbounded
+
+
+def negative_timed(t, ev, lock, q):
+    t.join(2.0)  # NEG: positional timeout
+    ev.wait(timeout=0.5)  # NEG: timeout kwarg
+    lock.acquire(True, 1.0)  # NEG: positional timeout
+    return q.get(timeout=0.1)  # NEG: bounded get
+
+
+def negative_nonblocking(lock, q):
+    lock.acquire(False)  # NEG: poll, returns immediately
+    lock.acquire(blocking=False)  # NEG: poll via kwarg
+    return q.get(block=False)  # NEG: raises Empty instead of blocking
+
+
+def negative_dict_get(d):
+    return d.get("key")  # NEG: dict-style get carries the key
+
+
+def negative_str_join(parts):
+    return ",".join(parts)  # NEG: str.join's positional is the iterable
+
+
+def negative_with_lock(lock):
+    with lock:  # NEG: with-blocks are Family B's domain (LOCK002..004)
+        return 1
+
+
+def negative_suppressed(ev):
+    ev.wait()  # policyd-lint: disable=ROBUST002

@@ -247,7 +247,10 @@ class TestSurfaces:
                            "autotune": None,
                            "failsafe": d.pipeline.failsafe_state(),
                            "placement": d.pipeline.placement_state(),
+                           "admission": d.pipeline.admission_state(),
                            "traces": []}
+            # healthy baseline: the admission block reports the gate off
+            assert out["admission"]["enabled"] is False
             # healthy baseline: the failsafe block reports level 0
             assert out["failsafe"]["mode"] == "sharded"
             assert out["failsafe"]["degraded"] is False

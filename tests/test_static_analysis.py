@@ -136,6 +136,27 @@ def test_tpu005_engine_markers_stay_clean():
     assert lines_of(f, "TPU005") == []
 
 
+def test_robust002_blocking_waits():
+    f = analyze_paths([fixture("hot_robust002.py")])
+    # join / wait / acquire / get() / get(True) — negatives (timed,
+    # polling, dict get, str.join, with-block, suppressed) stay silent
+    assert lines_of(f, "ROBUST002") == [12, 16, 20, 24, 28]
+    assert all(x.severity == "warning" for x in f if x.rule == "ROBUST002")
+    assert len(f) == 5
+
+
+def test_robust002_verdict_path_stays_clean():
+    """The regression gate policyd-overload bought: every blocking
+    wait on the verdict path (pipeline, admission, watchdog) must stay
+    timed so a wedged device call can never park a caller forever."""
+    f = analyze_paths([
+        os.path.join(PKG, "datapath", "pipeline.py"),
+        os.path.join(PKG, "datapath", "admission.py"),
+        os.path.join(PKG, "datapath", "l7_pipeline.py"),
+    ])
+    assert [x for x in f if x.rule == "ROBUST002"] == []
+
+
 def test_hot_gating_rules_need_hot_module(tmp_path):
     cold = tmp_path / "cold.py"
     cold.write_text(
