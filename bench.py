@@ -771,6 +771,111 @@ def _bench_flows(
     return k * b / t_off, k * b / t_on, overhead
 
 
+def _bench_prof(repo, reg, idents, nrng: np.random.Generator, attached):
+    """``--prof``: policyd-prof round → result dict for the one-line
+    JSON. Three measurements on the N_RULES world, depth-1 pipeline
+    (no overlap, so one batch's dispatch+host_sync spans ARE its RTT):
+
+    1. RTT decomposition at sample_every=1: every batch pays the
+       block_until_ready sandwiches; the mean h2d+compute+d2h sum per
+       batch is compared against the tracer-measured dispatch +
+       host_sync wall time of the SAME batches. Sound when the error
+       is within 10% (the residual is host bookkeeping inside the
+       dispatch span — chunk planning, metric accounting).
+    2. Verdict parity: profiling must not change a single verdict.
+    3. profiling_overhead_pct: e2e rate with sampling at the DEFAULT
+       sample_every=64 vs fully off, both warm (<2% target).
+    """
+    from cilium_tpu.datapath.pipeline import DatapathPipeline
+    from cilium_tpu.engine import PolicyEngine
+    from cilium_tpu.ipcache.ipcache import IPCache
+    from cilium_tpu.ipcache.prefilter import PreFilter
+
+    eng = PolicyEngine(repo, reg)
+    cache = IPCache()
+    for i, ident in enumerate(idents):
+        cache.upsert(
+            f"10.{(i >> 8) & 255}.{i & 255}.1/32", ident.id, source="k8s"
+        )
+    pipe = DatapathPipeline(eng, cache, PreFilter(), conntrack=None)
+    pipe.set_endpoints([idents[j].id for j in range(N_ENDPOINTS)])
+    b, k = 1 << 18, 8
+    batches = []
+    for _ in range(k):
+        i_sel = nrng.integers(0, len(idents), b)
+        ips = (
+            np.uint32(10) << 24
+            | ((i_sel >> 8) & 255).astype(np.uint32) << 16
+            | (i_sel & 255).astype(np.uint32) << 8
+            | 1
+        ).astype(np.uint32)
+        eps = nrng.integers(0, N_ENDPOINTS, b).astype(np.int32)
+        dports = nrng.choice(np.array([80, 443, 8080, 53, 22], np.int32), b)
+        protos = np.where(dports == 53, 17, 6).astype(np.int32)
+        batches.append((ips, eps, dports, protos))
+
+    def run_all():
+        pipe.process(*batches[0])  # warm this mode's program
+        t0 = time.time()
+        out = [pipe.process(*bt) for bt in batches]
+        return time.time() - t0, out
+
+    t_off, off = run_all()
+    attached.stage("prof-baseline")
+
+    # every batch sampled AND traced: the profiler's decomposition vs
+    # the tracer's independent wall clock over the same dispatches
+    pipe.tracer.enable()
+    pipe.set_profiling(True, sample_every=1)
+    _t, on = run_all()
+    pipe.tracer.disable()
+    for (v0, r0), (v1, r1) in zip(off, on):
+        np.testing.assert_array_equal(v0, v1)
+        np.testing.assert_array_equal(r0, r1)
+    prof = pipe.profiler
+    samples = prof.samples()
+    n_s = max(1, len(samples))
+    h2d = sum(s["h2d_ms"] for s in samples) / n_s
+    comp = sum(s["device_compute_ms"] for s in samples) / n_s
+    d2h = sum(s["d2h_ms"] for s in samples) / n_s
+    span_ms, n_t = 0.0, 0
+    for t in pipe.tracer.traces():
+        durs = {name: dur for name, _rel, dur in t["phases"]}
+        if "dispatch" in durs:
+            span_ms += (durs["dispatch"] + durs.get("host_sync", 0)) / 1e6
+            n_t += 1
+    measured_ms = span_ms / max(1, n_t)
+    decomposed_ms = h2d + comp + d2h
+    err_pct = (
+        abs(decomposed_ms - measured_ms) / measured_ms * 100.0
+        if measured_ms > 0 else 100.0
+    )
+    attached.stage("prof-decomposition")
+
+    # overhead at the shipping default, warm off-baseline re-measured
+    # so jit warmup never lands in the delta
+    pipe.set_profiling(True, sample_every=64)
+    t_on64, _ = run_all()
+    pipe.set_profiling(False)
+    t_off2, _ = run_all()
+    base = min(t_off, t_off2)
+    overhead = (t_on64 - base) / base * 100.0 if base > 0 else 0.0
+    return {
+        "dispatch_rtt_ms": round(measured_ms, 3),
+        "h2d_ms": round(h2d, 3),
+        "device_compute_ms": round(comp, 3),
+        "d2h_ms": round(d2h, 3),
+        "rtt_decomposition_err_pct": round(err_pct, 2),
+        "rtt_decomposition_sound": bool(err_pct <= 10.0),
+        "profiling_overhead_pct": round(overhead, 2),
+        "prof_off_vps": round(k * b / base) if base > 0 else 0,
+        "prof_on_vps": round(k * b / t_on64) if t_on64 > 0 else 0,
+        "profile_samples": len(samples),
+        "jit_sites": len(prof.jit_costs()),
+        "sample_every": 64,
+    }
+
+
 def _bench_tune(repo, reg, idents, nrng: np.random.Generator, attached):
     """``--tune``: policyd-autotune round → result dict for the
     one-line JSON. Three measurements on the N_RULES world:
@@ -1946,6 +2051,7 @@ def _attach_watchdog(timeout_s: float) -> _AttachStages:
             # never comparable to device rates AND machine-greppable:
             # a wedged round must still leave one parseable record
             "backend": "attach-timeout",
+            "host_cpus": os.cpu_count(),
             "error": (
                 f"TPU attach did not complete within {timeout_s:.0f}s "
                 f"(axon tunnel wedged?) — last completed stage: "
@@ -2027,6 +2133,7 @@ def _attach_backend(
             "attach_stage": attached.last,
             "attach_history": attached.history,
             "backend": "attach-timeout",
+            "host_cpus": os.cpu_count(),
             "error": (
                 f"TPU attach failed after {attempts} bounded attempt(s) "
                 f"({attempt_timeout_s:.0f}s each) — last stage: "
@@ -2066,6 +2173,10 @@ def _lint_preflight() -> None:
         "value": 0,
         "unit": "verdicts/s",
         "vs_baseline": 0.0,
+        # no device attached yet (lint runs first) but the line keeps
+        # the always-present pair every metric line carries
+        "backend": "unattached",
+        "host_cpus": os.cpu_count(),
         "error": (
             f"lint pre-flight: {len(hot)} new hot-path finding(s) — "
             + "; ".join(f.render() for f in hot[:3])
@@ -2077,7 +2188,235 @@ def _lint_preflight() -> None:
     sys.exit(3)
 
 
+# ── --diff: bench regression diffing (policyd-prof) ──────────────────
+
+# lower-is-better comes from the unit suffix; anything unmatched is
+# not auto-comparable (flags, depths, counts)
+_DIFF_HIGHER = ("_vps", "_rps", "_lps", "_qps", "_ratio")
+_DIFF_LOWER = ("_ms", "_us", "_ns", "_s", "_pct")
+# environment/bookkeeping keys a slow CI node must never fail a round
+# on; calib_* are the normalizers themselves
+_DIFF_SKIP = ("value", "vs_baseline", "build_s", "compile_s",
+              "host_cpus", "sample_every")
+
+
+def _flag_value(argv, name):
+    """Value following a bare ``--flag VALUE`` pair (bench has no
+    argparse — every mode is a sys.argv scan)."""
+    if name in argv:
+        i = argv.index(name)
+        if i + 1 < len(argv):
+            return argv[i + 1]
+    return None
+
+
+def _load_artifact(path: str) -> dict:
+    """Parse a BENCH/TRACES artifact: a bare metric-line JSON object,
+    or a round log with one JSON object per line (stdout + stderr
+    concatenated). The first line carrying "metric" is the record; a
+    ``{"detail": ...}`` line contributes the calibration envelope and
+    "traces"/"phases" found on other lines are merged in when the
+    record lacks them."""
+    rec: dict = {}
+    detail: dict = {}
+    extra: dict = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(obj, dict):
+                continue
+            if "metric" in obj and "metric" not in rec:
+                rec = obj
+            elif isinstance(obj.get("detail"), dict):
+                detail = obj["detail"]
+            for key in ("traces", "phases"):
+                if key in obj and key not in extra:
+                    extra[key] = obj[key]
+    if not rec and not extra:
+        raise ValueError(f"no metric/traces JSON line found in {path}")
+    for key, val in extra.items():
+        rec.setdefault(key, val)
+    if detail:
+        rec.setdefault("detail", detail)
+    return rec
+
+
+def _diff_calib(rec: dict, key: str):
+    v = rec.get(key)
+    if v is None:
+        v = (rec.get("detail") or {}).get(key)
+    try:
+        return float(v) if v else None
+    except (TypeError, ValueError):
+        return None
+
+
+def _diff_host_scale(key: str, prev: dict, cur: dict):
+    """cur/prev calibration ratio for host-side metrics, or None when
+    the key is device-side or either artifact lacks the envelope.
+    Interpreter-bound paths normalize by the python loop, the native
+    front-end by the sha stream (same split the full sweep's
+    *_per_* normalizations use)."""
+    if key.startswith(("kafka_", "p99")):
+        calib = "calib_py_loops_per_s"
+    elif key.startswith("native_"):
+        calib = "calib_sha256_mb_per_s"
+    else:
+        return None
+    pv, cv = _diff_calib(prev, calib), _diff_calib(cur, calib)
+    if not pv or not cv:
+        return None
+    return cv / pv
+
+
+def _diff_phase_means(rec: dict) -> dict:
+    """{phase: mean_ms} from an explicit "phases" dict or a TRACES
+    artifact ("traces": [{"phases": [[name, rel_ns, dur_ns], ...]}])."""
+    ph = rec.get("phases")
+    if isinstance(ph, dict):
+        return {k: float(v) for k, v in ph.items()
+                if isinstance(v, (int, float))}
+    tot: dict = {}
+    n: dict = {}
+    for t in rec.get("traces", ()) or ():
+        for name, _rel, dur in t.get("phases", ()):
+            tot[name] = tot.get(name, 0.0) + dur / 1e6
+            n[name] = n.get(name, 0) + 1
+    return {k: tot[k] / n[k] for k in tot}
+
+
+def _diff_records(prev: dict, cur: dict, threshold_pct: float) -> int:
+    """Compare two bench records, print ONE machine-greppable verdict
+    line, and return the process exit code (0 pass/incomparable, 4
+    regression). Direction comes from the key's unit suffix; host-side
+    metrics are normalized by the calibration envelope when both
+    records carry one."""
+    prev_b, cur_b = prev.get("backend"), cur.get("backend")
+    verdict = {
+        "threshold_pct": round(threshold_pct, 1),
+        "backend": [prev_b, cur_b],
+        "host_cpus": [prev.get("host_cpus"), cur.get("host_cpus")],
+    }
+    if prev_b != cur_b and prev_b is not None and cur_b is not None:
+        # local-fallback vs device rates (or a wedged round) must
+        # never produce a pass OR fail — only an explicit refusal
+        verdict["verdict"] = "incomparable"
+        verdict["reason"] = f"backend mismatch: {prev_b} vs {cur_b}"
+        print(json.dumps({"diff": verdict}), flush=True)
+        return 0
+
+    cpus_differ = (
+        prev.get("host_cpus") is not None
+        and cur.get("host_cpus") is not None
+        and prev.get("host_cpus") != cur.get("host_cpus")
+    )
+    thr = threshold_pct / 100.0
+    regressions, improvements, skipped = [], [], []
+
+    def compare(key, pval, cval, higher, normalized):
+        delta = (cval - pval) / abs(pval) * 100.0
+        entry = {"key": key, "prev": round(pval, 3), "cur": round(cval, 3),
+                 "delta_pct": round(delta, 1)}
+        if normalized:
+            entry["normalized"] = True
+        worse = cval < pval * (1 - thr) if higher else cval > pval * (1 + thr)
+        better = cval > pval * (1 + thr) if higher else cval < pval * (1 - thr)
+        if worse:
+            regressions.append(entry)
+        elif better:
+            improvements.append(entry)
+        return 1
+
+    compared = 0
+    for key, pval in prev.items():
+        if key in _DIFF_SKIP or key.startswith("calib_"):
+            continue
+        cval = cur.get(key)
+        if not isinstance(pval, (int, float)) or isinstance(pval, bool):
+            continue
+        if not isinstance(cval, (int, float)) or isinstance(cval, bool):
+            continue
+        if key.endswith(_DIFF_HIGHER):
+            higher = True
+        elif key.endswith(_DIFF_LOWER):
+            higher = False
+        else:
+            continue
+        if pval <= 0 or cval <= 0:
+            # zeroed (skipped sub-bench) or flag-negated values carry
+            # no rate/latency meaning — refuse silently failing on them
+            skipped.append({"key": key, "reason": "non-positive"})
+            continue
+        scale = _diff_host_scale(key, prev, cur)
+        if scale is None and cpus_differ and key.startswith(
+            ("kafka_", "native_", "p99")
+        ):
+            skipped.append({
+                "key": key,
+                "reason": "host_cpus mismatch, no calibration envelope",
+            })
+            continue
+        if scale is not None:
+            # expected cur = prev moved with the machine: rates scale
+            # with calib, times against it
+            pval = pval * scale if higher else pval / scale
+        compared += compare(key, pval, cval, higher, scale is not None)
+
+    # the headline "value" has no suffixed twin in the full sweep —
+    # diff it via the unit field when the metric lines match
+    if (prev.get("metric") == cur.get("metric")
+            and isinstance(prev.get("value"), (int, float))
+            and isinstance(cur.get("value"), (int, float))
+            and prev["value"] > 0 and cur["value"] > 0):
+        unit = str(prev.get("unit", ""))
+        if unit.endswith("/s"):
+            compared += compare("value", float(prev["value"]),
+                                float(cur["value"]), True, False)
+        elif unit in ("ms", "us", "s", "pct"):
+            compared += compare("value", float(prev["value"]),
+                                float(cur["value"]), False, False)
+
+    # phase waterfall: every phase is a duration → lower is better
+    pph, cph = _diff_phase_means(prev), _diff_phase_means(cur)
+    for name in sorted(set(pph) & set(cph)):
+        if pph[name] > 0 and cph[name] > 0:
+            compared += compare(f"phase:{name}", pph[name], cph[name],
+                                False, False)
+
+    verdict["verdict"] = "regression" if regressions else "pass"
+    verdict["compared"] = compared
+    verdict["regressions"] = regressions
+    verdict["improvements"] = improvements
+    if skipped:
+        verdict["skipped"] = skipped
+    print(json.dumps({"diff": verdict}), flush=True)
+    return 4 if regressions else 0
+
+
+def _diff_threshold(argv) -> float:
+    raw = _flag_value(argv, "--diff-threshold") or os.environ.get(
+        "BENCH_DIFF_THRESHOLD", "25"
+    )
+    return float(raw)
+
+
 def main() -> None:
+    diff_prev = _flag_value(sys.argv[1:], "--diff")
+    if diff_prev is not None:
+        cur_path = _flag_value(sys.argv[1:], "--cur")
+        if cur_path is not None:
+            # pure file-vs-file compare: runs BEFORE the attach
+            # watchdog — no device, no world build, sub-second
+            sys.exit(_diff_records(
+                _load_artifact(diff_prev), _load_artifact(cur_path),
+                _diff_threshold(sys.argv[1:]),
+            ))
     if "--lint" in sys.argv[1:]:
         _lint_preflight()
     attached = _attach_watchdog(
@@ -2103,6 +2442,7 @@ def main() -> None:
             "unit": "rps",
             **out,
             "backend": backend,
+            "host_cpus": os.cpu_count(),
         }))
         return
 
@@ -2127,6 +2467,26 @@ def main() -> None:
             "flows_on_vps": round(on_vps),
             "pipeline_depth": 2,
             "backend": backend,
+            "host_cpus": os.cpu_count(),
+            "build_s": round(t_build, 2),
+        }))
+        return
+
+    if "--prof" in sys.argv[1:]:
+        # policyd-prof round: RTT decomposition soundness + sampled
+        # profiling overhead — the round driver gates on
+        # rtt_decomposition_sound and profiling_overhead_pct < 2
+        out = _bench_prof(
+            repo, reg, idents, np.random.default_rng(19), attached
+        )
+        attached.set()
+        print(json.dumps({
+            "metric": f"DeviceProfiling overhead at {N_RULES} rules",
+            "value": out["profiling_overhead_pct"],
+            "unit": "pct",
+            **out,
+            "backend": backend,
+            "host_cpus": os.cpu_count(),
             "build_s": round(t_build, 2),
         }))
         return
@@ -2145,6 +2505,7 @@ def main() -> None:
             "unit": "s",
             **out,
             "backend": backend,
+            "host_cpus": os.cpu_count(),
             "build_s": round(t_build, 2),
         }))
         return
@@ -2162,6 +2523,7 @@ def main() -> None:
             "unit": "flows/s",
             **out,
             "backend": backend,
+            "host_cpus": os.cpu_count(),
             "build_s": round(t_build, 2),
         }))
         return
@@ -2181,6 +2543,7 @@ def main() -> None:
             "unit": "verdicts/s",
             **out,
             "backend": backend,
+            "host_cpus": os.cpu_count(),
             "build_s": round(t_build, 2),
         }))
         return
@@ -2206,6 +2569,7 @@ def main() -> None:
             **out10,
             "scale_100k": out100,
             "backend": backend,
+            "host_cpus": os.cpu_count(),
             "build_s": round(t_build, 2),
         }))
         return
@@ -2224,6 +2588,7 @@ def main() -> None:
             "unit": "depth",
             **out,
             "backend": backend,
+            "host_cpus": os.cpu_count(),
             "build_s": round(t_build, 2),
         }))
         return
@@ -2374,6 +2739,7 @@ def main() -> None:
         # which backend produced these numbers (local-fallback = host
         # CPU after device attach failed; NOT comparable to device runs)
         "backend": backend,
+        "host_cpus": os.cpu_count(),
         # deny stage ACTIVE via the fused one-walk table (negative =
         # fusion unexpectedly absent)
         "pipeline_e2e_fused_pf_vps": round(pipeline_e2e_fused_pf_vps),
@@ -2409,6 +2775,13 @@ def main() -> None:
         ),
         file=sys.stderr,
     )
+    if diff_prev is not None:
+        # --diff without --cur: this fresh sweep IS the current record
+        # (the detail envelope rides along for calibration)
+        sys.exit(_diff_records(
+            _load_artifact(diff_prev), {**result, "detail": envelope},
+            _diff_threshold(sys.argv[1:]),
+        ))
 
 
 if __name__ == "__main__":

@@ -23,6 +23,7 @@ from typing import Iterable, List, Optional, Sequence, Set
 from .core import Finding, ModuleSource
 from .hotpath import analyze_hotpath
 from .locks import LockIndex, analyze_locks_module, cycle_findings
+from .obsdocs import analyze_obsdocs
 
 __all__ = [
     "Finding",
@@ -91,6 +92,7 @@ def analyze_paths(
     all_edges = []
     for mod in modules:
         findings.extend(analyze_hotpath(mod))
+        findings.extend(analyze_obsdocs(mod))
         lock_findings, edges = analyze_locks_module(mod, index)
         findings.extend(lock_findings)
         all_edges.extend(edges)

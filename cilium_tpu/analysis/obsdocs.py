@@ -1,0 +1,122 @@
+"""OBS001: metric families must be documented in observe/README.md.
+
+The observability surface (metrics.py families, /metrics exposition,
+the observe/README.md catalogue operators read) drifts silently: a PR
+adds ``registry.counter("cilium_tpu_new_total", ...)``, the dashboards
+pick it up, and the README that explains what the family MEANS — and
+what its cost model is — never learns the name. This rule pins the two
+together: every family registered at module level must have its full
+exposition name appear in the ``observe/README.md`` that lives next to
+the registering module (for ``cilium_tpu/metrics.py`` that is
+``cilium_tpu/observe/README.md``).
+
+Rule
+----
+OBS001  a module-level ``registry.counter/gauge/histogram("name", ...)``
+        call whose string-literal family name does not appear anywhere
+        in the sibling ``observe/README.md`` (warning). A module that
+        registers families but has no ``observe/README.md`` beside it
+        flags every registration — the catalogue is part of shipping a
+        family.
+
+Only literal first arguments are checked: a computed name can't be
+matched against prose, and the repo's registry idiom is literal-only.
+Suppress a justified exception with ``# policyd-lint: disable=OBS001``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional
+
+from .core import (
+    SEV_WARNING,
+    Finding,
+    ModuleSource,
+    call_name,
+    walk_skipping,
+)
+
+_REGISTRY_METHODS = ("counter", "gauge", "histogram")
+
+# per-analysis README text cache: every registering module in a
+# directory shares one catalogue read
+_readme_cache: Dict[str, Optional[str]] = {}
+
+
+def _readme_text(module_path: str) -> Optional[str]:
+    """Contents of the observe/README.md sibling to ``module_path``
+    (None when absent). A module inside observe/ itself reads its own
+    directory's README."""
+    d = os.path.dirname(os.path.abspath(module_path))
+    candidates = (
+        os.path.join(d, "observe", "README.md"),
+        os.path.join(d, "README.md") if os.path.basename(d) == "observe"
+        else None,
+    )
+    for path in candidates:
+        if path is None:
+            continue
+        if path not in _readme_cache:
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    _readme_cache[path] = f.read()
+            except OSError:
+                _readme_cache[path] = None
+        if _readme_cache[path] is not None:
+            return _readme_cache[path]
+    return None
+
+
+def _family_name(node: ast.Call) -> Optional[str]:
+    """The literal family name of a registry registration call, or
+    None when the call is not one (or the name is computed)."""
+    name = call_name(node)
+    if name is None:
+        return None
+    parts = name.split(".")
+    # registry.counter(...) or metrics.registry.counter(...)
+    if parts[-1] not in _REGISTRY_METHODS or "registry" not in parts[:-1]:
+        return None
+    if not node.args:
+        return None
+    first = node.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+        return first.value
+    return None
+
+
+def analyze_obsdocs(mod: ModuleSource) -> List[Finding]:
+    """Run OBS001 over one module's top-level statements. Registrations
+    inside functions are runtime-scoped (tests, fixtures) and exempt."""
+    regs: List[tuple] = []
+    scoped = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    for stmt in mod.tree.body:
+        if isinstance(stmt, scoped):
+            continue
+        for node in walk_skipping(stmt, scoped):
+            if isinstance(node, ast.Call):
+                fam = _family_name(node)
+                if fam is not None:
+                    regs.append((node.lineno, fam))
+    if not regs:
+        return []
+    readme = _readme_text(mod.path)
+    findings: List[Finding] = []
+    for line, fam in regs:
+        if readme is None:
+            findings.append(mod.finding(
+                "OBS001", SEV_WARNING, line,
+                f"metric family {fam!r} registered but no "
+                "observe/README.md exists beside this module to "
+                "document it",
+            ))
+        elif fam not in readme:
+            findings.append(mod.finding(
+                "OBS001", SEV_WARNING, line,
+                f"metric family {fam!r} is not documented in "
+                "observe/README.md (add it to the metrics catalogue "
+                "so the exposition and the operator docs can't drift)",
+            ))
+    return findings

@@ -123,6 +123,9 @@ class APIClient:
     def traces_get(self, limit: int = 16):
         return self._request("GET", f"/traces?limit={limit}")
 
+    def profile_get(self):
+        return self._request("GET", "/profile")
+
     def flows_get(self, limit: int = 64, *, verdict=None,
                   from_identity=None, reason=None):
         params = [f"limit={limit}"]

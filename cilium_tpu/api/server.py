@@ -162,6 +162,8 @@ class _Handler(UnixHandler):
         elif path == "/traces" and method == "GET":
             limit = int(q.get("limit", ["16"])[0])
             self._json(200, d.traces(limit=limit))
+        elif path == "/profile" and method == "GET":
+            self._json(200, d.profile())
         elif path == "/flows" and method == "GET":
             def _opt(name):
                 return int(q[name][0]) if name in q else None

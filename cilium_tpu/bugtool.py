@@ -65,6 +65,14 @@ def collect_debuginfo(daemon) -> Dict:
         # attributed flows an operator replays offline against
         # policy.json to explain each verdict
         "flows": daemon.flows(limit=64),
+        # policyd-prof → profile.json: sampled RTT decomposition +
+        # memory/transfer ledgers, so offline bundles carry the full
+        # telemetry surface
+        "profile": daemon.profile(),
+        # raw Prometheus exposition IN the payload: a remote
+        # /debuginfo fetch then archives the same metrics.prom a
+        # live-daemon capture gets (write_archive_from pops this key)
+        "metrics": daemon.metrics_text(),
     }
 
 
@@ -78,9 +86,14 @@ def write_archive_from(info: Dict, metrics_text: str, path: str) -> str:
     """cilium-bugtool: write a tar.gz of per-subsystem JSON files plus
     the raw Prometheus metrics text. Accepts the /debuginfo payload so
     the CLI can archive a REMOTE daemon over REST. Returns the path."""
+    info = dict(info)
+    # the payload's own exposition text (remote captures) becomes
+    # metrics.prom, not a JSON-encoded metrics.json; an explicit
+    # metrics_text (live-daemon capture) wins
+    payload_metrics = info.pop("metrics", None)
     members = {f"{key}.json": json.dumps(value, indent=1, default=str)
                for key, value in info.items()}
-    members["metrics.prom"] = metrics_text
+    members["metrics.prom"] = metrics_text or payload_metrics or ""
     with tarfile.open(path, "w:gz") as tar:
         for name, text in sorted(members.items()):
             data = text.encode()

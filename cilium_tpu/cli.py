@@ -104,6 +104,9 @@ class _Surface:
     def _d_traces_get(self, limit=16):
         return self._daemon.traces(limit=limit)
 
+    def _d_profile_get(self):
+        return self._daemon.profile()
+
     def _d_flows_get(self, limit=64, *, verdict=None,
                      from_identity=None, reason=None):
         return self._daemon.flows(
@@ -256,6 +259,13 @@ def build_parser() -> argparse.ArgumentParser:
                      help="raw trace dicts instead of waterfalls")
     mon.add_argument("--timeout", type=float, default=None,
                      help="stop after N idle seconds (default: run forever)")
+
+    top = sub.add_parser(
+        "top", help="device-time profile: sampled RTT split, jit cost "
+                    "ledger, device memory + transfer ledgers"
+    )
+    top.add_argument("--json", action="store_true",
+                     help="raw profile dict instead of the summary view")
 
     flw = sub.add_parser(
         "flows", help="print sampled attributed flows (policyd-flows)"
@@ -1141,12 +1151,69 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"quarantined, "
                         f"{'fail-open' if fs.get('fail_open') else 'fail-closed'}"
                     )
+                pq = out.get("phase_quantiles")
+                if pq:
+                    # process-lifetime latency context (histogram
+                    # interpolation) for the per-batch waterfalls below
+                    print("phase quantiles: " + ", ".join(
+                        f"{ph} p50={v['p50_ms']}ms/p99={v['p99_ms']}ms"
+                        for ph, v in sorted(pq.items())
+                    ))
                 print()
             for t in out.get("traces", ()):
                 print(render_waterfall(
                     t["kind"], t["batch"], t["total_ns"], t["phases"],
                 ))
                 print()
+    elif args.cmd == "top":
+        out = s.profile_get()
+        if args.json:
+            _print(out)
+        else:
+            if not out.get("enabled"):
+                print("device profiling is disabled (enable with "
+                      "`cilium-tpu config DeviceProfiling=true`)")
+            else:
+                print(f"sampling every {out.get('sample_every')} "
+                      f"batch(es), {len(out.get('samples', ()))} "
+                      "sample(s) retained")
+            sites = out.get("sites") or {}
+            if sites:
+                print()
+                print(f"{'site':<10}{'samples':>8}{'h2d_ms':>10}"
+                      f"{'compute_ms':>12}{'d2h_ms':>10}")
+                for name, st in sorted(
+                    sites.items(),
+                    key=lambda kv: -kv[1].get("device_compute_ms", 0.0),
+                ):
+                    print(f"{name:<10}{st.get('samples', 0):>8}"
+                          f"{st.get('h2d_ms', 0.0):>10.3f}"
+                          f"{st.get('device_compute_ms', 0.0):>12.3f}"
+                          f"{st.get('d2h_ms', 0.0):>10.3f}")
+            costs = out.get("jit_costs") or {}
+            if costs:
+                print()
+                print("jit sites (XLA cost_analysis per compiled "
+                      "program):")
+                for key, c in sorted(costs.items()):
+                    print(f"  {key}: flops={c.get('flops')} "
+                          f"bytes_accessed={c.get('bytes_accessed')}")
+            ledger = out.get("device_table_bytes") or {}
+            if ledger:
+                print()
+                print("device table bytes (family/placement, per "
+                      "device):")
+                for key, val in sorted(ledger.items()):
+                    print(f"  {key:<28}{int(val):>14,}")
+            xf = out.get("device_transfers") or {}
+            if xf.get("counts") or xf.get("bytes"):
+                counts = xf.get("counts") or {}
+                nbytes = xf.get("bytes") or {}
+                print()
+                print("device transfers:")
+                for k in sorted(set(counts) | set(nbytes)):
+                    print(f"  {k:<6} count={counts.get(k, 0):.0f} "
+                          f"bytes={nbytes.get(k, 0):.0f}")
     elif args.cmd == "flows":
         import datetime as _dt
 

@@ -127,6 +127,9 @@ class Daemon:
             # are runtime options (default off)
             deadline_ms=cfg.verdict_deadline_ms,
             stall_ms=cfg.dispatch_stall_ms,
+            # policyd-prof: the sampling period is boot config; the
+            # DeviceProfiling gate itself is a runtime option (off)
+            profile_sample_every=cfg.profile_sample_every,
         )
         # ONE controller registry for the whole daemon (pkg/controller;
         # `cilium status --all-controllers` reads it) — the endpoint
@@ -788,7 +791,7 @@ class Daemon:
             "PhaseTracing", "VerdictSharding", "MeshSharding2D",
             "FlowAttribution", "DispatchAutoTune", "FailOpen",
             "FaultInjection", "EpochSwap", "L7DeviceBatch",
-            "AdmissionControl", "Prefilter",
+            "AdmissionControl", "Prefilter", "DeviceProfiling",
         }
     )
 
@@ -861,6 +864,14 @@ class Daemon:
             # publishes on the next rebuild; off publishes None and the
             # shed kernels never trace
             self.pipeline.set_prefilter_shed(value)
+        elif name == "DeviceProfiling":
+            # policyd-prof: the sampling device profiler; off clears
+            # the instance and both dispatch paths return to one
+            # attribute read per batch (exact pre-option programs)
+            self.pipeline.set_profiling(value)
+            from .datapath import l7_pipeline as _l7rt
+
+            _l7rt.set_profiler(self.pipeline.profiler)
         elif name == "FaultInjection":
             # policyd-failsafe: arm/disarm the injection hub; off keeps
             # rules queued so a re-enable resumes a chaos scenario
@@ -1114,8 +1125,54 @@ class Daemon:
             # spans read during an overload spike need to say which
             # flows never reached the device path at all
             "admission": self.pipeline.admission_state(),
+            # policyd-prof: per-phase p50/p99 from the registry's
+            # bucket counts — callers stop eyeballing raw buckets
+            "phase_quantiles": self._phase_quantiles(),
             "traces": tr.traces(limit),
         }
+
+    def _phase_quantiles(self) -> Dict:
+        """{phase: {n, p50_ms, p99_ms}} interpolated from the
+        pipeline_phase_seconds histogram (metrics.Histogram.quantile)."""
+        h = metrics.pipeline_phase_seconds
+        out: Dict = {}
+        for lbl in h.series_labels():
+            phase = lbl.get("phase")
+            if phase is None:
+                continue
+            n = h.get_count(lbl)
+            if not n:
+                continue
+            p50 = h.quantile(0.5, lbl)
+            p99 = h.quantile(0.99, lbl)
+            out[phase] = {
+                "n": n,
+                "p50_ms": round(p50 * 1e3, 4),
+                "p99_ms": round(p99 * 1e3, 4),
+            }
+        return out
+
+    def profile(self) -> Dict:
+        """GET /profile (policyd-prof): sampled RTT decomposition +
+        per-site aggregates, the jit cost ledger, and the device
+        memory/transfer ledgers."""
+        snap = self.pipeline.profile_state()
+        snap["device_table_bytes"] = {
+            "/".join(v for _, v in key): val
+            for key, val in metrics.device_table_bytes.series().items()
+        }
+        snap["device_transfers"] = {
+            "counts": {
+                "/".join(v for _, v in key) or "all": val
+                for key, val in metrics.device_transfers_total.series().items()
+            },
+            "bytes": {
+                "/".join(v for _, v in key) or "all": val
+                for key, val
+                in metrics.device_transfer_bytes_total.series().items()
+            },
+        }
+        return snap
 
     def flows(
         self,

@@ -28,6 +28,7 @@ import time
 from collections import deque
 from typing import List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -78,9 +79,10 @@ class PendingL7Batch:
 
 
 class _InFlight:
-    __slots__ = ("pending", "chunks", "n_req", "n_fields", "bt", "t0")
+    __slots__ = ("pending", "chunks", "n_req", "n_fields", "bt", "t0", "ps")
 
-    def __init__(self, pending, chunks, n_req, n_fields, bt, t0) -> None:
+    def __init__(self, pending, chunks, n_req, n_fields, bt, t0,
+                 ps=None) -> None:
         self.pending = pending
         # [(lo_dev, hi_dev, rows_live)] — device handles; pulled at
         # completion time, not submit time (that's the overlap)
@@ -89,6 +91,9 @@ class _InFlight:
         self.n_fields = n_fields
         self.bt = bt
         self.t0 = t0
+        # policyd-prof: live _DispatchSample on the profiler's Nth
+        # batch (None otherwise); _finish times the mask pull into it
+        self.ps = ps
 
 
 class L7Pipeline:
@@ -103,6 +108,10 @@ class L7Pipeline:
     def __init__(self, depth: int = 2, tracer: Optional[Tracer] = None) -> None:
         self.depth = max(1, int(depth))
         self.tracer = tracer
+        # policyd-prof: None (off) keeps submit()/_finish() at one
+        # attribute read per batch; the daemon installs the shared
+        # DeviceProfiler through set_profiler() below
+        self.profiler = None
         self._lock = threading.Lock()
         self._inflight: "deque[_InFlight]" = deque()
         # jit program identity for the walk is (kernel, Q, lanes, rung):
@@ -211,11 +220,18 @@ class L7Pipeline:
             live = int(lens.size)
             live_bytes = int(np.maximum(lens, 0).sum())
 
+        # policyd-prof: one attribute read while off; the sampled
+        # batch pays the explicit-upload / ready sandwiches below
+        prof = self.profiler
+        ps = prof.begin_dispatch("l7", n_req) if prof is not None else None
+
         with bt.phase("dispatch"):
             chunks = []
             top = L7_LANE_RUNGS[-1]
             pad_rows = 0
             off = 0
+            n_chunks = 0
+            _pl_t0 = time.perf_counter() if ps is not None else 0.0
             while off < live:
                 take = min(top, live - off)
                 lanes = lane_rung(take)
@@ -231,11 +247,40 @@ class L7Pipeline:
                     csb = sb[off : off + take]
                     clens = lens[off : off + take]
                     cstarts = starts[off : off + take]
+                if ps is not None:
+                    # sampled h2d edge: upload explicitly and wait so
+                    # the walk below starts from device-resident inputs
+                    # (jnp.asarray in _walk passes jax arrays through —
+                    # same avals, same compiled program). The per-chunk
+                    # sync IS the measurement, 1-in-N batches only:
+                    _t0 = time.perf_counter()
+                    csb, clens, cstarts = jax.block_until_ready(  # policyd-lint: disable=TPU002
+                        jax.device_put((csb, clens, cstarts))
+                    )
+                    ps.add_h2d(time.perf_counter() - _t0)
                 kind = "pair" if table.has_pair else "fused"
                 self._note_shape(kind, table.n_states, lanes, rung)
                 lo, hi = self._walk(table, csb, clens, cstarts, rung)
                 chunks.append((lo, hi, take))
                 off += take
+                n_chunks += 1
+            if ps is not None:
+                # sampled compute edge: h2d already completed above, so
+                # the rest of the chunk loop (lane padding, per-rung jit
+                # dispatch) plus the residual wait here is the fused DFA
+                # walk side of the split
+                jax.block_until_ready([(c[0], c[1]) for c in chunks])
+                ps.add_compute(
+                    time.perf_counter() - _pl_t0 - ps.h2d_s
+                )
+                ps.mark(
+                    rungs=[lane_rung(min(top, c[2])) for c in chunks],
+                    len_rung=int(rung),
+                    lanes=int(live),
+                    pad_lanes=int(pad_rows),
+                    chunks=n_chunks,
+                    parser=parser,
+                )
             metrics.l7_pad_lanes_total.inc({"kind": "lane"}, pad_rows)
             metrics.l7_pad_lanes_total.inc({"kind": "lane_live"}, live)
             metrics.l7_pad_lanes_total.inc(
@@ -245,7 +290,7 @@ class L7Pipeline:
             metrics.l7_batches_total.inc({"parser": parser})
 
         pending = PendingL7Batch(self)
-        entry = _InFlight(pending, chunks, n_req, table.n_fields, bt, t0)
+        entry = _InFlight(pending, chunks, n_req, table.n_fields, bt, t0, ps)
         if bt is not NOOP_BATCH:
             tr.detach(bt)
         overflow: List[_InFlight] = []
@@ -268,6 +313,8 @@ class L7Pipeline:
 
     def _finish(self, entry: _InFlight) -> None:
         bt = entry.bt
+        ps = entry.ps
+        _pt0 = time.perf_counter() if ps is not None else 0.0
         try:
             with bt.phase("host_sync"):
                 parts = []
@@ -290,6 +337,12 @@ class L7Pipeline:
         # or FIFO draining would deadlock behind it
         except Exception as exc:  # policyd-lint: disable=ROBUST001
             entry.pending._exc = exc
+        if ps is not None:
+            ps.add_d2h(time.perf_counter() - _pt0)
+            prof = self.profiler
+            if prof is not None:
+                prof.complete(ps)
+            entry.ps = None
         entry.pending._done = True
         metrics.l7_batch_seconds.observe(time.perf_counter() - entry.t0)
         bt.end()
@@ -310,6 +363,10 @@ class L7Pipeline:
 _rt_lock = threading.Lock()
 _enabled = False
 _pipeline: Optional[L7Pipeline] = None
+# shared DeviceProfiler (policyd-prof): installed by the daemon while
+# DeviceProfiling is on; carried onto any pipeline set_device_batch
+# creates later so toggle order doesn't matter
+_profiler = None
 
 
 def set_device_batch(on: bool, tracer: Optional[Tracer] = None,
@@ -322,12 +379,23 @@ def set_device_batch(on: bool, tracer: Optional[Tracer] = None,
         if on:
             if _pipeline is None or (tracer is not None and _pipeline.tracer is not tracer):
                 _pipeline = L7Pipeline(depth=depth, tracer=tracer)
+            _pipeline.profiler = _profiler
             _enabled = True
             return
         _enabled = False
         pipe, _pipeline = _pipeline, None
     if pipe is not None:
         pipe.drain()
+
+
+def set_profiler(prof) -> None:
+    """Install (or clear, with None) the shared DeviceProfiler on the
+    L7 pipeline — the DeviceProfiling half of the L7DeviceBatch gate."""
+    global _profiler
+    with _rt_lock:
+        _profiler = prof
+        if _pipeline is not None:
+            _pipeline.profiler = prof
 
 
 def device_batch_enabled() -> bool:
@@ -343,3 +411,4 @@ def shared_pipeline() -> Optional[L7Pipeline]:
 
 def _reset_for_tests() -> None:
     set_device_batch(False)
+    set_profiler(None)

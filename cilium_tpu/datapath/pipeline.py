@@ -747,11 +747,12 @@ class _Enqueued:
 
     __slots__ = (
         "chunks", "spans", "b", "exact", "ndev", "attrib", "staging", "host",
+        "psample",
     )
 
     def __init__(
         self, chunks, spans, b, exact, ndev, attrib=False, staging=(),
-        host=None,
+        host=None, psample=None,
     ) -> None:
         self.chunks = chunks
         self.spans = spans
@@ -765,6 +766,10 @@ class _Enqueued:
         # ladder level 2 (host fallback): (verdict, redirect) computed
         # synchronously on host numpy — no device arrays to pull
         self.host = host
+        # policyd-prof: the live _DispatchSample when this dispatch was
+        # the profiler's Nth batch (None otherwise) — the completion
+        # half times the d2h pull into it and retires it
+        self.psample = psample
 
 
 class DatapathPipeline:
@@ -795,6 +800,8 @@ class DatapathPipeline:
         prefilter_shed: bool = False,
         deadline_ms: float = 0.0,
         stall_ms: float = 0.0,
+        profiling: bool = False,
+        profile_sample_every: int = 64,
     ) -> None:
         self.engine = engine
         self.ipcache = ipcache
@@ -1050,6 +1057,16 @@ class DatapathPipeline:
         self._completing: Optional[Tuple] = None
         if stall_ms > 0:
             self.set_stall_ms(stall_ms)
+        # -- policyd-prof: device-time sampling profiler --------------
+        # DeviceProfiling runtime option: None (off) keeps the dispatch
+        # halves at one `self.profiler is None` read per batch — the
+        # exact pre-option programs (observe/profiler.py is not even
+        # imported). sample_every is boot config; set_profiling builds
+        # the profiler with it.
+        self.profile_sample_every = max(1, int(profile_sample_every))
+        self.profiler = None
+        if profiling:
+            self.set_profiling(True)
         _metrics.pipeline_mode.set(0.0)
 
     def set_endpoints(self, endpoints: Sequence) -> None:
@@ -1374,6 +1391,43 @@ class DatapathPipeline:
             out["shed_ratio"] = 0.0
         out["watchdog"] = wd.snapshot() if wd is not None else None
         return out
+
+    # -- policyd-prof: device-time sampling profiler -------------------
+    def set_profiling(
+        self, on: bool, *, sample_every: Optional[int] = None
+    ) -> None:
+        """Toggle the DeviceProfiling runtime option. Off (default)
+        keeps both dispatch halves at ONE attribute read per batch
+        (``self.profiler is None``) — the exact pre-option programs;
+        on installs a fresh DeviceProfiler whose every
+        ``sample_every``-th batch pays the block_until_ready
+        sandwiches that decompose dispatch RTT (observe/profiler.py)."""
+        if sample_every is not None:
+            self.profile_sample_every = max(1, int(sample_every))
+        if not on:
+            self.profiler = None
+            return
+        if self.profiler is None:
+            from ..observe.profiler import DeviceProfiler
+
+            self.profiler = DeviceProfiler(
+                sample_every=self.profile_sample_every
+            )
+        elif self.profiler.sample_every != self.profile_sample_every:
+            # re-enable with a new rate retunes the live instance (the
+            # ring and ledgers are kept — only the cadence moves)
+            self.profiler.sample_every = self.profile_sample_every
+
+    def profile_state(self) -> Dict:
+        """Profiler snapshot for GET /profile and ``cilium-tpu top``
+        (enabled flag + samples/aggregates/jit-cost ledger when on)."""
+        prof = self.profiler
+        if prof is None:
+            return {
+                "enabled": False,
+                "sample_every": self.profile_sample_every,
+            }
+        return prof.snapshot()
 
     def _shed_walk(
         self, peer_bytes: np.ndarray, dports, protos, *, family: int
@@ -2077,6 +2131,37 @@ class DatapathPipeline:
             _metrics.sharded_table_bytes.set(
                 float(rt_bytes // ident), {"family": "rule_tab"}
             )
+            # policyd-prof memory ledger: every device-resident table
+            # family under its placement (same per-device convention as
+            # sharded_table_bytes; the tries are always replicated —
+            # every flow shard walks the whole trie)
+            ident_placement = (
+                "ident-sharded" if self._plan.is_2d else "replicated"
+            )
+            _metrics.device_table_bytes.set(
+                float(pm_bytes // ident),
+                {"family": "policymap", "placement": ident_placement},
+            )
+            _metrics.device_table_bytes.set(
+                float(rt_bytes // ident),
+                {"family": "rule_tab", "placement": ident_placement},
+            )
+            sel = getattr(device, "sel_match", None)
+            if sel is not None:
+                _metrics.device_table_bytes.set(
+                    float(int(getattr(sel, "nbytes", 0)) // ident),
+                    {"family": "sel_match", "placement": ident_placement},
+                )
+            if self._tries is not None:
+                trie_bytes = sum(
+                    int(getattr(a, "nbytes", 0))
+                    for leaves in self._tries[:2]
+                    for a in leaves
+                )
+                _metrics.device_table_bytes.set(
+                    float(trie_bytes),
+                    {"family": "lpm_trie", "placement": "replicated"},
+                )
             if self.counters.shape[0] != len(self._endpoints):
                 self.counters = np.zeros((len(self._endpoints), 3), np.int64)
             return self._tables
@@ -2809,7 +2894,7 @@ class DatapathPipeline:
         self, t, peer_bytes, ep_idx, dports, protos, row_override,
         lo, hi, padded, *, family, pf_stage, ep_count, v6_fused,
         flow_sharding, rule_tab=None, n_rules=0, staging=None,
-        ident_gather=False,
+        ident_gather=False, psample=None,
     ):
         """Pad + upload + enqueue ONE chunk; returns the UN-PULLED
         device (verdict, redirect, counters) triple. Under sharding
@@ -2818,7 +2903,11 @@ class DatapathPipeline:
         ``staging`` (bucketed dispatches only) collects the pre-pinned
         rung buffers the pad half wrote into, for release at the host
         pull; padded rungs then cost four memcpys instead of four
-        np.pad allocations."""
+        np.pad allocations. ``psample`` (policyd-prof, the 1-in-N
+        sampled batch only) makes the upload an explicit synchronous
+        device_put so its wall time separates from the async program
+        enqueue — identical avals, so the compiled program is the same
+        one the unsampled path runs."""
         if _faults.hub.active:
             _faults.hub.check(_faults.SITE_H2D)
         pb = peer_bytes[lo:hi]
@@ -2850,7 +2939,22 @@ class DatapathPipeline:
             pb, ei, dp, pr, ro = _pad_flows(pad, pb, ei, dp, pr,
                                             row_override=ro)
         peer = _pack_v4_u32(pb) if family == 4 else pb
-        if flow_sharding is not None:
+        if psample is not None:
+            # sampled h2d edge: upload explicitly and wait — the time
+            # between here and the post-enqueue ready wait is then pure
+            # device compute. device_put with sharding=None commits to
+            # the default device; either way the avals (and therefore
+            # the jit cache key / compiled program) are unchanged.
+            _t0 = time.perf_counter()
+            peer, ei, dp, pr = jax.block_until_ready(
+                jax.device_put((peer, ei, dp, pr), flow_sharding)
+            )
+            if ro is not None:
+                ro = jax.block_until_ready(
+                    jax.device_put(ro, flow_sharding)
+                )
+            psample.add_h2d(time.perf_counter() - _t0)
+        elif flow_sharding is not None:
             peer, ei, dp, pr = jax.device_put(
                 (peer, ei, dp, pr), flow_sharding
             )
@@ -2858,19 +2962,36 @@ class DatapathPipeline:
                 ro = jax.device_put(ro, flow_sharding)
         elif ro is not None:
             ro = jnp.asarray(ro)
+        attrib = rule_tab is not None
         if family == 4:
-            return process_flows_wide(
-                t, peer, ei, dp, pr, ep_count=ep_count,
-                prefilter=pf_stage, row_override=ro,
-                attrib=rule_tab is not None, rule_tab=rule_tab,
-                n_rules=n_rules, ident_gather=ident_gather,
+            fn = process_flows_wide
+            fargs = (t, peer, ei, dp, pr)
+            fkw = dict(
+                ep_count=ep_count, prefilter=pf_stage, row_override=ro,
+                attrib=attrib, rule_tab=rule_tab, n_rules=n_rules,
+                ident_gather=ident_gather,
             )
-        return process_flows(
-            t, peer, ei, dp, pr, ep_count=ep_count, levels=16,
-            prefilter=pf_stage, fused=v6_fused, row_override=ro,
-            attrib=rule_tab is not None, rule_tab=rule_tab,
-            n_rules=n_rules, ident_gather=ident_gather,
-        )
+        else:
+            fn = process_flows
+            fargs = (t, peer, ei, dp, pr)
+            fkw = dict(
+                ep_count=ep_count, levels=16, prefilter=pf_stage,
+                fused=v6_fused, row_override=ro, attrib=attrib,
+                rule_tab=rule_tab, n_rules=n_rules,
+                ident_gather=ident_gather,
+            )
+        if psample is not None:
+            prof = self.profiler
+            if prof is not None:
+                # compile-time cost ledger: flops / bytes-accessed for
+                # this (site, stable ladder shape), recorded once
+                prof.note_jit_cost(
+                    "dispatch",
+                    (family, padded, pf_stage, ep_count, ro is not None,
+                     v6_fused, attrib, ident_gather),
+                    fn, fargs, fkw,
+                )
+        return fn(*fargs, **fkw)
 
     # -- policyd-failsafe: ladder level 2 (host fallback) ---------------
     def _host_tables(self, direction: int) -> Optional[Tuple]:
@@ -3055,6 +3176,14 @@ class DatapathPipeline:
             _metrics.dispatch_pad_lanes_total.inc(
                 {"family": f"v{family}"}, float(pad_lanes)
             )
+        # policyd-prof: one attribute read while off (None); while on,
+        # every sample_every-th dispatch gets a live sample and pays
+        # the synchronizing sandwiches (h2d inside _enqueue_one, the
+        # ready wait below, d2h in _dispatch_complete)
+        prof = self.profiler
+        psample = (
+            prof.begin_dispatch("dispatch", b) if prof is not None else None
+        )
         tr = self.tracer
         if tr.active:
             # shape-bucket telemetry: the jit cache keys on padded
@@ -3081,6 +3210,17 @@ class DatapathPipeline:
                 {"direction": "h2d"},
                 (4.0 + (row_override is not None)) * len(spans) * ndev,
             )
+            # byte-ledger sibling (policyd-prof): logical upload bytes
+            # — v4 packs to one u32 lane, v6 ships the raw int32
+            # bytes; shard slices sum to the full array, so no ×ndev
+            peer_w = 4 if family == 4 else peer_bytes.shape[1] * 4
+            _metrics.device_transfer_bytes_total.inc(
+                {"direction": "h2d"},
+                float(sum(
+                    p * (peer_w + 12 + (4 if row_override is not None else 0))
+                    for _, _, p in spans
+                )),
+            )
             bt.mark(
                 padded=int(sum(p for _, _, p in spans)), chunks=len(spans)
             )
@@ -3090,6 +3230,7 @@ class DatapathPipeline:
         # separate spans would de-fuse the program); the actual device
         # execution time aggregates into "host_sync" at completion.
         staging = [] if bucketed else None
+        _pl_t0 = time.perf_counter() if psample is not None else 0.0
         with bt.phase("dispatch"):
             chunks = [
                 self._enqueue_one(
@@ -3098,16 +3239,46 @@ class DatapathPipeline:
                     ep_count=ep_count, v6_fused=v6_fused,
                     flow_sharding=flow_sharding, rule_tab=rule_tab,
                     n_rules=n_rules, staging=staging, ident_gather=ident2d,
+                    psample=psample,
                 )
                 for lo, hi, padded in spans
             ]
+            if psample is not None:
+                # sampled compute edge: h2d already completed
+                # synchronously inside _enqueue_one, so what remains of
+                # the chunk loop — per-chunk program dispatch (slicing,
+                # padding, jit call) plus the residual device wait here
+                # — is charged to device_compute (on hardware the
+                # dispatch overhead runs concurrently with execution;
+                # splitting it would need a per-chunk sync that changes
+                # what's being measured). Done INSIDE the dispatch span
+                # so a sampled batch's trace and its decomposition
+                # cover the same wall clock. This serializes THIS batch
+                # against the pipeline overlap — the cost sampling
+                # exists to amortize.
+                jax.block_until_ready(chunks)
+                psample.add_compute(
+                    time.perf_counter() - _pl_t0 - psample.h2d_s
+                )
+                # rung occupancy: what the tuner/chunker chose vs what
+                # was live — makes pad waste visible per sample
+                psample.mark(
+                    rungs=[int(p) for _, _, p in spans],
+                    lanes=int(b),
+                    pad_lanes=int(sum(p for _, _, p in spans) - b),
+                    chunks=len(spans),
+                    ndev=int(ndev),
+                    depth=int(self.pipeline_depth),
+                    family=int(family),
+                    bucketed=bool(bucketed),
+                )
         if bucketed:
             for _lo, _hi, padded in spans:
                 self._warm_buckets.add(padded)
         exact = all(hi - lo == padded for lo, hi, padded in spans)
         return _Enqueued(chunks, spans, b, exact, ndev,
                          attrib=rule_tab is not None,
-                         staging=staging or ())
+                         staging=staging or (), psample=psample)
 
     def _dispatch_complete(
         self, enq: _Enqueued, bt=_NOOP_BATCH
@@ -3146,6 +3317,24 @@ class DatapathPipeline:
                 {"direction": "d2h"},
                 (6.0 if enq.attrib else 3.0) * len(enq.chunks) * enq.ndev,
             )
+            # byte-ledger sibling (policyd-prof): logical bytes the
+            # pull below actually moves (counters/hits only when exact
+            # — the inexact path never reads them). .nbytes on an
+            # un-pulled device array is metadata, no sync.
+            nb = 0
+            for ch in enq.chunks:
+                nb += int(ch[0].nbytes) + int(ch[1].nbytes)
+                if enq.attrib:
+                    nb += int(ch[3].nbytes) + int(ch[4].nbytes)
+                if enq.exact:
+                    nb += int(ch[2].nbytes)
+                    if enq.attrib:
+                        nb += int(ch[5].nbytes)
+            _metrics.device_transfer_bytes_total.inc(
+                {"direction": "d2h"}, float(nb)
+            )
+        ps = enq.psample
+        _pt0 = time.perf_counter() if ps is not None else 0.0
         with bt.phase("host_sync"):
             b = enq.b
             rule = l4x = hits = None
@@ -3178,6 +3367,14 @@ class DatapathPipeline:
                         hits = hits + np.asarray(ch[5])
             else:
                 counters = None
+        if ps is not None:
+            # sampled d2h edge: the residual pull wait (compute already
+            # completed at the enqueue half's ready sandwich)
+            ps.add_d2h(time.perf_counter() - _pt0)
+            prof = self.profiler
+            if prof is not None:
+                prof.complete(ps)
+            enq.psample = None  # retry-idempotent: never retire twice
         if enq.staging:
             # the host pull above proves the device program finished —
             # only now are the pinned buffers safe to hand to the next

@@ -40,9 +40,19 @@ class Counter:
     def get(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self._values.get(_labels_key(labels), 0.0)
 
+    def series(self) -> Dict[_LabelKey, float]:
+        """Point-in-time snapshot of every label series (for /profile
+        readers that want values, not exposition text)."""
+        with self._lock:
+            return dict(self._values)
+
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for k, v in sorted(self._values.items()):
+        # snapshot under the lock: a concurrent inc() on a fresh label
+        # set would otherwise mutate the dict mid-iteration
+        with self._lock:
+            items = sorted(self._values.items())
+        for k, v in items:
             out.append(f"{self.name}{_fmt_labels(k)} {v}")
         return out
 
@@ -54,7 +64,9 @@ class Gauge(Counter):
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        for k, v in sorted(self._values.items()):
+        with self._lock:
+            items = sorted(self._values.items())
+        for k, v in items:
             out.append(f"{self.name}{_fmt_labels(k)} {v}")
         return out
 
@@ -100,6 +112,42 @@ class Histogram:
     def get_count(self, labels: Optional[Dict[str, str]] = None) -> int:
         s = self._series.get(_labels_key(labels))
         return 0 if s is None else s.n
+
+    def series_labels(self) -> List[Dict[str, str]]:
+        """Label sets with at least one series (incl. the unlabeled
+        {}) — lets /traces and /profile walk per-phase quantiles
+        without reaching into the series dict."""
+        with self._lock:
+            keys = list(self._series.keys())
+        return [dict(k) for k in keys]
+
+    def quantile(
+        self, q: float, labels: Optional[Dict[str, str]] = None
+    ) -> Optional[float]:
+        """Estimate the q-quantile (0 < q <= 1) of one label series by
+        linear interpolation within the landing bucket — the standard
+        Prometheus histogram_quantile() estimate. Returns None for an
+        unobserved series. Values past the last finite bucket clamp to
+        that bucket bound (+Inf has no upper edge to interpolate to)."""
+        if not 0.0 < q <= 1.0:
+            raise ValueError("quantile must be in (0, 1]")
+        with self._lock:
+            s = self._series.get(_labels_key(labels))
+            if s is None or s.n == 0:
+                return None
+            counts = list(s.counts)
+            n = s.n
+        rank = q * n
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            prev_cum = cum
+            cum += counts[i]
+            if cum >= rank:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                if counts[i] == 0:
+                    return b
+                return lo + (b - lo) * (rank - prev_cum) / counts[i]
+        return self.buckets[-1]
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
@@ -370,4 +418,32 @@ watchdog_stalls_total = registry.counter(
     "the faults.py site the stalled operation registered under — "
     "dispatch for in-flight batches, attach/compile for registered "
     "external waits, stall for injected sweeps)",
+)
+
+# -- policyd-prof (device profiler + memory/transfer ledger) families ------
+profile_samples_total = registry.counter(
+    "cilium_tpu_profile_samples_total",
+    "Dispatches sampled by the device profiler (label site: dispatch|l7; "
+    "every profile_sample_every-th batch while DeviceProfiling is on)",
+)
+profile_phase_seconds = registry.histogram(
+    "cilium_tpu_profile_phase_seconds",
+    "Sampled dispatch RTT decomposition from the profiler's "
+    "block_until_ready sandwiches (label phase: h2d|device_compute|d2h; "
+    "only sampled batches observe — scale rates by profile_sample_every)",
+    buckets=PHASE_BUCKETS,
+)
+device_table_bytes = registry.gauge(
+    "cilium_tpu_device_table_bytes",
+    "PER-DEVICE resident bytes of each policy table family (labels: "
+    "family = policymap|rule_tab|sel_match|lpm_trie|dfa, placement = "
+    "replicated|ident-sharded; the memory-ledger counterpart of "
+    "cilium_tpu_sharded_table_bytes, covering every family)",
+)
+device_transfer_bytes_total = registry.counter(
+    "cilium_tpu_device_transfer_bytes_total",
+    "Host↔device bytes moved on traced dispatches (label: direction — "
+    "the byte-ledger sibling of the count-only "
+    "cilium_tpu_device_transfers_total; logical bytes, not multiplied "
+    "by mesh device count, since shard slices sum to the full array)",
 )

@@ -6,10 +6,12 @@ without pulling JAX.
 """
 
 from .flows import FlowRecord, FlowRing, SAMPLE_CAP
+from .profiler import DeviceProfiler
 from .tracer import BatchTrace, NOOP_BATCH, Tracer
 
 __all__ = [
     "BatchTrace",
+    "DeviceProfiler",
     "FlowRecord",
     "FlowRing",
     "NOOP_BATCH",

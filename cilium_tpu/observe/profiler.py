@@ -1,0 +1,199 @@
+# policyd: hot
+"""Device-time profiler for the verdict path (policyd-prof).
+
+The span tracer (tracer.py) attributes HOST wall time: under the
+async dispatch discipline the ``dispatch`` phase measures enqueue cost
+and ``host_sync`` absorbs everything the device did, so
+``dispatch_rtt_ms`` is one opaque number. This module adds the device
+side: every Nth completed batch (``DaemonConfig.profile_sample_every``)
+is sampled with ``jax.block_until_ready`` sandwiches at the
+enqueue/ready edges, splitting the RTT into ``h2d`` / ``device_compute``
+/ ``d2h``, recorded alongside the rung-occupancy the tuner chose
+(lanes live vs rung, chunk count, pad lanes). A second ledger captures
+per-jit-site ``cost_analysis()`` (flops, bytes accessed) once per
+stable ladder shape at compile time.
+
+Cost model (the hub's ``active`` pattern, monitor/hub.py): while
+``DeviceProfiling`` is off the pipeline holds ``self.profiler = None``
+and the hot path's entire cost is that one attribute read — this
+module is never even imported on the OFF path. While on, non-sampled
+batches pay one attribute read plus one locked counter tick; only the
+1-in-N sampled batch pays the synchronizing sandwiches (which is why
+sampling, not always-on timing: a block_until_ready at the enqueue
+edge serializes the overlap the pipeline exists to create).
+
+Import-light like the rest of observe/: stdlib + metrics only at
+module scope; jax is imported lazily inside ``note_jit_cost``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .. import metrics as _metrics
+
+
+class _DispatchSample:
+    """One sampled dispatch: the RTT decomposition accumulators plus
+    occupancy notes. Built only on the 1-in-N sampled path — the
+    disabled-overhead test monkeypatches this ctor to raise."""
+
+    __slots__ = (
+        "site", "batch", "ts", "h2d_s", "device_compute_s", "d2h_s",
+        "notes",
+    )
+
+    def __init__(self, site: str, batch: int) -> None:
+        self.site = site
+        self.batch = int(batch)
+        self.ts = time.time()
+        self.h2d_s = 0.0
+        self.device_compute_s = 0.0
+        self.d2h_s = 0.0
+        self.notes: Dict[str, object] = {}
+
+    def add_h2d(self, seconds: float) -> None:
+        self.h2d_s += seconds
+
+    def add_compute(self, seconds: float) -> None:
+        self.device_compute_s += seconds
+
+    def add_d2h(self, seconds: float) -> None:
+        self.d2h_s += seconds
+
+    def mark(self, **notes) -> None:
+        self.notes.update(notes)
+
+    def to_dict(self) -> Dict:
+        return {
+            "site": self.site,
+            "batch": self.batch,
+            "ts": self.ts,
+            "h2d_ms": self.h2d_s * 1e3,
+            "device_compute_ms": self.device_compute_s * 1e3,
+            "d2h_ms": self.d2h_s * 1e3,
+            "notes": dict(self.notes),
+        }
+
+
+class DeviceProfiler:
+    """Sampling profiler + jit-cost ledger. Disabled by default; the
+    daemon toggles it through the ``DeviceProfiling`` runtime option
+    (pipeline.set_profiling installs/clears the instance)."""
+
+    def __init__(self, sample_every: int = 64, capacity: int = 256) -> None:
+        # plain attribute, not a property: the ON-but-unsampled cost is
+        # reading this once per batch (pipeline reads self.profiler)
+        self.active = True
+        self.sample_every = max(1, int(sample_every))
+        self.capacity = int(capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._tick: Dict[str, int] = {}
+        # (site, shape-key) → {"flops", "bytes_accessed"} — populated
+        # once per stable ladder shape, so steady state never lowers
+        self._jit_costs: Dict[str, Dict] = {}
+
+    # -- hot-path API ---------------------------------------------------
+    def begin_dispatch(self, site: str, batch: int) -> Optional[_DispatchSample]:
+        """Tick the per-site sample counter; every ``sample_every``-th
+        call returns a live sample, the rest return None. The caller
+        gates every sandwich on that None."""
+        with self._lock:
+            t = self._tick.get(site, 0) + 1
+            self._tick[site] = t
+        if t % self.sample_every != 0:
+            return None
+        return _DispatchSample(site, batch)
+
+    def complete(self, sample: _DispatchSample) -> None:
+        """Retire a finished sample into the ring and the registry."""
+        with self._lock:
+            self._ring.append(sample)
+        lbl_site = {"site": sample.site}
+        _metrics.profile_samples_total.inc(lbl_site)
+        _metrics.profile_phase_seconds.observe(
+            sample.h2d_s, {"phase": "h2d"})
+        _metrics.profile_phase_seconds.observe(
+            sample.device_compute_s, {"phase": "device_compute"})
+        _metrics.profile_phase_seconds.observe(
+            sample.d2h_s, {"phase": "d2h"})
+
+    # -- compile-time ledger --------------------------------------------
+    def note_jit_cost(self, site: str, shape_key, fn, args, kwargs) -> None:
+        """Record XLA's cost_analysis for one (jit site, ladder shape),
+        once. Lowering an already-compiled shape hits the jit cache's
+        tracing machinery, not a device recompile, but it still isn't
+        free — which is fine: this runs at most once per stable rung
+        key, on a sampled batch. Best-effort: cost_analysis is not
+        available on every backend/JAX version, so any failure just
+        leaves the entry marked unavailable."""
+        key = f"{site}:{shape_key}"
+        with self._lock:
+            if key in self._jit_costs:
+                return
+            # reserve before the (slow, lock-free) lowering so a racing
+            # sampler doesn't lower the same program twice
+            self._jit_costs[key] = {"flops": None, "bytes_accessed": None}
+        entry: Dict[str, object] = {"flops": None, "bytes_accessed": None}
+        try:
+            lowered = fn.lower(*args, **kwargs)
+            cost = lowered.compile().cost_analysis()
+            # JAX version drift: dict, or a list of per-computation dicts
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            if isinstance(cost, dict):
+                if "flops" in cost:
+                    entry["flops"] = float(cost["flops"])
+                if "bytes accessed" in cost:
+                    entry["bytes_accessed"] = float(cost["bytes accessed"])
+        except Exception:  # policyd-lint: disable=ROBUST001
+            # best-effort telemetry by contract (docstring above): a
+            # backend without cost_analysis must never fault a dispatch
+            pass
+        with self._lock:
+            self._jit_costs[key] = entry
+
+    # -- cold-path API --------------------------------------------------
+    def samples(self, limit: Optional[int] = None) -> List[Dict]:
+        with self._lock:
+            items = list(self._ring)
+        if limit is not None and limit >= 0:
+            items = items[-limit:]
+        return [s.to_dict() for s in items]
+
+    def jit_costs(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._jit_costs.items()}
+
+    def snapshot(self) -> Dict:
+        """The /profile payload core: recent samples, per-site device
+        time aggregates (the ``cilium-tpu top`` ranking), and the
+        compile-time cost ledger."""
+        samples = self.samples()
+        sites: Dict[str, Dict] = {}
+        for s in samples:
+            agg = sites.setdefault(s["site"], {
+                "samples": 0, "h2d_ms": 0.0, "device_compute_ms": 0.0,
+                "d2h_ms": 0.0,
+            })
+            agg["samples"] += 1
+            agg["h2d_ms"] += s["h2d_ms"]
+            agg["device_compute_ms"] += s["device_compute_ms"]
+            agg["d2h_ms"] += s["d2h_ms"]
+        return {
+            "enabled": self.active,
+            "sample_every": self.sample_every,
+            "capacity": self.capacity,
+            "sites": sites,
+            "samples": samples,
+            "jit_costs": self.jit_costs(),
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._tick.clear()

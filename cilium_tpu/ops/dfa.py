@@ -300,6 +300,7 @@ class DeviceDFATable:
     __slots__ = (
         "key", "trans", "accept_lo", "accept_hi", "pair",
         "starts_host", "n_states", "n_fields", "q_pad", "has_pair",
+        "device_bytes",
     )
 
     def __init__(self, key: Tuple, fused: FusedDFA) -> None:
@@ -315,6 +316,14 @@ class DeviceDFATable:
         self.n_fields = fused.n_fields
         self.q_pad = fused.q_pad
         self.has_pair = fused.pair is not None
+        # policyd-prof memory ledger: device-resident bytes of this
+        # table (replicated — every device walks the whole automaton)
+        self.device_bytes = (
+            int(self.trans.nbytes)
+            + int(self.accept_lo.nbytes)
+            + int(self.accept_hi.nbytes)
+            + (int(self.pair.nbytes) if self.pair is not None else 0)
+        )
 
 
 # Interned device tables, keyed by pattern-set key: N endpoints with
@@ -349,6 +358,11 @@ def intern_fused_table(key: Tuple, build: Callable[[], FusedDFA]) -> DeviceDFATa
             _interned.popitem(last=False)
             metrics.l7_dfa_intern_total.inc({"result": "evict"})
         metrics.l7_dfa_tables_interned.set(len(_interned))
+        # policyd-prof memory ledger: total interned DFA residence
+        metrics.device_table_bytes.set(
+            float(sum(t.device_bytes for t in _interned.values())),
+            {"family": "dfa", "placement": "replicated"},
+        )
     return tab
 
 
@@ -362,3 +376,6 @@ def _reset_intern_for_tests() -> None:
     with _intern_lock:
         _interned.clear()
         metrics.l7_dfa_tables_interned.set(0)
+        metrics.device_table_bytes.set(
+            0.0, {"family": "dfa", "placement": "replicated"}
+        )

@@ -87,6 +87,12 @@ class DaemonConfig:
     # this as stalled, classifies it via faults.classify(), and drives
     # the failsafe quarantine + degradation ladder instead of hanging.
     dispatch_stall_ms: float = 0.0
+    # Sampling period of the DeviceProfiling runtime option (policyd-
+    # prof): every Nth completed batch pays the block_until_ready
+    # sandwiches that decompose dispatch RTT into h2d / device_compute
+    # / d2h. 1 = profile every batch (bench --prof); 64 keeps sampled
+    # overhead under the <2% budget on pipeline_e2e_vps.
+    profile_sample_every: int = 64
 
     def validate(self) -> None:
         if self.enforcement_mode not in ("default", "always", "never"):
@@ -110,6 +116,8 @@ class DaemonConfig:
             raise ValueError("verdict-deadline-ms must be >= 0")
         if self.dispatch_stall_ms < 0:
             raise ValueError("dispatch-stall-ms must be >= 0")
+        if self.profile_sample_every < 1:
+            raise ValueError("profile-sample-every must be >= 1")
         if not 2 <= self.mesh_ident_axis <= 64:
             raise ValueError("mesh-ident-axis must be 2-64")
         if self.mesh_process_index < 0:
@@ -242,6 +250,17 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
             "via the fail-closed 155 / FailOpen semantics — never "
             "silently dropped. Off keeps the exact pre-option submit "
             "path",
+        ),
+        OptionSpec(
+            "DeviceProfiling",
+            "Device-time sampling profiler (policyd-prof): every "
+            "profile-sample-every-th batch is timed with "
+            "block_until_ready sandwiches at the enqueue/ready edges, "
+            "splitting dispatch RTT into h2d / device_compute / d2h "
+            "alongside rung occupancy, plus a per-jit-site "
+            "cost_analysis ledger keyed on the stable ladder shapes; "
+            "off keeps the exact pre-option programs and the hot path "
+            "at one attribute read per batch",
         ),
         OptionSpec(
             "Prefilter",

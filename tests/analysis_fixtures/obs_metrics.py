@@ -1,0 +1,39 @@
+"""OBS001 fixture: module-level families vs the sibling
+observe/README.md catalogue (one documented, one drifted, one
+suppressed, plus scoped/computed negatives)."""
+
+
+class _Reg:
+    def counter(self, name, help="", labels=()):
+        return name
+
+    def gauge(self, name, help="", labels=()):
+        return name
+
+    def histogram(self, name, help="", buckets=(), labels=()):
+        return name
+
+
+registry = _Reg()
+
+documented_total = registry.counter(
+    "fixture_documented_total", "NEG: present in observe/README.md"
+)
+undocumented_total = registry.counter(
+    "fixture_undocumented_total", "POS: missing from observe/README.md"
+)
+# justified internal-only family
+# policyd-lint: disable=OBS001
+suppressed_bytes = registry.gauge(
+    "fixture_suppressed_bytes", "NEG: suppressed with justification"
+)
+
+
+def scoped():
+    # NEG: runtime-scoped registration (tests build throwaway
+    # registries) — only module-level families ship on /metrics
+    return registry.histogram("fixture_scoped_seconds", "NEG")
+
+
+_name = "fixture_" + "computed_total"
+computed_total = registry.counter(_name, "NEG: non-literal name")

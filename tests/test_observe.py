@@ -248,6 +248,10 @@ class TestSurfaces:
                            "failsafe": d.pipeline.failsafe_state(),
                            "placement": d.pipeline.placement_state(),
                            "admission": d.pipeline.admission_state(),
+                           # process-global registry: other tests may
+                           # have observed phases, so compare to a
+                           # fresh computation rather than {}
+                           "phase_quantiles": d._phase_quantiles(),
                            "traces": []}
             # healthy baseline: the admission block reports the gate off
             assert out["admission"]["enabled"] is False

@@ -199,6 +199,45 @@ def test_held_context_propagation():
     )
 
 
+# ------------------------------------------------------------ OBS001
+
+
+def test_obs001_fixture_positive_and_negatives():
+    """One drifted family flagged; documented / suppressed / scoped /
+    computed registrations all stay silent."""
+    f = analyze_paths([fixture("obs_metrics.py")])
+    obs = [x for x in f if x.rule == "OBS001"]
+    assert lines_of(f, "OBS001") == [22]
+    assert obs[0].severity == "warning"
+    assert "fixture_undocumented_total" in obs[0].message
+    for name in ("fixture_documented_total", "fixture_suppressed_bytes",
+                 "fixture_scoped_seconds", "fixture_computed_total"):
+        assert not any(name in x.message for x in obs)
+
+
+def test_obs001_missing_readme_flags_everything(tmp_path):
+    """A metrics module with NO sibling observe/README.md flags every
+    module-level registration (the catalogue must exist to drift)."""
+    mod = tmp_path / "naked_metrics.py"
+    mod.write_text(
+        "registry = object()\n"
+        "a = registry.counter('orphan_a_total', 'h')\n"  # type: ignore
+        "b = registry.gauge('orphan_b', 'h')\n"
+    )
+    f = analyze_paths([str(mod)])
+    assert lines_of(f, "OBS001") == [2, 3]
+    assert "no observe/README.md" in f[0].message
+
+
+def test_obs001_package_metrics_stay_documented():
+    """The real catalogue gate: every family registered in metrics.py
+    is documented in observe/README.md (beyond-baseline drift is also
+    caught by test_package_clean_against_baseline, but this one names
+    the contract)."""
+    f = analyze_paths([os.path.join(PKG, "metrics.py")])
+    assert lines_of(f, "OBS001") == []
+
+
 # ------------------------------------------------- suppressions + baseline
 
 
