@@ -1150,6 +1150,9 @@ def _bench_chaos(repo, reg, idents, nrng: np.random.Generator, attached):
     # overload: oversubscribed storm with queue_full + stall injected
     overload = _chaos_overload(eng, cache, idents, nrng, attached)
 
+    # federation: partition + lease expiry during two-node allocation
+    federation = _chaos_federation(attached)
+
     snap = _faults.hub.snapshot()
     _faults.hub.reset()
     sites = sorted({k.split(":")[0] for k in snap["injected"]})
@@ -1176,6 +1179,7 @@ def _bench_chaos(repo, reg, idents, nrng: np.random.Generator, attached):
         "reattached": reattached,
         "failsafe": pipe.failsafe_state(),
         "overload": overload,
+        "federation": federation,
     }
 
 
@@ -1296,6 +1300,77 @@ def _chaos_overload(eng, cache, idents, nrng, attached):
     }
 
 
+def _chaos_federation(attached):
+    """Federation sub-round of ``--chaos`` (policyd-fed): a kvstore
+    partition on one node's CAS path plus a third node's lease expiry,
+    both landing during concurrent two-node identity allocation. The
+    reserve/confirm allocator must converge to identical injective
+    id maps (zero double-assigns), ride ``utils/backoff`` through the
+    partition, and ``run_gc`` must reap only the dead node's ids."""
+    import threading
+
+    from cilium_tpu.federation import ClusterIdentityAllocator
+    from cilium_tpu.kvstore.backend import InMemoryBackend, InMemoryStore
+    from cilium_tpu.kvstore.filestore import FlakyBackend
+    from cilium_tpu.kvstore.paths import IDENTITIES_PATH
+    from cilium_tpu.utils.backoff import Backoff
+
+    attached.stage("chaos-federation")
+    store = InMemoryStore()
+
+    def bo():
+        return Backoff(
+            min_s=0.001, max_s=0.02, full_jitter=True, max_elapsed_s=30.0
+        )
+
+    def node(backend, name):
+        return ClusterIdentityAllocator(
+            backend, IDENTITIES_PATH, node_name=name,
+            min_id=256, max_id=8192, backoff_factory=bo,
+        )
+
+    # node c holds identities, then dies mid-storm (lease expiry)
+    c = node(InMemoryBackend(store, "c"), "c")
+    c_ids = {c.allocate(f"k8s:app=ephemeral-{i}")[0] for i in range(8)}
+    a = node(InMemoryBackend(store, "a"), "a")
+    flaky = FlakyBackend(InMemoryBackend(store, "b"))
+    b = node(flaky, "b")
+
+    keys = [f"k8s:app=chaos-fed-{i}" for i in range(40)]
+    got = {"a": {}, "b": {}}
+
+    def worker(alloc, tag):
+        for k in keys:
+            got[tag][k] = alloc.allocate(k)[0]
+
+    flaky.fail(True)  # partition lands BEFORE the storm starts
+    threads = [
+        threading.Thread(target=worker, args=(a, "a")),
+        threading.Thread(target=worker, args=(b, "b")),
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.005)
+    store.revoke_lease(c.backend.lease_id)  # node c dies mid-storm
+    time.sleep(0.005)
+    flaky.fail(False)  # partition heals; b's backoff retries land
+    for t in threads:
+        t.join(60.0)
+
+    reaped = a.run_gc()  # release-on-lease-expiry: c's masters go
+    ids = sorted(got["a"].values())
+    return {
+        "keys": len(keys),
+        "identical_maps": got["a"] == got["b"],
+        "no_double_assign": len(set(ids)) == len(keys),
+        "dead_node_disjoint": not (set(ids) & c_ids),
+        "reaped_ids": len(reaped),
+        "reap_sound": set(reaped) == c_ids,
+        "partition_retries": b.state()["allocations"].get("retry", 0),
+        "kv_op_errors": flaky.op_errors,
+    }
+
+
 def _bench_overload(repo, reg, idents, nrng: np.random.Generator, attached):
     """``--overload``: policyd-overload round → result dict for the
     one-line JSON. A deny-heavy DoS mix (90% unknown world sources on
@@ -1388,6 +1463,127 @@ def _bench_overload(repo, reg, idents, nrng: np.random.Generator, attached):
         "admission": pipe.admission_state(),
     }
 
+
+
+def _bench_cluster(attached):
+    """``--cluster``: policyd-fed round → result dict for the one-line
+    JSON. Three in-process federation nodes share ONE FileBackend
+    SQLite store (the durable kvstore path, not the in-memory test
+    double) and the round measures the three allocation regimes plus
+    the epoch barrier:
+
+    - contended: all nodes race ``allocate`` over one overlapping key
+      set — reserve/confirm CAS both ways, injectivity asserted;
+    - cached: re-allocation of held keys (the local-refcount fast
+      path every endpoint-create after the first rides);
+    - epoch convergence: wall time from all nodes publishing a new
+      policy epoch to ``wait_cluster_epoch`` observing the fleet
+      minimum reach it."""
+    import tempfile
+    import threading
+
+    from cilium_tpu.federation import ClusterIdentityAllocator, EpochExchange
+    from cilium_tpu.kvstore.filestore import FileBackend
+    from cilium_tpu.kvstore.paths import IDENTITIES_PATH
+    from cilium_tpu.utils.backoff import Backoff
+
+    attached.stage("cluster-build")
+    tmp = tempfile.mkdtemp(prefix="bench-cluster-")
+    path = os.path.join(tmp, "kvstore.sqlite")
+    names = ["node-0", "node-1", "node-2"]
+
+    def bo():
+        return Backoff(
+            min_s=0.001, max_s=0.05, full_jitter=True, max_elapsed_s=30.0
+        )
+
+    backends = [FileBackend(path, n, lease_ttl=60.0) for n in names]
+    allocs = [
+        ClusterIdentityAllocator(
+            be, IDENTITIES_PATH, node_name=n,
+            min_id=256, max_id=1 << 16, backoff_factory=bo,
+        )
+        for be, n in zip(backends, names)
+    ]
+
+    n_keys = 48
+    keys = [f"k8s:app=bench-{i}" for i in range(n_keys)]
+    got = [dict() for _ in allocs]
+
+    def worker(i):
+        for k in keys:
+            got[i][k] = allocs[i].allocate(k)[0]
+
+    attached.stage("cluster-contended")
+    t0 = time.time()
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(allocs))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120.0)
+    contended_s = time.time() - t0
+    assert got[0] == got[1] == got[2], "federated id maps diverged"
+    assert len(set(got[0].values())) == n_keys, "double-assigned ids"
+
+    attached.stage("cluster-cached")
+    t0 = time.time()
+    for _ in range(10):
+        for k in keys:
+            allocs[0].allocate(k)
+    cached_s = time.time() - t0
+
+    attached.stage("cluster-epoch")
+    epochs = [{"v": 0} for _ in names]
+    exchanges = [
+        EpochExchange(
+            be, n, cluster="bench",
+            epoch_source=(lambda e=e: e["v"]),
+        )
+        for be, n, e in zip(backends, names, epochs)
+    ]
+
+    def pump_all():
+        for x in exchanges:
+            x.publish()
+            x.pump()
+
+    # warm the view so the barrier measures propagation, not join
+    for _ in range(4):
+        pump_all()
+    for e in epochs:
+        e["v"] = 1
+    t0 = time.time()
+    converged = exchanges[0].wait_cluster_epoch(
+        1, timeout=30.0, min_nodes=len(names), pump=pump_all
+    )
+    epoch_converge_s = time.time() - t0
+
+    counts = [a.state()["allocations"] for a in allocs]
+    for x in exchanges:
+        x.close()
+    for a in allocs:
+        a.close()
+    for be in backends:
+        be.close()
+
+    contended_ops = n_keys * len(allocs)
+    return {
+        "nodes": len(names),
+        "keys": n_keys,
+        "contended_alloc_ops_s": round(contended_ops / contended_s, 1),
+        "cached_alloc_ops_s": round(10 * n_keys / cached_s, 1),
+        "epoch_converged": bool(converged),
+        "epoch_converge_ms": round(epoch_converge_s * 1e3, 2),
+        "alloc_outcomes": {
+            "new": sum(c.get("new", 0) for c in counts),
+            "adopted": sum(c.get("adopted", 0) for c in counts),
+            "cached": sum(c.get("cached", 0) for c in counts),
+            "retry": sum(c.get("retry", 0) for c in counts),
+        },
+    }
 
 
 def _bench_mesh(repo, reg, idents, nrng: np.random.Generator, attached):
@@ -2440,6 +2636,23 @@ def main() -> None:
             "metric": "L7 fused DFA dispatch rate",
             "value": out["l7_dfa_rps"],
             "unit": "rps",
+            **out,
+            "backend": backend,
+            "host_cpus": os.cpu_count(),
+        }))
+        return
+
+    if "--cluster" in sys.argv[1:]:
+        # policyd-fed round: federated identity allocation + epoch
+        # barrier across 3 in-process nodes on one filestore — the
+        # round driver gates on epoch_converged and the injectivity
+        # asserts inside. No world build needed.
+        out = _bench_cluster(attached)
+        attached.set()
+        print(json.dumps({
+            "metric": "federated contended identity allocation rate",
+            "value": out["contended_alloc_ops_s"],
+            "unit": "ops/s",
             **out,
             "backend": backend,
             "host_cpus": os.cpu_count(),

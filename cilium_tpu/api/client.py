@@ -195,3 +195,6 @@ class APIClient:
 
     def node_list(self):
         return self._request("GET", "/node")
+
+    def cluster_status(self):
+        return self._request("GET", "/cluster")

@@ -144,6 +144,8 @@ class _Handler(UnixHandler):
             self._json(200, d.ct_flush())
         elif path == "/node" and method == "GET":
             self._json(200, d.node_list())
+        elif path == "/cluster" and method == "GET":
+            self._json(200, d.cluster_status())
         elif (m := re.fullmatch(r"/map/(\w+)", path)) and method == "GET":
             self._json(200, d.map_dump(m.group(1)))
         elif path == "/ipam" and method == "POST":

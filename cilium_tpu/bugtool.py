@@ -57,6 +57,9 @@ def collect_debuginfo(daemon) -> Dict:
             "failures": daemon.fqdn.failures,
         },
         "health": daemon.health.report(),
+        # policyd-fed → cluster.json: federation membership, per-node
+        # published policy epochs, and identity-allocator accounting
+        "cluster": daemon.cluster_status(),
         "accesslog": [r.to_dict() for r in daemon.proxy.accesslog.recent(200)],
         # policyd-trace ring (metrics.prom in the archive carries the
         # matching /metrics snapshot via write_archive_from)

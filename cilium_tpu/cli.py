@@ -192,6 +192,9 @@ class _Surface:
     def _d_node_list(self):
         return self._daemon.node_list()
 
+    def _d_cluster_status(self):
+        return self._daemon.cluster_status()
+
 
 def _parse_frontend(text: str) -> dict:
     """'10.96.0.10:80/TCP' → frontend dict (cilium service update
@@ -471,6 +474,12 @@ def build_parser() -> argparse.ArgumentParser:
         dest="sub", required=True
     )
     nd.add_parser("list", help="known cluster nodes")
+    # policyd-fed: the federated policy plane (GET /cluster)
+    cf = sub.add_parser(
+        "cluster", help="federated policy plane (policyd-fed)"
+    ).add_subparsers(dest="sub", required=True)
+    cf.add_parser("nodes", help="fleet nodes + published policy epochs")
+    cf.add_parser("status", help="full federation membership view")
     mp2 = sub.add_parser("map", help="open-map inventory").add_subparsers(
         dest="sub", required=True
     )
@@ -1280,6 +1289,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             _print(s.prefilter_patch(args.cidrs))
     elif args.cmd == "node":
         _print(s.node_list())
+    elif args.cmd == "cluster":
+        st = s.cluster_status()
+        _print(st.get("nodes", []) if args.sub == "nodes" else st)
     elif args.cmd == "map":
         if args.sub == "list":
             _print(s.map_list())

@@ -218,6 +218,11 @@ class Daemon:
         # so the whole cluster numbers identities identically
         self.allocate_identity = self.registry.allocate
         self.release_identity = self.registry.release
+        # policyd-fed: a federation membership (federation/member.py)
+        # is attached after the kvstore join; the ClusterFederation
+        # runtime option decides whether the identity source routes
+        # through it
+        self._federation = None
         # node connectivity prober (cilium-health launch,
         # daemon/main.go:927-945); probes the node registry when one
         # is attached, reports empty standalone
@@ -792,6 +797,7 @@ class Daemon:
             "FlowAttribution", "DispatchAutoTune", "FailOpen",
             "FaultInjection", "EpochSwap", "L7DeviceBatch",
             "AdmissionControl", "Prefilter", "DeviceProfiling",
+            "ClusterFederation",
         }
     )
 
@@ -872,6 +878,19 @@ class Daemon:
             from .datapath import l7_pipeline as _l7rt
 
             _l7rt.set_profiler(self.pipeline.profiler)
+        elif name == "ClusterFederation":
+            # policyd-fed: swap the identity source onto the attached
+            # federation membership (cluster-wide reserve/confirm CAS
+            # numbering); off restores the local registry path. No
+            # recompile either way — identity NUMBERING is the only
+            # difference, so the OFF path's programs stay bit-identical
+            fed = self._federation
+            if value and fed is not None:
+                self.allocate_identity = fed.allocate
+                self.release_identity = fed.release
+            else:
+                self.allocate_identity = self.registry.allocate
+                self.release_identity = self.registry.release
         elif name == "FaultInjection":
             # policyd-failsafe: arm/disarm the injection hub; off keeps
             # rules queued so a re-enable resumes a chaos scenario
@@ -907,6 +926,17 @@ class Daemon:
                 raise ValueError(
                     "Conntrack cannot be enabled: daemon started "
                     "without a conntrack table"
+                )
+            if (
+                name == "ClusterFederation"
+                and (value if isinstance(value, bool) else _parse_bool(value))
+                and self._federation is None
+            ):
+                # enabling with no membership would silently keep the
+                # registry path — same never-lie rule as Conntrack
+                raise ValueError(
+                    "ClusterFederation cannot be enabled: no federation "
+                    "membership attached (daemon.attach_federation)"
                 )
             out[name] = value if isinstance(value, bool) else _parse_bool(value)
         return out
@@ -1080,6 +1110,40 @@ class Daemon:
                 registry, route_mtu=self.mtu.route_mtu
             )
 
+    # -- federation (policyd-fed) ----------------------------------------
+    def attach_federation(self, member) -> None:
+        """Attach a federation membership (federation/member.py) after
+        the kvstore join; the ClusterFederation runtime option decides
+        whether the identity source actually routes through it (and
+        re-applies immediately if it was already on)."""
+        self._federation = member
+        if self.options.get("ClusterFederation"):
+            self.allocate_identity = member.allocate
+            self.release_identity = member.release
+
+    def detach_federation(self) -> None:
+        """Drop the membership and restore the local identity source
+        (the member itself is closed by its owner)."""
+        if self.options.get("ClusterFederation"):
+            self.options.set("ClusterFederation", False)
+        self._federation = None
+        self.allocate_identity = self.registry.allocate
+        self.release_identity = self.registry.release
+
+    def cluster_status(self) -> Dict:
+        """GET /cluster (policyd-fed): federation membership view —
+        fleet nodes with their published policy epochs, the cluster
+        convergence floor, and identity-allocator accounting."""
+        out: Dict = {
+            "enabled": self.options.get("ClusterFederation"),
+            "attached": self._federation is not None,
+        }
+        if self._federation is not None:
+            out.update(self._federation.status())
+        else:
+            out.update({"node": None, "node_count": 0, "nodes": []})
+        return out
+
     def health_report(self) -> Dict:
         """GET /health (the cilium-health status surface)."""
         return self.health.report()
@@ -1232,6 +1296,18 @@ class Daemon:
             # policyd-overload: /healthz answers "is the gate shedding"
             # (queue depth, shed ratio, last stall) without a second RPC
             "admission": self.pipeline.admission_state(),
+            # policyd-fed: is this node federated, and is its policy
+            # epoch converged with the fleet (GET /cluster for the
+            # full per-node view)
+            "cluster": {
+                "enabled": self.options.get("ClusterFederation"),
+                "attached": self._federation is not None,
+                "epoch_lag": (
+                    self._federation.epochs.epoch_lag()
+                    if self._federation is not None
+                    else 0
+                ),
+            },
         }
 
     def _peek_features(self):

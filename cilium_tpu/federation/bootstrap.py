@@ -1,0 +1,111 @@
+"""Multi-process mesh bootstrap: jax.distributed keyed off the daemon's
+``mesh_process_index``.
+
+PR 9 made placement an explicit MeshPlan and plumbed
+``PlacementConfig.process_index`` through option.py/daemon.py, but past
+one host the field was dead code. This module is the missing bring-up:
+``mesh_bootstrap`` runs ``jax.distributed.initialize`` so ``jax.
+devices()`` spans every participating host, and ``placement_config``
+builds the PlacementConfig whose ``process_index`` filter
+(placement.eligible_devices) then selects exactly this host's local
+devices out of the global complement.
+
+CPU dryrun recipe (what tests/test_mesh_bootstrap.py subprocesses):
+each process sets ``JAX_PLATFORMS=cpu`` and ``XLA_FLAGS=
+--xla_force_host_platform_device_count=K``, then calls
+``mesh_bootstrap("127.0.0.1:<port>", num_processes=N,
+process_index=i)``. Every process sees N*K global devices, K local
+ones, and per-process ``resolve_plan`` yields the same generation and
+axis layout — the MeshPlan spans hosts.
+
+Initialization is process-global in jax, hence idempotent here: a
+second call returns the first call's summary (coordinator mismatch
+raises — silently reusing a different fleet would be worse).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ..option import get_config
+
+_lock = threading.Lock()
+_summary: Optional[Dict] = None
+
+
+def mesh_bootstrap(
+    coordinator_address: str,
+    num_processes: int,
+    process_index: Optional[int] = None,
+) -> Dict:
+    """Join (or found) the multi-process jax mesh; returns a summary of
+    the resulting device complement. ``process_index`` defaults to the
+    daemon config's ``mesh_process_index``."""
+    if process_index is None:
+        process_index = get_config().mesh_process_index
+    global _summary
+    with _lock:
+        if _summary is not None:
+            if _summary["coordinator"] != coordinator_address:
+                raise RuntimeError(
+                    "mesh already initialized against "
+                    f"{_summary['coordinator']!r}, refusing "
+                    f"{coordinator_address!r}"
+                )
+            return dict(_summary)
+        try:
+            import jax
+        except ImportError as e:  # container without the toolchain
+            raise RuntimeError(f"jax unavailable for mesh bootstrap: {e}")
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_index,
+            )
+        except (RuntimeError, ValueError) as e:
+            raise RuntimeError(
+                f"jax.distributed.initialize failed for process "
+                f"{process_index}/{num_processes} at "
+                f"{coordinator_address}: {e}"
+            )
+        _summary = {
+            "initialized": True,
+            "coordinator": coordinator_address,
+            "num_processes": int(num_processes),
+            "process_index": int(jax.process_index()),
+            "process_count": int(jax.process_count()),
+            "global_devices": len(jax.devices()),
+            "local_devices": len(jax.local_devices()),
+        }
+        return dict(_summary)
+
+
+def bootstrap_state() -> Optional[Dict]:
+    """The last successful bootstrap summary (None standalone)."""
+    with _lock:
+        return dict(_summary) if _summary is not None else None
+
+
+def placement_config(process_index: Optional[int] = None):
+    """The PlacementConfig for this host's slice of the fleet mesh —
+    same construction the daemon ctor uses, with ``process_index``
+    resolvable from the live bootstrap instead of static config."""
+    from ..datapath.placement import PlacementConfig
+
+    cfg = get_config()
+    if process_index is None:
+        state = bootstrap_state()
+        process_index = (
+            state["process_index"] if state else cfg.mesh_process_index
+        )
+    return PlacementConfig(
+        device_ids=(
+            tuple(int(x) for x in cfg.mesh_devices.split(","))
+            if cfg.mesh_devices
+            else None
+        ),
+        ident_axis=cfg.mesh_ident_axis,
+        process_index=process_index,
+    )

@@ -9,6 +9,9 @@ store.go NodeStorePrefix, pkg/ipcache/kvstore.go IPIdentitiesPath).
 IDENTITIES_PATH = "cilium/state/identities/v1"
 NODES_PATH = "cilium/state/nodes/v1"
 IP_IDENTITIES_PATH = "cilium/state/ip/v1"
+# policyd-fed: per-node descriptor + policy_epoch records (the
+# federation epoch exchange; federation/epochs.py)
+CLUSTER_EPOCHS_PATH = "cilium/state/epochs/v1"
 
 
 def key_to_label_strings(key: str):

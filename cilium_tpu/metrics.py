@@ -447,3 +447,25 @@ device_transfer_bytes_total = registry.counter(
     "cilium_tpu_device_transfers_total; logical bytes, not multiplied "
     "by mesh device count, since shard slices sum to the full array)",
 )
+
+# -- policyd-fed (cluster federation) families -----------------------------
+cluster_nodes = registry.gauge(
+    "cilium_tpu_cluster_nodes",
+    "Nodes currently publishing in the federated policy plane (the "
+    "epoch-exchange view; records are lease-bound, so a dead node "
+    "ages out with its kvstore lease)",
+)
+cluster_identity_allocations_total = registry.counter(
+    "cilium_tpu_cluster_identity_allocations_total",
+    "Cluster identity-allocator outcomes (label result: new = won the "
+    "reserve/confirm CAS, adopted = joined a peer's allocation, "
+    "cached = local refcount hit, retry = CAS race or kvstore "
+    "partition re-attempt, error = backoff budget exhausted or id "
+    "space full)",
+)
+cluster_epoch_lag = registry.gauge(
+    "cilium_tpu_cluster_epoch_lag",
+    "Local policy_epoch minus the cluster convergence floor (the min "
+    "over every published node); 0 means this node's last full "
+    "rebuild is enforced fleet-wide as far as the exchange can prove",
+)

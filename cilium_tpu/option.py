@@ -263,6 +263,16 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
             "at one attribute read per batch",
         ),
         OptionSpec(
+            "ClusterFederation",
+            "Federated identity plane (policyd-fed): identity "
+            "allocation routes through the attached federation "
+            "membership's kvstore reserve/confirm CAS allocator so N "
+            "daemon nodes converge on one identity numbering and "
+            "exchange policy epochs; off restores the local registry "
+            "allocator — numbering is the only difference, compiled "
+            "device programs are bit-identical either way",
+        ),
+        OptionSpec(
             "Prefilter",
             "Device prefilter shed stage (policyd-overload): a coarse "
             "[identity, proto/port-class] drop table compiled from "

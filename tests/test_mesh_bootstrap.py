@@ -1,0 +1,108 @@
+"""policyd-fed satellite: 2-process jax.distributed CPU dryrun.
+
+Two real OS processes bootstrap one jax mesh over a loopback
+coordinator, then each resolves its own MeshPlan — the acceptance
+check is that both processes agree on the plan generation and axis
+layout while holding disjoint process indices. Runs entirely on CPU
+via ``--xla_force_host_platform_device_count`` (the same recipe the
+federation README documents for fleet bring-up).
+
+The subprocesses must NOT inherit this pytest process's jax env
+(conftest pins an 8-device single-process mesh), so they get a
+minimal scrubbed environment.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from cilium_tpu.federation import bootstrap as _bootstrap
+from cilium_tpu.federation import mesh_bootstrap, placement_config
+
+_CHILD = r"""
+import json, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cilium_tpu.federation import mesh_bootstrap, placement_config
+from cilium_tpu.datapath.placement import resolve_plan
+
+summary = mesh_bootstrap({coord!r}, 2, {pid})
+plan = resolve_plan(placement_config(), sharding=True)
+print(json.dumps({{
+    "summary": summary,
+    "generation": plan.generation,
+    "axes": {{k: int(v) for k, v in plan.axes.items()}},
+    "local_devices": len(plan.device_ids),
+}}))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_agrees_on_plan():
+    import pathlib
+
+    import cilium_tpu
+    repo = str(pathlib.Path(cilium_tpu.__file__).parents[1])
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {
+        "PATH": "/usr/bin:/bin",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c",
+             _CHILD.format(repo=repo, coord=coord, pid=pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=180)
+        assert p.returncode == 0, err.decode()[-2000:]
+        outs.append(json.loads(out.decode().strip().splitlines()[-1]))
+
+    for pid, o in enumerate(outs):
+        s = o["summary"]
+        assert s["initialized"] and s["coordinator"] == coord
+        assert s["process_index"] == pid
+        assert s["process_count"] == 2
+        assert s["global_devices"] == 4 and s["local_devices"] == 2
+        assert o["local_devices"] == 2  # plan filtered to this host
+    # the federation contract: one MeshPlan across the fleet
+    assert outs[0]["generation"] == outs[1]["generation"]
+    assert outs[0]["axes"] == outs[1]["axes"]
+
+
+class TestPlacementConfig:
+    def test_defaults_to_config_process_index(self):
+        pc = placement_config()
+        assert pc.process_index == 0  # cfg.mesh_process_index default
+
+    def test_explicit_index_wins(self):
+        assert placement_config(process_index=3).process_index == 3
+
+    def test_bootstrap_state_standalone(self):
+        # this pytest process never runs mesh_bootstrap itself
+        state = _bootstrap.bootstrap_state()
+        assert state is None or state["initialized"]
+
+    def test_coordinator_mismatch_raises_once_initialized(self):
+        with _bootstrap._lock:
+            prior = _bootstrap._summary
+        if prior is None:
+            pytest.skip("mesh not initialized in-process")
+        with pytest.raises(RuntimeError, match="already initialized"):
+            mesh_bootstrap("127.0.0.1:1", 2, 0)
