@@ -1153,6 +1153,10 @@ def _bench_chaos(repo, reg, idents, nrng: np.random.Generator, attached):
     # federation: partition + lease expiry during two-node allocation
     federation = _chaos_federation(attached)
 
+    # survive: kill -9 restart, raced rule change, SIGTERM drain, and a
+    # torn CT write, each in a real subprocess daemon (policyd-survive)
+    survive = _chaos_survive(attached)
+
     snap = _faults.hub.snapshot()
     _faults.hub.reset()
     sites = sorted({k.split(":")[0] for k in snap["injected"]})
@@ -1180,6 +1184,11 @@ def _bench_chaos(repo, reg, idents, nrng: np.random.Generator, attached):
         "failsafe": pipe.failsafe_state(),
         "overload": overload,
         "federation": federation,
+        # top-level so _diff_records' _ms suffix rule tracks them
+        # (restart_downtime_ms down, drain_ms down)
+        "restart_downtime_ms": survive["restart_downtime_ms"],
+        "drain_ms": survive["drain_ms"],
+        "survive": survive,
     }
 
 
@@ -1368,6 +1377,273 @@ def _chaos_federation(attached):
         "reap_sound": set(reaped) == c_ids,
         "partition_retries": b.state()["allocations"].get("retry", 0),
         "kv_op_errors": flaky.op_errors,
+    }
+
+
+# Subprocess driver for the survive sub-round: one script, four
+# phases, so each leg runs (and dies) in a REAL process the way a node
+# agent does. ``serve`` is killed -9 by the parent mid-storm; ``restore``
+# measures state-load -> first verdict; ``mutate`` models a crash landing
+# between a rule change and the next CT sync; ``drain`` exits 0 through
+# the SIGTERM -> drain() path.
+_SURVIVE_DRIVER_SRC = r'''
+import json, os, signal, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+
+phase, state_dir = sys.argv[1], sys.argv[2]
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.ops.lpm import ip_strings_to_u32
+
+ALLOW = json.dumps([{
+    "endpointSelector": {"matchLabels": {"app": "web"}},
+    "ingress": [{"fromEndpoints": [{"matchLabels": {"app": "client"}}]}],
+}])
+EXTRA = json.dumps([{
+    "endpointSelector": {"matchLabels": {"app": "web"}},
+    "ingress": [{"fromEndpoints": [{"matchLabels": {"app": "extra"}}]}],
+}])
+N = 128
+
+
+def seed(dm):
+    dm.policy_add(ALLOW)
+    dm.endpoint_add(1, ["unspec:app=web"], ipv4="10.0.0.1")
+    dm.endpoint_add(2, ["unspec:app=client"], ipv4="10.0.0.2")
+
+
+def storm(dm, i):
+    # distinct sports per round -> fresh CT entries every round; sport
+    # 10000 (round 0, lane 0) is the established flow restore replays
+    peers = ip_strings_to_u32(["10.0.0.2"] * N)
+    sports = (10000 + (i * N + np.arange(N)) % 40000).astype(np.int32)
+    v, _ = dm.pipeline.process(
+        peers, np.zeros(N, np.int32), np.full(N, 80, np.int32),
+        np.full(N, 6, np.int32), sports=sports)
+    return v
+
+
+if phase == "serve":
+    dm = Daemon(state_dir=state_dir)
+    seed(dm)
+    i = 0
+    while True:
+        storm(dm, i)
+        i += 1
+        dm._save_ct_snapshot(force=True)
+        print("SYNC %d %d" % (i, len(dm.conntrack)), flush=True)
+        time.sleep(0.02)
+
+elif phase == "restore":
+    t0 = time.perf_counter()
+    dm = Daemon(state_dir=state_dir)
+    info = dict(dm.ct_restore_info() or {})
+    peers = ip_strings_to_u32(["10.0.0.2"])
+    v, _ = dm.pipeline.process(
+        peers, np.zeros(1, np.int32), np.array([80], np.int32),
+        np.full(1, 6, np.int32), sports=np.array([10000], np.int32))
+    downtime_ms = (time.perf_counter() - t0) * 1000.0
+    # leave a coherent pair on disk for the next leg: CT + compiled
+    # written back-to-back while quiescent (same tail order drain uses)
+    dm._save_compiled_snapshot(force=True)
+    dm._save_ct_snapshot(force=True)
+    from cilium_tpu import metrics as _m
+    print("RESULT " + json.dumps({
+        "downtime_ms": downtime_ms,
+        "downtime_gauge_ms": _m.restart_downtime_seconds.get() * 1000.0,
+        "kept": int(info.get("kept", -1)),
+        "expired": int(info.get("expired", -1)),
+        "flushed": int(info.get("flushed", -1)),
+        "basis_match": bool(info.get("basis_match", False)),
+        "verdict_forward": bool(int(v[0]) == 1),
+        "ct_len": len(dm.conntrack),
+    }), flush=True)
+
+elif phase == "mutate":
+    dm = Daemon(state_dir=state_dir)
+    # crash window: rule change lands, compiled.npz moves, the process
+    # dies before the next CT sync -> ct.npz keeps the OLD basis stamp
+    dm.controllers.remove_controller("ct-snapshot-sync")
+    dm._save_ct_snapshot = lambda *a, **k: None
+    dm.policy_add(EXTRA)
+    # the post-restore recompile is async and the saver skips sentinel
+    # (revision < 0) state — wait for the real compile to land so
+    # compiled.npz actually moves
+    dm.engine.refresh()
+    dm.engine.wait_refreshed(60)
+    dm.engine.refresh()
+    dm._save_compiled_snapshot(force=True)
+    print("MUTATED", flush=True)
+    os._exit(0)
+
+elif phase == "drain":
+    def _raise(signum, frame):
+        raise KeyboardInterrupt
+    signal.signal(signal.SIGTERM, _raise)
+    dm = Daemon(state_dir=state_dir)
+    seed(dm)
+    print("READY", flush=True)
+    i = 0
+    try:
+        while True:
+            storm(dm, i)
+            i += 1
+            print("BATCH %d" % i, flush=True)
+    except KeyboardInterrupt:
+        rep = dm.drain(deadline_s=5.0)
+        rep = {k: v for k, v in rep.items()
+               if isinstance(v, (int, float, bool, str))}
+        print("DRAIN " + json.dumps(rep), flush=True)
+        sys.exit(0)
+'''
+
+
+def _drv_spawn(phase, state_dir):
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, "-u", "-c", _SURVIVE_DRIVER_SRC, phase, state_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+
+
+def _drv_expect(proc, prefix, timeout_s=300.0):
+    """Read driver stdout until a ``prefix``-marked line (daemon log
+    noise is interleaved on the same pipe and skipped)."""
+    end = time.time() + timeout_s
+    tail = []
+    while time.time() < end:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    "survive driver exited rc=%s waiting for %r:\n%s"
+                    % (proc.returncode, prefix, "".join(tail[-20:]))
+                )
+            time.sleep(0.05)
+            continue
+        tail.append(line)
+        if line.startswith(prefix):
+            return line.strip()
+    proc.kill()
+    raise RuntimeError("timeout waiting for %r:\n%s"
+                       % (prefix, "".join(tail[-20:])))
+
+
+def _chaos_survive(attached):
+    """Survive sub-round of ``--chaos`` (policyd-survive), four legs:
+
+    - kill -9 mid-storm, restart: ``restart_downtime_ms`` is
+      state-load -> first verdict in the restarted process, with the
+      established flows KEPT (basis matches) and still forwarding;
+    - raced rule change: compiled.npz moves after the last CT sync ->
+      the restore classifies the stale ct.npz and cold-flushes;
+    - SIGTERM drain: in-flight storm completes, state persists,
+      ``verdicts_lost == 0``, exit code 0;
+    - torn write: SITE_STATE_WRITE truncates ct.npz mid-write -> the
+      next boot classifies, cold-starts, never crashes."""
+    import signal as _signal
+    import tempfile
+
+    from cilium_tpu import faults as _faults
+    from cilium_tpu.daemon import Daemon as _Daemon
+
+    # --- leg 1: kill -9 -> restart with established flows kept
+    attached.stage("chaos-restart")
+    sdir = tempfile.mkdtemp(prefix="bench-survive-")
+    serve = _drv_spawn("serve", sdir)
+    line = _drv_expect(serve, "SYNC ")
+    while int(line.split()[2]) < 1:
+        line = _drv_expect(serve, "SYNC ")
+    ct_at_kill = int(line.split()[2])
+    serve.kill()  # SIGKILL: no drain, no goodbye
+    serve.wait(timeout=30)
+    rest = _drv_spawn("restore", sdir)
+    keep = json.loads(_drv_expect(rest, "RESULT ")[len("RESULT "):])
+    rest.wait(timeout=60)
+
+    # --- leg 2: raced rule change voids the stale CT snapshot
+    attached.stage("chaos-restart-raced")
+    mut = _drv_spawn("mutate", sdir)
+    _drv_expect(mut, "MUTATED")
+    mut.wait(timeout=60)
+    rest2 = _drv_spawn("restore", sdir)
+    raced = json.loads(_drv_expect(rest2, "RESULT ")[len("RESULT "):])
+    rest2.wait(timeout=60)
+
+    # --- leg 3: SIGTERM -> graceful drain -> exit 0
+    attached.stage("chaos-drain")
+    ddir = tempfile.mkdtemp(prefix="bench-drain-")
+    drainp = _drv_spawn("drain", ddir)
+    _drv_expect(drainp, "READY")
+    _drv_expect(drainp, "BATCH ")  # storm is in flight
+    drainp.send_signal(_signal.SIGTERM)
+    drain_rep = json.loads(_drv_expect(drainp, "DRAIN ")[len("DRAIN "):])
+    drain_rc = drainp.wait(timeout=60)
+
+    # --- leg 4: torn CT write -> next boot cold-starts, no crash
+    attached.stage("chaos-torn-write")
+    tdir = tempfile.mkdtemp(prefix="bench-torn-")
+    dmt = _Daemon(state_dir=tdir)
+    dmt.controllers.remove_all()  # no background resave heals the tear
+    dmt.policy_add(
+        '[{"endpointSelector": {"matchLabels": {"app": "web"}}, '
+        '"ingress": [{"fromEndpoints": [{"matchLabels": '
+        '{"app": "client"}}]}]}]'
+    )
+    dmt.endpoint_add(1, ["unspec:app=web"], ipv4="10.0.0.1")
+    dmt.endpoint_add(2, ["unspec:app=client"], ipv4="10.0.0.2")
+    from cilium_tpu.ops.lpm import ip_strings_to_u32 as _ip2u32
+
+    dmt.pipeline.process(
+        _ip2u32(["10.0.0.2"]), np.zeros(1, np.int32),
+        np.array([80], np.int32), np.full(1, 6, np.int32),
+        sports=np.array([4242], np.int32),
+    )
+    dmt._save_compiled_snapshot(force=True)
+    _faults.hub.fail(_faults.SITE_STATE_WRITE, _faults.KIND_TRANSIENT,
+                     times=1)
+    dmt._save_ct_snapshot(force=True)  # tears ct.npz, logged not raised
+    torn_bytes = os.path.getsize(os.path.join(tdir, "ct.npz"))
+    dmtr = _Daemon(state_dir=tdir)  # must classify + boot cold
+    torn_info = dict(dmtr.ct_restore_info() or {})
+    for d in (dmt, dmtr):
+        d.controllers.remove_all()
+        d.health.stop()
+        d.fqdn.stop()
+        d.endpoint_manager.shutdown()
+
+    return {
+        # headline numbers (hoisted top-level by _bench_chaos so --diff
+        # applies the _ms lower-is-better direction)
+        "restart_downtime_ms": round(keep["downtime_ms"], 3),
+        "drain_ms": round(drain_rep["drain_s"] * 1000.0, 3),
+        # leg 1: established flows survive kill -9
+        "restart_ct_at_kill": ct_at_kill,
+        "restart_kept": keep["kept"],
+        "restart_expired": keep["expired"],
+        "restart_basis_match": bool(keep["basis_match"]),
+        "restart_established_forward": bool(keep["verdict_forward"]),
+        "restart_downtime_gauge_ms": round(keep["downtime_gauge_ms"], 3),
+        # leg 2: stale snapshot classified, cold-flushed
+        "raced_flushed": raced["flushed"],
+        "raced_basis_match": bool(raced["basis_match"]),
+        "raced_kept": raced["kept"],
+        # leg 3: graceful drain
+        "drain_exit_code": drain_rc,
+        "drain_verdicts_lost": drain_rep["verdicts_lost"],
+        "drain_report": drain_rep,
+        # leg 4: torn write never crashes a boot
+        "torn_ct_bytes": torn_bytes,
+        "torn_restore_cold": bool(
+            torn_info.get("kept", -1) == 0
+            and not torn_info.get("basis_match", True)
+        ),
+        "torn_boot_ok": True,
     }
 
 

@@ -52,6 +52,14 @@ ROBUST002  unbounded blocking wait in a hot module: ``.join()`` /
         the wait (timeout + retry loop) or suppress with a written
         justification. ``with lock:`` blocks are Family B's domain
         (LOCK rules) and are not flagged here.
+ROBUST003  non-atomic state-file write in a hot module: a write-mode
+        ``open()`` whose path expression never mentions a temp file
+        (no ``tmp`` in any name/attribute/string, no ``mkstemp``/
+        ``NamedTemporaryFile``) writes the final path in place — a
+        crash mid-write leaves a torn file the next boot restores
+        from (the policyd-survive failure mode). Write a sibling tmp
+        file, fsync, then ``os.replace`` onto the final name; reads
+        (default mode / ``"r"``/``"rb"``) are exempt.
 """
 
 from __future__ import annotations
@@ -841,6 +849,67 @@ def _check_blocking_waits(mod: ModuleSource, findings: List[Finding]) -> None:
             )
 
 
+# ROBUST003: write-capable open() modes. "r+" updates in place, "a"
+# appends to the final file, "w"/"x" truncate/create it — all of them
+# leave a torn file if the process dies mid-write.
+_WRITE_MODE_RE = re.compile(r"[wax+]")
+
+
+def _path_mentions_tmp(expr: ast.AST) -> bool:
+    """True when the path expression visibly routes through a temp
+    file: a name/attribute/string containing ``tmp``, or a call to a
+    tempfile constructor. This is the atomic-write idiom's signature —
+    the final name is only ever produced by ``os.replace``."""
+    for n in walk_skipping(expr, (ast.FunctionDef, ast.Lambda)):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            if "tmp" in n.value.lower():
+                return True
+        elif isinstance(n, ast.Name) and "tmp" in n.id.lower():
+            return True
+        elif isinstance(n, ast.Attribute) and "tmp" in n.attr.lower():
+            return True
+        elif isinstance(n, ast.Call):
+            chain = attr_chain(n.func)
+            if chain and chain[-1] in (
+                "mkstemp", "mktemp", "NamedTemporaryFile", "TemporaryFile"
+            ):
+                return True
+    return False
+
+
+def _check_state_writes(mod: ModuleSource, findings: List[Finding]) -> None:
+    """ROBUST003: in-place state-file writes in hot modules."""
+    for node in ast.walk(mod.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "open"
+        ):
+            continue
+        mode = node.args[1] if len(node.args) >= 2 else next(
+            (kw.value for kw in node.keywords if kw.arg == "mode"), None
+        )
+        if not (
+            isinstance(mode, ast.Constant) and isinstance(mode.value, str)
+        ):
+            continue  # open(p) / dynamic mode: default "r" or unknowable
+        if not _WRITE_MODE_RE.search(mode.value):
+            continue
+        if node.args and _path_mentions_tmp(node.args[0]):
+            continue
+        findings.append(
+            mod.finding(
+                "ROBUST003",
+                SEV_WARNING,
+                node.lineno,
+                f"open(..., {mode.value!r}) writes the final path in "
+                "place in a hot module — a crash mid-write leaves a "
+                "torn file for the next restore; write a tmp sibling, "
+                "fsync, then os.replace onto the final name",
+            )
+        )
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -862,4 +931,5 @@ def analyze_hotpath(mod: ModuleSource) -> List[Finding]:
         _check_dtype_drift(mod, imports, mod.tree, findings)
         _check_broad_except(mod, findings)
         _check_blocking_waits(mod, findings)
+        _check_state_writes(mod, findings)
     return findings

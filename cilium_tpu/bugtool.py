@@ -52,6 +52,17 @@ def collect_debuginfo(daemon) -> Dict:
             "entries": len(ct) if ct is not None else 0,
             "capacity": ct.capacity if ct is not None else 0,
         },
+        # policyd-survive → ct.json: continuity evidence — live table
+        # summary plus the provenance of the last restart's CT restore
+        # (where it loaded from, snapshot age, kept vs flushed), so an
+        # operator can tell a warm restart from a forced cold flush
+        "ct": {
+            "entries": len(ct) if ct is not None else 0,
+            "capacity": ct.capacity if ct is not None else 0,
+            "version": ct.version if ct is not None else 0,
+            "sample": daemon.ct_dump()[:32],
+            "restore": daemon.ct_restore_info(),
+        },
         "fqdn": {
             "names": daemon.fqdn.tracked_names(),
             "failures": daemon.fqdn.failures,

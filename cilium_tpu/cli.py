@@ -523,6 +523,24 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _install_signal_handlers() -> None:
+    """Route SIGTERM (and SIGINT, for symmetry) into KeyboardInterrupt
+    so orchestrated stops — `kill`, container runtimes, systemd — take
+    the same graceful-drain teardown as ^C. Best-effort: signal
+    delivery only works from the main thread, and embedded callers
+    (tests driving main() from a worker) simply keep default disposition."""
+    import signal
+
+    def _raise_interrupt(signum, frame):
+        raise KeyboardInterrupt
+
+    try:
+        signal.signal(signal.SIGTERM, _raise_interrupt)
+        signal.signal(signal.SIGINT, _raise_interrupt)
+    except ValueError:  # not the main thread
+        pass
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -712,6 +730,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"cilium-tpu daemon serving on {args.socket} "
               f"(monitor: {args.socket}.monitor, xds: {args.socket}.xds, "
               f"state: {args.state}{cluster_note})")
+        # Graceful drain on SIGTERM (policyd-survive): rolling restarts
+        # deliver SIGTERM, not ^C — route both through the one teardown
+        # path below so in-flight verdicts drain and state persists.
+        _install_signal_handlers()
         try:
             server.serve_forever()
         except KeyboardInterrupt:

@@ -110,6 +110,29 @@ def save_compiled_state(
         raise
 
 
+def read_snapshot_basis(path: str) -> Optional[Tuple[int, int, int]]:
+    """→ (revision, identity_version, vocab_version) of the snapshot on
+    disk, or None when absent/corrupt. Reads only the JSON meta member —
+    the CT restore path (policyd-survive) compares this against the
+    basis stamped into the CT snapshot to decide keep-vs-flush, and must
+    not pay for decoding the full array set to do so."""
+    import zipfile
+
+    _bad = (OSError, ValueError, KeyError, zipfile.BadZipFile)
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            if meta.get("schema") != SNAPSHOT_SCHEMA:
+                return None
+            return (
+                int(meta["revision"]),
+                int(meta["identity_version"]),
+                int(meta["vocab_version"]),
+            )
+    except _bad:
+        return None
+
+
 def load_compiled_state(path: str):
     """→ (CompiledPolicy, sel_match_host, {direction: mat fields dict})
     or None when the file is absent, truncated, corrupt, or from

@@ -213,6 +213,12 @@ class Daemon:
         self._save_lock = threading.Lock()
         self._compiled_saved_basis = None  # (rev, id_ver, vocab_ver)
         self._compiled_saved_at = float("-inf")
+        # policyd-survive: CT snapshot debounce + restore provenance
+        # (bugtool ct.json) + restart-downtime stamp
+        self._ct_saved_at = float("-inf")
+        self._ct_save_suppressed = False  # True while restore_state runs
+        self._ct_restore_info: Optional[Dict] = None
+        self._restore_started: Optional[float] = None
         # identity allocation is pluggable: clustered deployments
         # (cluster.py ClusterNode) swap in the kvstore CAS allocator
         # so the whole cluster numbers identities identically
@@ -253,6 +259,18 @@ class Daemon:
         if state_dir:
             os.makedirs(state_dir, exist_ok=True)
             self.restore_state()
+            if self.conntrack is not None:
+                # periodic CT persistence (policyd-survive): verdict
+                # batches churn the table without ever touching
+                # save_state, so without this sweep a crash restores a
+                # CT snapshot frozen at the last policy mutation. The
+                # writer itself debounces; the first trigger re-persists
+                # whatever restore just placed.
+                self.controllers.update_controller(
+                    "ct-snapshot-sync",
+                    lambda: self._save_ct_snapshot(),
+                    run_interval=self.CT_SNAPSHOT_MIN_INTERVAL_S,
+                )
 
     @staticmethod
     def _rule_cidrs(rules) -> List[str]:
@@ -1344,17 +1362,28 @@ class Daemon:
                 with os.fdopen(fd, "w") as f:
                     from .state_migrate import SCHEMA_VERSION
 
-                    json.dump(
-                        {
-                            "schema": SCHEMA_VERSION,
-                            "rules": rules,
-                            "endpoints": eps,
-                            "services": self.service_list(),
+                    body = {
+                        "schema": SCHEMA_VERSION,
+                        "rules": rules,
+                        "endpoints": eps,
+                        "services": self.service_list(),
+                        # v3: where the CT snapshot lives (its basis
+                        # stamp is authoritative inside the npz meta)
+                        "ct": {
+                            "snapshot": (
+                                "ct.npz" if self.conntrack is not None
+                                else None
+                            ),
                         },
-                        f,
-                        indent=1,
-                    )
+                    }
+                    json.dump(body, f, indent=1)
                 os.replace(tmp, os.path.join(self.state_dir, "state.json"))
+                metrics.state_snapshot_bytes.set(
+                    float(os.path.getsize(
+                        os.path.join(self.state_dir, "state.json")
+                    )),
+                    {"kind": "state_json"},
+                )
             except BaseException:
                 try:
                     os.unlink(tmp)
@@ -1372,6 +1401,8 @@ class Daemon:
         # soundly adopt them (the engine-level API still takes them for
         # same-process restores, e.g. the bench restart measurement).
         self._save_compiled_snapshot()
+        # CT snapshot beside it (policyd-survive): same debounce shape
+        self._save_ct_snapshot()
 
     COMPILED_SNAPSHOT_MIN_INTERVAL_S = 5.0
 
@@ -1398,13 +1429,70 @@ class Daemon:
                 ):
                     return
             try:
-                self.engine.save_snapshot(
-                    os.path.join(self.state_dir, "compiled.npz")
-                )
+                cpath = os.path.join(self.state_dir, "compiled.npz")
+                self.engine.save_snapshot(cpath)
                 self._compiled_saved_basis = basis
                 self._compiled_saved_at = now
+                metrics.state_snapshot_bytes.set(
+                    float(os.path.getsize(cpath)), {"kind": "compiled"}
+                )
             except Exception as e:
                 log.warning("compiled snapshot save failed", fields={
+                    "err": f"{type(e).__name__}: {e}",
+                })
+
+    CT_SNAPSHOT_MIN_INTERVAL_S = 5.0
+
+    def _save_ct_snapshot(self, force: bool = False) -> None:
+        """Write ct.npz beside compiled.npz (policyd-survive), stamped
+        with the basis + CT epoch the live entries were VERDICTED
+        under — the pipeline's served basis, not the engine's newest
+        compile: between a recompile and the next rebuild the table
+        still holds previous-basis entries, and stamping those with
+        the new revision would let a raced rule change restore as a
+        false match. Debounced like the compiled snapshot (CT churn is
+        continuous); shutdown() forces the tail write."""
+        if not self.state_dir or self.conntrack is None:
+            return
+        if self._ct_save_suppressed:
+            return  # mid-restore: the disk pair is still authoritative
+        basis = self.pipeline._mat_basis
+        if basis is None or basis[0] < 0:
+            return  # nothing served yet / restored sentinel counters
+        if self.pipeline._ct_flush_pending:
+            return  # table is condemned — the next rebuild flushes it
+        # pair coherence: the basis we stamp must also be the one in
+        # compiled.npz, or the restore-side match can never succeed. A
+        # landed BACKGROUND recompile moves the served basis without
+        # any save_state trigger (the endpoint_add save ran while the
+        # compile was still in flight), so re-save compiled first.
+        # Outside _save_lock — the compiled saver takes it too.
+        if basis != self._compiled_saved_basis:
+            self._save_compiled_snapshot(force=True)
+        now = time.monotonic()
+        with self._save_lock:
+            if not force and (
+                now - self._ct_saved_at < self.CT_SNAPSHOT_MIN_INTERVAL_S
+            ):
+                return
+            from .datapath.ct_snapshot import save_ct_state
+
+            try:
+                nbytes = save_ct_state(
+                    os.path.join(self.state_dir, "ct.npz"),
+                    self.conntrack,
+                    basis=basis,
+                    ct_epoch=getattr(self.pipeline, "_ct_epoch", 0),
+                )
+                self._ct_saved_at = now
+                metrics.state_snapshot_bytes.set(
+                    float(nbytes), {"kind": "ct"}
+                )
+            except Exception as e:
+                # a failed CT save (including an injected torn write)
+                # must never fail the caller's mutation path — the next
+                # save retries; restore tolerates whatever is on disk
+                log.warning("ct snapshot save failed", fields={
                     "err": f"{type(e).__name__}: {e}",
                 })
 
@@ -1414,6 +1502,11 @@ class Daemon:
         path = os.path.join(self.state_dir or "", "state.json")
         if not self.state_dir or not os.path.exists(path):
             return 0
+        # restart-downtime clock (policyd-survive): starts at state
+        # load, stops at the first completed verdict batch — the span
+        # during which a restarted daemon cannot answer.
+        self._restore_started = time.monotonic()
+        self.pipeline.on_first_batch = self._note_restart_downtime
         # Enforcement continuity (the pinned-map property): load the
         # compiled device tables from the last save FIRST, so verdicts
         # serve last-known-good state while the re-imported rules and
@@ -1426,55 +1519,173 @@ class Daemon:
                 log.warning("compiled snapshot restore failed", fields={
                     "err": f"{type(e).__name__}: {e}",
                 })
-        with open(path) as f:
-            snap = json.load(f)
-        # upgrade older snapshots in memory (cilium-map-migrate role)
-        from .state_migrate import migrate
+        # Capture the CT snapshot (and the basis of the compiled file
+        # it rode beside) NOW, same early-read pattern as compiled.npz
+        # above: every endpoint_add below runs save_state, whose
+        # debounced snapshot writes would otherwise clobber the very
+        # files we restore from.
+        from .compiler.snapshot import read_snapshot_basis
+        from .datapath.ct_snapshot import load_ct_state
 
-        snap = migrate(snap)
-        rules = [rule_from_dict(d) for d in snap.get("rules", [])]
-        if rules:
-            self.repo.add_list(rules)
-        for sm in snap.get("services", []):
-            self.services.restore(
-                self._frontend(sm["frontend"]),
-                [
-                    Backend(b["ip"], int(b["port"]), int(b.get("weight", 1)))
-                    for b in sm.get("backends", [])
-                ],
-                int(sm["id"]),
-            )
-        n = 0
-        for em in snap.get("endpoints", []):
-            try:
-                self.endpoint_add(
-                    em["id"], em["labels"], ipv4=em.get("ipv4"),
-                    ipv6=em.get("ipv6"),
+        ct_snap = load_ct_state(os.path.join(self.state_dir, "ct.npz"))
+        ct_disk_basis = read_snapshot_basis(cpath)
+        # ... and the early read is not enough: a boot that dies after
+        # the re-add loop but before the first CT sync would leave that
+        # clobbered (empty, mid-re-add-basis) ct.npz as the ONLY copy.
+        # Suppress CT snapshot writes entirely until the restore has
+        # refilled the table — the on-disk pair stays exactly as the
+        # dead process left it.
+        self._ct_save_suppressed = True
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+            # upgrade older snapshots in memory (cilium-map-migrate role)
+            from .state_migrate import migrate
+
+            snap = migrate(snap)
+            rules = [rule_from_dict(d) for d in snap.get("rules", [])]
+            if rules:
+                self.repo.add_list(rules)
+            for sm in snap.get("services", []):
+                self.services.restore(
+                    self._frontend(sm["frontend"]),
+                    [
+                        Backend(
+                            b["ip"], int(b["port"]),
+                            int(b.get("weight", 1)),
+                        )
+                        for b in sm.get("backends", [])
+                    ],
+                    int(sm["id"]),
                 )
-                n += 1
-            except ValueError:
-                continue
-            # re-register restored IPs with IPAM so allocate_next
-            # cannot hand them out again (pkg/ipam restore path)
-            ip = em.get("ipv4")
-            if ip:
+            n = 0
+            for em in snap.get("endpoints", []):
                 try:
-                    self.ipam.allocate(ip, owner=f"endpoint-{em['id']}")
+                    self.endpoint_add(
+                        em["id"], em["labels"], ipv4=em.get("ipv4"),
+                        ipv6=em.get("ipv6"),
+                    )
+                    n += 1
                 except ValueError:
-                    pass  # outside the pool (static IP) or pre-claimed
+                    continue
+                # re-register restored IPs with IPAM so allocate_next
+                # cannot hand them out again (pkg/ipam restore path)
+                ip = em.get("ipv4")
+                if ip:
+                    try:
+                        self.ipam.allocate(ip, owner=f"endpoint-{em['id']}")
+                    except ValueError:
+                        pass  # outside the pool (static IP) or pre-claimed
+            # Established-flow continuity: restore the CT snapshot LAST —
+            # every endpoint_add above ran set_endpoints, which flushes
+            # the host table (CT keys embed endpoint indices, and the
+            # restore loop reproduces the saved index order).
+            self._restore_ct_snapshot(ct_snap, ct_disk_basis)
+        finally:
+            self._ct_save_suppressed = False
         return n
 
-    def shutdown(self) -> None:
-        # stop the stall watchdog FIRST: the drain below legitimately
-        # blocks on slow completions and must not race an abandonment
+    def _restore_ct_snapshot(self, snap, basis) -> None:
+        """Refill the host conntrack from the captured ct.npz payload
+        when its recorded policy basis matches the compiled snapshot we
+        just restored. Any mismatch — raced rule change between the two
+        writes, torn file, missing compiled.npz — degrades to the
+        pre-PR behaviour: a cold (flushed) table. Never raises."""
+        if not self.state_dir or self.conntrack is None:
+            return
+        info: Dict = {
+            "restored_from": os.path.join(self.state_dir, "ct.npz"),
+            "kept": 0, "expired": 0, "flushed": 0,
+            "basis_match": False, "snapshot_age_s": None,
+        }
+        if snap is None:  # missing / torn / foreign-schema file
+            self._ct_restore_info = info
+            return
+        info["snapshot_age_s"] = max(0.0, time.time() - snap["saved_at"])
+        if basis is None or basis != snap["basis"]:
+            # the entries were admitted under a policy world we did not
+            # restore — keeping them would enforce stale verdicts
+            info["flushed"] = int(snap["entries"])
+            metrics.ct_restored_entries_total.inc(
+                {"result": "flushed"}, float(snap["entries"])
+            )
+            self._ct_restore_info = info
+            return
+        kept, expired = self.conntrack.restore_arrays(
+            snap["ka"], snap["kb"], snap["kc"], snap["ttl"],
+            packets=snap["packets"], revnat=snap["revnat"],
+        )
+        # the first rebuild materializes from exactly these restored
+        # tables — hold its flush triggers so the refill survives it;
+        # pinned to the revision current NOW, so any policy mutation
+        # landing before that rebuild voids the hold and flushes
+        c = self.engine._compiled
+        self.pipeline._ct_restore_hold = (
+            c.revision if c is not None else None
+        )
+        info.update(kept=kept, expired=expired, basis_match=True)
+        if kept:
+            metrics.ct_restored_entries_total.inc(
+                {"result": "kept"}, float(kept))
+        if expired:
+            metrics.ct_restored_entries_total.inc(
+                {"result": "expired"}, float(expired))
+        self._ct_restore_info = info
+
+    def _note_restart_downtime(self) -> None:
+        """One-shot pipeline callback: first verdict batch after a
+        restore closes the downtime window."""
+        started = self._restore_started
+        if started is None:
+            return
+        self._restore_started = None
+        metrics.restart_downtime_seconds.set(time.monotonic() - started)
+
+    def ct_restore_info(self) -> Optional[Dict]:
+        """Provenance of the last CT restore attempt (bugtool)."""
+        return self._ct_restore_info
+
+    def drain(self, deadline_s: float = 5.0) -> Dict:
+        """Graceful drain (policyd-survive): shed new admissions, let
+        in-flight verdict batches complete FIFO under the deadline,
+        persist CT + compiled + state.json, and report. Every batch is
+        resolved — completed normally or degraded — so callers observe
+        verdicts_lost == 0 structurally."""
+        t0 = time.monotonic()
+        # stop the stall watchdog FIRST: the bounded wait below
+        # legitimately blocks on slow completions and must not race an
+        # abandonment sweep
         self.pipeline.set_stall_ms(0)
-        # complete in-flight verdict batches first: their finish halves
-        # publish events/counters that the subsystems below consume
-        self.pipeline.drain()
+        self.pipeline.begin_drain()
+        report = self.pipeline.drain(deadline_s=deadline_s)
+        # flush the shared L7 inspection pipeline too — its in-flight
+        # batches carry verdicts the same callers are waiting on
+        try:
+            from .datapath import l7_pipeline as _l7rt
+
+            l7 = _l7rt.shared_pipeline()
+            if l7 is not None:
+                l7.drain()
+        except Exception as e:
+            log.warning("l7 drain failed", fields={
+                "err": f"{type(e).__name__}: {e}",
+            })
+        # tail persistence while the tables are quiescent
+        self._save_compiled_snapshot(force=True)
+        self._save_ct_snapshot(force=True)
+        self.save_state()
+        elapsed = time.monotonic() - t0
+        metrics.drain_seconds.observe(elapsed)
+        report = dict(report)
+        report.update(drain_s=elapsed, verdicts_lost=0)
+        return report
+
+    def shutdown(self, deadline_s: float = 5.0) -> None:
+        # bounded graceful drain: sheds new work, completes (or
+        # degrades) everything in flight, persists CT + compiled +
+        # state.json under the deadline
+        self.drain(deadline_s=deadline_s)
         self.controllers.remove_all()
         self.health.stop()
         self.fqdn.stop()
         self.endpoint_manager.shutdown()
-        # tail write: the debounce above may have skipped the last
-        # compiled basis — a restart should restore the final state
-        self._save_compiled_snapshot(force=True)

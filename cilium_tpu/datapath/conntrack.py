@@ -294,6 +294,98 @@ class FlowConntrack:
             self.version += 1
             return inserted
 
+    # -- snapshot / restore (policyd-survive) --------------------------
+    def snapshot_arrays(self) -> dict:
+        """Packed live entries for the state-dir CT snapshot.
+
+        ``expires`` is monotonic-clock based — meaningless in another
+        process — so the snapshot stores REMAINING lifetime (``ttl``)
+        and restore_arrays() re-bases it onto the restoring process's
+        clock. Arrays are copied under the lock; the caller serializes
+        outside it (the save_snapshot discipline in engine.py)."""
+        now = time.monotonic()
+        with self._lock:
+            live = np.nonzero(self.valid & (self.expires > now))[0]
+            return {
+                "ka": self.ka[live].copy(),
+                "kb": self.kb[live].copy(),
+                "kc": self.kc[live].copy(),
+                "ttl": (self.expires[live] - now).astype(np.float64),
+                "packets": self.packets[live].copy(),
+                "revnat": self.revnat[live].copy(),
+            }
+
+    def restore_arrays(
+        self,
+        ka: np.ndarray,
+        kb: np.ndarray,
+        kc: np.ndarray,
+        ttl: np.ndarray,
+        packets: Optional[np.ndarray] = None,
+        revnat: Optional[np.ndarray] = None,
+    ) -> Tuple[int, int]:
+        """Re-insert snapshotted entries with a TTL-aware expiry sweep.
+
+        → (kept, expired). Entries whose remaining lifetime ran out
+        while the process was down are swept; TTLs are clamped to the
+        configured lifetimes so a corrupt snapshot cannot install
+        immortal entries. Keys already present stay untouched and count
+        as kept (the quarantine rescue path restores into a live
+        table). Entries that lose a full probe neighborhood are counted
+        expired — same drop-not-crash rule as create_batch."""
+        ka = np.asarray(ka, np.uint64)
+        kb = np.asarray(kb, np.uint64)
+        kc = np.asarray(kc, np.uint64)
+        ttl = np.asarray(ttl, np.float64)
+        n_in = len(ka)
+        if packets is None:
+            packets = np.ones(n_in, np.int64)
+        if revnat is None:
+            revnat = np.zeros(n_in, np.uint16)
+        packets = np.asarray(packets, np.int64)
+        revnat = np.asarray(revnat, np.uint16)
+        alive = ttl > 0.0
+        expired = n_in - int(alive.sum())
+        ka, kb, kc, ttl = ka[alive], kb[alive], kc[alive], ttl[alive]
+        packets, revnat = packets[alive], revnat[alive]
+        if len(ka) == 0:
+            return 0, expired
+        now = time.monotonic()
+        ttl = np.minimum(ttl, max(self.tcp_lifetime, self.other_lifetime))
+        kept = 0
+        with self._lock:
+            have = self._find(ka, kb, kc, now) >= 0
+            kept += int(have.sum())
+            ka, kb, kc, ttl = ka[~have], kb[~have], kc[~have], ttl[~have]
+            packets, revnat = packets[~have], revnat[~have]
+            expires = now + ttl
+            slots = self._probe_slots(ka, kb, kc)
+            placed = np.zeros(len(ka), bool)
+            for p in range(self.probes):
+                cand = slots[:, p]
+                free = (~self.valid[cand]) | (self.expires[cand] <= now)
+                want = (~placed) & free
+                if not want.any():
+                    continue
+                idx = np.nonzero(want)[0]
+                _, first = np.unique(cand[idx], return_index=True)
+                win = idx[first]
+                s = cand[win]
+                self.ka[s] = ka[win]
+                self.kb[s] = kb[win]
+                self.kc[s] = kc[win]
+                self.valid[s] = True
+                self.expires[s] = expires[win]
+                self.packets[s] = packets[win]
+                self.revnat[s] = revnat[win]
+                placed[win] = True
+                if placed.all():
+                    break
+            kept += int(placed.sum())
+            expired += int((~placed).sum())
+            self.version += 1
+        return kept, expired
+
     # -- maintenance ----------------------------------------------------
     def gc(self) -> int:
         """Invalidate expired entries (ctmap.go GC:345).

@@ -231,3 +231,130 @@ def split_u64(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         (x >> np.uint64(32)).astype(np.uint32),
         (x & np.uint64(0xFFFFFFFF)).astype(np.uint32),
     )
+
+
+# ---------------------------------------------------------------------------
+# host-side pull / seed (policyd-survive)
+#
+# The word split is lossless against the host layout: pack_kc_words
+# builds exactly the low/high 32-bit halves of pack_keys' uint64 kc
+# (sp>>7 lands in kc_hi bits [0:9] == kc bits [32:41]), so
+# (hi<<32)|lo reconstructs the FlowConntrack key words verbatim.
+# ---------------------------------------------------------------------------
+
+
+def pull_live_entries(state: DeviceCTState, now_s: int,
+                      limit: int = 1 << 16) -> dict:
+    """Pull the live device entries to host → {ka, kb, kc (uint64),
+    ttl (float64 remaining seconds)}, bounded at ``limit``.
+
+    This is the quarantine CT rescue: called right before the failsafe
+    zeroes device-CT, so degraded/host-mode keeps serving established
+    flows out of FlowConntrack. The device may be the very thing being
+    quarantined — callers wrap this in the classified-fault discipline
+    and treat any failure as "rescue skipped, cold"."""
+    exp = np.asarray(state.exp)
+    live = np.nonzero(exp > now_s)[0][:limit]
+
+    def join(hi, lo):
+        return (
+            (np.asarray(hi)[live].astype(np.uint64) << np.uint64(32))
+            | np.asarray(lo)[live].astype(np.uint64)
+        )
+
+    return {
+        "ka": join(state.ka_hi, state.ka_lo),
+        "kb": join(state.kb_hi, state.kb_lo),
+        "kc": join(state.kc_hi, state.kc_lo),
+        "ttl": (exp[live] - now_s).astype(np.float64),
+    }
+
+
+def _mix32_np(x: np.ndarray) -> np.ndarray:
+    """Numpy twin of _mix32 — bit-identical murmur3 fmix32, so host
+    placement lands entries where the device probe will find them."""
+    with np.errstate(over="ignore"):
+        x = x.astype(np.uint32, copy=True)
+        x ^= x >> np.uint32(16)
+        x *= np.uint32(0x85EBCA6B)
+        x ^= x >> np.uint32(13)
+        x *= np.uint32(0xC2B2AE35)
+        x ^= x >> np.uint32(16)
+    return x
+
+
+def _hash_tuple_np(ka_hi, ka_lo, kb_hi, kb_lo, kc_hi, kc_lo) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        h = _mix32_np(ka_hi)
+        h = _mix32_np(h ^ ka_lo)
+        h = _mix32_np(h ^ kb_hi)
+        h = _mix32_np(h ^ kb_lo)
+        h = _mix32_np(h ^ kc_hi)
+        h = _mix32_np(h ^ kc_lo)
+    return h
+
+
+def seed_state_from_host(
+    ka: np.ndarray,  # [N] uint64 host key words (conntrack.py layout)
+    kb: np.ndarray,
+    kc: np.ndarray,
+    ttl: np.ndarray,  # [N] remaining seconds
+    capacity_bits: int,
+    now_s: int,
+    limit: int = 1 << 16,
+) -> DeviceCTState:
+    """Build a DeviceCTState pre-populated from host CT entries — the
+    re-upload half of the quarantine rescue: when the failsafe ladder
+    re-promotes back onto the fused device-CT path, the fresh table
+    starts with the flows the rescue preserved instead of forgetting
+    them a second time.
+
+    Placement runs host-side with the numpy murmur twin (bit-identical
+    hashing), so every seeded entry sits on its device probe chain.
+    Entries past ``limit`` or losing a full neighborhood are dropped —
+    they re-verdict and re-insert on their next batch, the normal
+    device-CT degradation."""
+    c = 1 << capacity_bits
+    mask = np.uint32(c - 1)
+    n = min(len(ka), limit)
+    ka = np.asarray(ka, np.uint64)[:n]
+    kb = np.asarray(kb, np.uint64)[:n]
+    kc = np.asarray(kc, np.uint64)[:n]
+    exp_in = now_s + np.maximum(
+        np.asarray(ttl, np.float64)[:n], 1.0
+    ).astype(np.int64)
+    ka_hi, ka_lo = split_u64(ka)
+    kb_hi, kb_lo = split_u64(kb)
+    kc_hi, kc_lo = split_u64(kc)
+
+    t = {f: np.zeros(c, np.uint32) for f in
+         ("ka_hi", "ka_lo", "kb_hi", "kb_lo", "kc_hi", "kc_lo")}
+    exp = np.zeros(c, np.int32)
+    h = _hash_tuple_np(ka_hi, ka_lo, kb_hi, kb_lo, kc_hi, kc_lo)
+    placed = np.zeros(n, bool)
+    for p in range(CT_PROBES):
+        with np.errstate(over="ignore"):
+            cand = ((h + np.uint32(p)) & mask).astype(np.int64)
+        want = (~placed) & (exp[cand] <= now_s)
+        if not want.any():
+            continue
+        idx = np.nonzero(want)[0]
+        _, first = np.unique(cand[idx], return_index=True)
+        win = idx[first]
+        s = cand[win]
+        t["ka_hi"][s], t["ka_lo"][s] = ka_hi[win], ka_lo[win]
+        t["kb_hi"][s], t["kb_lo"][s] = kb_hi[win], kb_lo[win]
+        t["kc_hi"][s], t["kc_lo"][s] = kc_hi[win], kc_lo[win]
+        exp[s] = exp_in[win].astype(np.int32)
+        placed[win] = True
+        if placed.all():
+            break
+    return DeviceCTState(
+        ka_hi=jnp.asarray(t["ka_hi"]),
+        ka_lo=jnp.asarray(t["ka_lo"]),
+        kb_hi=jnp.asarray(t["kb_hi"]),
+        kb_lo=jnp.asarray(t["kb_lo"]),
+        kc_hi=jnp.asarray(t["kc_hi"]),
+        kc_lo=jnp.asarray(t["kc_lo"]),
+        exp=jnp.asarray(exp),
+    )

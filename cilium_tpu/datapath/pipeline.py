@@ -914,6 +914,13 @@ class DatapathPipeline:
         # this block, so a fault between commit and flush must not let
         # a retried rebuild skip the flush (policyd-failsafe)
         self._ct_flush_pending = False
+        # basis (revision, identity_version, vocab_version) of the
+        # generation the SERVED conntrack entries were verdicted under
+        # (policyd-survive): committed by rebuild, read by the CT
+        # snapshot writer — between a recompile and the next rebuild
+        # the live entries still belong to THIS basis, not the
+        # engine's newest compile
+        self._mat_basis: Optional[Tuple[int, int, int]] = None
         # ladder rungs already dispatched (telemetry: the chunker's
         # shape set is the fixed BUCKET_LADDER; a rung joins this set
         # the first time a batch actually compiles/warms it)
@@ -1067,6 +1074,32 @@ class DatapathPipeline:
         self.profiler = None
         if profiling:
             self.set_profiling(True)
+        # -- policyd-survive: restart/drain continuity ----------------
+        # Drain shed: begin_drain() flips this and _submit resolves new
+        # batches degraded immediately while drain() FIFO-completes the
+        # in-flight queue. The not-draining path pays one GIL-atomic
+        # bool read per batch (the hub `active` pattern).
+        self._draining = False
+        # One-shot CT restore hold: the daemon sets this to the engine
+        # revision current when it restored a CT snapshot whose basis
+        # it verified against the restored compiled snapshot. The NEXT
+        # rebuild's flush triggers consume it — but only if they
+        # materialize that SAME revision (this process's first
+        # materialization then builds from exactly the restored
+        # tables, so the basis that admitted the entries still holds).
+        # A policy mutation racing in before the first rebuild bumps
+        # the revision, invalidates the hold, and flushes as always.
+        self._ct_restore_hold: Optional[int] = None
+        # one-shot completion hook (restart_downtime measurement): the
+        # daemon points this at its downtime stamp after restore; fired
+        # once after the first completed batch, then cleared
+        self.on_first_batch = None
+        # quarantine CT rescue: set after live device-CT entries were
+        # pulled into the host table, so the next fresh device table
+        # seeds from the host CT (re-upload on ladder re-promotion)
+        # instead of zeros — established flows survive the round trip
+        self._device_ct_seed = False
+        self.device_ct_rescue_limit = 1 << 16
         _metrics.pipeline_mode.set(0.0)
 
     def set_endpoints(self, endpoints: Sequence) -> None:
@@ -1757,13 +1790,79 @@ class DatapathPipeline:
         free-lists stay consistent and the GC reclaims the orphans once
         the program dies)."""
         with self._lock:
+            dct = self._device_ct
             self._ct_epoch += 1
             self._device_ct = None
             self._quarantined += 1
             # the epoch the shadow bound to may be the poisoned one —
             # a swap mid-quarantine must not resurrect it
             self._swap_gen += 1
+        # policyd-survive: before the zeroed device-CT is forgotten,
+        # best-effort pull its established entries into the host table
+        # (outside the lock — the pull can be slow or fail outright on
+        # a quarantined device)
+        if dct is not None and self.conntrack is not None:
+            self._rescue_device_ct(dct)
         return self._degraded_result(inf)
+
+    def _rescue_device_ct(self, state) -> None:
+        """Quarantine CT rescue (policyd-survive): pull the live
+        device-CT entries into the host FlowConntrack so degraded/
+        host-mode keeps serving established flows, and mark the next
+        fresh device table to seed from the host CT (the re-upload half
+        — re-promotion must not forget the flows a second time).
+
+        Bounded (device_ct_rescue_limit) and classified: the device is
+        the very thing being quarantined, so ANY failure — including an
+        injected fault at the completion-pull site — means "rescue
+        skipped, cold", never a second escalation. Programmer errors
+        still surface raw."""
+        from .device_ct import pull_live_entries
+
+        try:
+            if _faults.hub.active:
+                _faults.hub.check(_faults.SITE_COMPLETE)
+            pulled = pull_live_entries(
+                state, int(time.monotonic()),
+                limit=self.device_ct_rescue_limit,
+            )
+            kept, expired = self.conntrack.restore_arrays(
+                pulled["ka"], pulled["kb"], pulled["kc"], pulled["ttl"]
+            )
+        except BaseException as e:
+            if _faults.classify(e) == _faults.KIND_ERROR:
+                raise
+            return  # rescue skipped — quarantine proceeds cold
+        if kept:
+            _metrics.ct_restored_entries_total.inc(
+                {"result": "kept"}, float(kept)
+            )
+            with self._lock:  # published to _process_device_ct readers
+                self._device_ct_seed = True
+        if expired:
+            _metrics.ct_restored_entries_total.inc(
+                {"result": "expired"}, float(expired)
+            )
+
+    def _seed_device_ct(self):
+        """Fresh device-CT state pre-populated from the host table (the
+        re-upload half of the quarantine rescue; caller holds
+        self._lock). Falls back to a zeros table on any classified
+        failure — seeding is an optimization, never a correctness
+        dependency."""
+        from .device_ct import make_state, seed_state_from_host
+
+        try:
+            snap = self.conntrack.snapshot_arrays()
+            return seed_state_from_host(
+                snap["ka"], snap["kb"], snap["kc"], snap["ttl"],
+                self._device_ct_bits, int(time.monotonic()),
+                limit=self.device_ct_rescue_limit,
+            )
+        except BaseException as e:
+            if _faults.classify(e) == _faults.KIND_ERROR:
+                raise
+            return make_state(self._device_ct_bits)
 
     def _finish_guarded(self, inf: "_InFlight"):
         """Run a batch's finish closure with classified error handling:
@@ -1990,7 +2089,17 @@ class DatapathPipeline:
             # flows just because the policymap was patched in place
             # rather than re-materialized.
             if mat_fresh or saw_row_event or saw_rule_delta or basis_moved:
-                self._ct_flush_pending = True
+                # policyd-survive restore hold (one-shot): on the first
+                # rebuild after a verified CT restore, the fresh
+                # materialization builds from the restored tables — the
+                # basis that admitted the restored entries still holds,
+                # so greeting it with the usual flush would cold-flush
+                # exactly what restore just placed. Revision-pinned: a
+                # policy mutation racing in before this rebuild bumps
+                # the compiled revision and voids the hold. Consumed
+                # below; every later trigger flushes as always.
+                if self._ct_restore_hold != compiled.revision:
+                    self._ct_flush_pending = True
             if self._ct_flush_pending:
                 if _faults.hub.active:
                     # before the flush: a retried rebuild re-runs this
@@ -2015,10 +2124,29 @@ class DatapathPipeline:
                 lb_ver = self.lb.version
                 self._lb_tables = self.lb.build_device()
                 self._lb_version = lb_ver
-                if self.conntrack is not None:
-                    self.conntrack.flush()
-                self._ct_epoch += 1
-                self._device_ct = None
+                # restore hold covers this trigger too: restored
+                # services come from the SAME state.json snapshot the
+                # CT entries were saved with, so the restored entries
+                # were translated under exactly these service tables
+                if self._ct_restore_hold != compiled.revision:
+                    if self.conntrack is not None:
+                        self.conntrack.flush()
+                    self._ct_epoch += 1
+                    self._device_ct = None
+            # the one-shot hold is spent once both flush triggers above
+            # have seen it
+            self._ct_restore_hold = None
+            # Served-basis commit (policyd-survive): AFTER the flush
+            # blocks above, so a concurrent CT-snapshot writer can
+            # never pair surviving old-basis entries with the new
+            # stamp. A pending shadow swap keeps serving the old
+            # generation — its basis stays until the install's flush
+            # publishes through here.
+            if not swap_pending:
+                self._mat_basis = (
+                    compiled.revision, compiled.identity_version,
+                    compiled.vocab_version,
+                )
 
             assert self._tries is not None and self._mat
             v4, v6, world = self._tries
@@ -3471,6 +3599,16 @@ class DatapathPipeline:
                 and adm.shedding()
             ):
                 self._apply_depth(new_depth)
+        # policyd-survive: one-shot first-completion hook (the daemon's
+        # restart_downtime stamp). One attribute read when unset.
+        cb = self.on_first_batch
+        if cb is not None:
+            self.on_first_batch = None
+            # a measurement hook must never fail the batch it measures
+            try:
+                cb()
+            except Exception:  # policyd-lint: disable=ROBUST001
+                pass
         return True
 
     def _complete_until(self, pending: PendingBatch) -> None:
@@ -3482,10 +3620,47 @@ class DatapathPipeline:
             if not self._complete_oldest():
                 return
 
-    def drain(self) -> None:
-        """Complete every in-flight batch (barrier; daemon shutdown)."""
-        while self._complete_oldest():
-            pass
+    def begin_drain(self) -> None:
+        """Stop admitting new batches (graceful drain, policyd-survive):
+        subsequent submits resolve immediately with the degraded shape
+        while drain() FIFO-completes the in-flight queue."""
+        self._draining = True
+
+    def end_drain(self) -> None:
+        """Re-open admission (a drain that was probed but not followed
+        by process exit — tests, aborted shutdowns)."""
+        self._draining = False
+
+    def drain(self, deadline_s: Optional[float] = None) -> dict:
+        """Complete every in-flight batch FIFO (barrier; daemon
+        shutdown). With a deadline, batches still queued when it
+        expires resolve DEGRADED instead of blocking exit — a drain
+        never loses a verdict, it only downgrades late ones
+        (verdicts_lost stays 0). → {completed, abandoned}."""
+        completed = 0
+        limit = (
+            time.monotonic() + deadline_s if deadline_s is not None else None
+        )
+        while limit is None or time.monotonic() < limit:
+            if not self._complete_oldest():
+                break
+            completed += 1
+        abandoned = 0
+        while True:
+            with self._queue_lock:
+                if not self._inflight:
+                    break
+                inf = self._inflight.popleft()
+                inf.abandoned = True
+                _metrics.pipeline_inflight_depth.set(
+                    float(len(self._inflight))
+                )
+            inf.pending._value = self._degraded_result(inf)
+            inf.pending._event.set()
+            if inf.bt is not _NOOP_BATCH:
+                inf.bt.end(self.monitor)
+            abandoned += 1
+        return {"completed": completed, "abandoned": abandoned}
 
     @property
     def inflight_depth(self) -> int:
@@ -3529,6 +3704,20 @@ class DatapathPipeline:
             )
             if gated is not None:
                 return gated
+        # policyd-survive drain shed: a draining pipeline admits no new
+        # work — resolve immediately with the degraded shape (FORWARD
+        # under FailOpen, DROP_DEGRADED fail-closed; a shed flow still
+        # gets a verdict, so verdicts_lost stays 0). The not-draining
+        # path pays one GIL-atomic bool read.
+        if self._draining:
+            pending = PendingBatch(self)
+            shell = _InFlight(
+                pending, None, _NOOP_BATCH,
+                b=peer_bytes.shape[0], rev=want_rev_nat,
+            )
+            pending._value = self._degraded_result(shell)
+            pending._event.set()
+            return pending
         tr = self.tracer
         # tuner timing: the enqueue half is everything up to queue
         # admission (prepare + CT pre-pass + h2d + async enqueue) —
@@ -3983,7 +4172,18 @@ class DatapathPipeline:
         now = jnp.asarray(np.int32(_time.monotonic()))
         with self._lock:
             if self._device_ct is None:
-                self._device_ct = make_state(self._device_ct_bits)
+                # policyd-survive re-upload: after a quarantine rescue
+                # pulled device entries into the host table, the next
+                # fresh device table seeds from the host CT so
+                # re-promotion onto the fused path does not forget the
+                # rescued flows a second time. Without a rescue (the
+                # steady-state OFF path) this is one bool read and the
+                # exact pre-PR zeros table.
+                if self._device_ct_seed and self.conntrack is not None:
+                    self._device_ct_seed = False
+                    self._device_ct = self._seed_device_ct()
+                else:
+                    self._device_ct = make_state(self._device_ct_bits)
             state = self._device_ct
             with bt.phase("dispatch"):
                 v, red, counters, new_state = process_flows_ct(

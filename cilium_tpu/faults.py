@@ -7,8 +7,9 @@ exercised by tests unless something actually fails mid-batch. This
 module is the failure source: a process-wide registry of NAMED
 injection sites wired into the hot path (h2d staging, XLA dispatch,
 completion pull, CT-epoch advance, kvstore pump, TPU attach, the
-admission gate's queue-full probe, the watchdog's stall sweep) that
-raises classified faults on demand, deterministically.
+admission gate's queue-full probe, the watchdog's stall sweep, the
+state-dir CT-snapshot write) that raises classified faults on demand,
+deterministically.
 
 Cost model (the hub's ``active`` pattern, observe/tracer.py): the hot
 path reads ONE attribute per site visit — ``hub.active`` — and skips
@@ -53,11 +54,12 @@ SITE_KVSTORE = "kvstore"    # SharedStore.pump event drain
 SITE_ATTACH = "attach"      # backend handshake / first compile
 SITE_QUEUE_FULL = "queue_full"  # admission gate: forces over-budget
 SITE_STALL = "stall"        # watchdog sweep: synthesizes a stuck batch
+SITE_STATE_WRITE = "state_write"  # state-dir persistence (CT snapshot)
 
 SITES: Tuple[str, ...] = (
     SITE_H2D, SITE_DISPATCH, SITE_COMPLETE,
     SITE_CT_EPOCH, SITE_KVSTORE, SITE_ATTACH,
-    SITE_QUEUE_FULL, SITE_STALL,
+    SITE_QUEUE_FULL, SITE_STALL, SITE_STATE_WRITE,
 )
 
 KIND_TRANSIENT = "transient"

@@ -469,3 +469,31 @@ cluster_epoch_lag = registry.gauge(
     "over every published node); 0 means this node's last full "
     "rebuild is enforced fleet-wide as far as the exchange can prove",
 )
+
+# -- policyd-survive (restart/drain continuity) families -------------------
+ct_restored_entries_total = registry.counter(
+    "cilium_tpu_ct_restored_entries_total",
+    "Conntrack entries processed by restore paths (label result: kept = "
+    "re-placed live into the table, expired = TTL ran out while the "
+    "process was down or the entry lost its probe neighborhood, "
+    "flushed = dropped whole because the CT snapshot's policy basis "
+    "did not match the restored compiled snapshot)",
+)
+restart_downtime_seconds = registry.gauge(
+    "cilium_tpu_restart_downtime_seconds",
+    "Wall time from the start of restore_state() to the first verdict "
+    "batch completed after a restart (set once per process; the bench "
+    "--chaos restart round reports the same quantity cross-process as "
+    "restart_downtime_ms)",
+)
+drain_seconds = registry.histogram(
+    "cilium_tpu_drain_seconds",
+    "Wall time of one bounded graceful drain (SIGTERM/shutdown): shed "
+    "new admissions, FIFO-complete in-flight verdict + L7 batches "
+    "under the deadline, persist CT + compiled + state.json",
+)
+state_snapshot_bytes = registry.gauge(
+    "cilium_tpu_state_snapshot_bytes",
+    "Bytes of the last state-dir snapshot written (label kind: "
+    "compiled|ct|state_json)",
+)

@@ -15,7 +15,7 @@ from __future__ import annotations
 import json
 from typing import Callable, Dict
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 
 def _v1_to_v2(snap: Dict) -> Dict:
@@ -34,8 +34,19 @@ def _v1_to_v2(snap: Dict) -> Dict:
     return snap
 
 
+def _v2_to_v3(snap: Dict) -> Dict:
+    """v2 → v3 (policyd-survive): add the conntrack-snapshot stanza.
+    v3 state.json records where the CT snapshot lives and the policy
+    basis it was saved against; a v2 file predates CT persistence, so
+    the stanza restores empty — a cold (flushed) conntrack, exactly
+    what a v2 daemon restart produced."""
+    snap.setdefault("ct", {"snapshot": None, "basis": None})
+    return snap
+
+
 MIGRATIONS: Dict[int, Callable[[Dict], Dict]] = {
     1: _v1_to_v2,
+    2: _v2_to_v3,
 }
 
 

@@ -1,0 +1,60 @@
+# policyd: hot
+"""ROBUST003 fixture: non-atomic state-file writes in a hot module.
+
+The positive cases write the final path in place — a crash mid-write
+leaves a torn file for the next restore. The negatives follow the
+atomic idiom (tmp sibling + os.replace), route through tempfile, or
+only read.
+"""
+import os
+import tempfile
+
+
+def positive_plain_write(path, data):
+    with open(path, "w") as f:  # POS: truncates the final file in place
+        f.write(data)
+
+
+def positive_binary_write(state_dir, payload):
+    with open(os.path.join(state_dir, "ct.npz"), "wb") as f:  # POS
+        f.write(payload)
+
+
+def positive_append(path, line):
+    with open(path, "a") as f:  # POS: appends to the final file
+        f.write(line)
+
+
+def positive_mode_kwarg(path, data):
+    with open(path, mode="r+b") as f:  # POS: in-place update
+        f.write(data)
+
+
+def negative_tmp_sibling(path, data):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:  # NEG: tmp sibling, replaced below
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def negative_mkstemp(path, data):
+    fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(path))
+    with open(tmp_path, "w") as f:  # NEG: tempfile-produced path
+        f.write(data)
+    os.replace(tmp_path, path)
+    return fd
+
+
+def negative_reads(path):
+    with open(path) as f:  # NEG: default mode is read
+        a = f.read()
+    with open(path, "rb") as f:  # NEG: binary read
+        b = f.read()
+    return a, b
+
+
+def negative_suppressed(path, data):
+    with open(path, "w") as f:  # policyd-lint: disable=ROBUST003
+        f.write(data)

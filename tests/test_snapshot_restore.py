@@ -7,6 +7,7 @@ normal refresh gate recompiles only when inputs actually move."""
 from __future__ import annotations
 
 import json
+import os
 import random
 
 import jax.numpy as jnp
@@ -177,6 +178,138 @@ class TestSnapshotRoundtrip:
         # engine still functional: a normal refresh works
         engine.refresh(force=True)
         assert engine.device_policy is not None
+
+
+class TestCTSnapshot:
+    """ct.npz beside compiled.npz (policyd-survive): the pinned-CT-map
+    persistence analog. Roundtrip, TTL expiry sweep, and the tolerant
+    loader's torn/foreign-file classification."""
+
+    def _table(self, n=64):
+        from cilium_tpu.datapath.conntrack import FlowConntrack, pack_keys
+
+        rng = np.random.default_rng(11)
+        ct = FlowConntrack(capacity_bits=10)
+        ka, kb, kc = pack_keys(
+            np.zeros(n, np.uint64),
+            rng.integers(1, 1 << 32, n, dtype=np.uint64),
+            (np.arange(n) % 4).astype(np.uint64),
+            (1000 + np.arange(n)).astype(np.uint64),
+            np.full(n, 80, np.uint64),
+            np.full(n, 6, np.uint64),
+            np.zeros(n, np.uint64),
+        )
+        assert ct.create_batch(
+            ka, kb, kc, revnat=np.arange(n).astype(np.uint16)
+        ) == n
+        return ct, (ka, kb, kc)
+
+    def test_roundtrip_entries_basis_revnat(self, tmp_path):
+        from cilium_tpu.datapath.conntrack import (
+            CT_ESTABLISHED,
+            FlowConntrack,
+        )
+        from cilium_tpu.datapath.ct_snapshot import (
+            load_ct_state,
+            save_ct_state,
+        )
+
+        ct, keys = self._table()
+        p = str(tmp_path / "ct.npz")
+        nbytes = save_ct_state(p, ct, basis=(3, 4, 5), ct_epoch=7)
+        assert nbytes == os.path.getsize(p)
+        snap = load_ct_state(p)
+        assert snap is not None
+        assert snap["basis"] == (3, 4, 5)
+        assert snap["ct_epoch"] == 7
+        assert snap["entries"] == 64
+        ct2 = FlowConntrack(capacity_bits=10)
+        kept, expired = ct2.restore_arrays(
+            snap["ka"], snap["kb"], snap["kc"], snap["ttl"],
+            packets=snap["packets"], revnat=snap["revnat"],
+        )
+        assert (kept, expired) == (64, 0)
+        state, _, rev = ct2.lookup_batch(*keys, want_revnat=True)
+        assert (state == CT_ESTABLISHED).all()
+        np.testing.assert_array_equal(rev, np.arange(64).astype(np.uint16))
+
+    def test_restore_sweeps_expired_and_clamps_ttl(self, tmp_path):
+        from cilium_tpu.datapath.conntrack import FlowConntrack
+        from cilium_tpu.datapath.ct_snapshot import (
+            load_ct_state,
+            save_ct_state,
+        )
+
+        ct, _ = self._table()
+        p = str(tmp_path / "ct.npz")
+        save_ct_state(p, ct, basis=(1, 1, 1), ct_epoch=0)
+        snap = load_ct_state(p)
+        # model downtime: the first 10 lifetimes ran out while the
+        # process was dead; one is absurd (corrupt snapshot shape)
+        ttl = snap["ttl"].copy()
+        ttl[:10] = -1.0
+        ttl[10] = 1e9
+        ct2 = FlowConntrack(capacity_bits=10)
+        kept, expired = ct2.restore_arrays(
+            snap["ka"], snap["kb"], snap["kc"], ttl,
+            packets=snap["packets"], revnat=snap["revnat"],
+        )
+        assert (kept, expired) == (54, 10)
+        # the clamp: no restored entry outlives the configured
+        # lifetimes, so a corrupt TTL cannot install an immortal entry
+        import time as _time
+
+        horizon = _time.monotonic() + max(
+            ct2.tcp_lifetime, ct2.other_lifetime
+        )
+        assert float(ct2.expires[ct2.valid].max()) <= horizon + 1.0
+
+    def test_torn_write_fault_leaves_tolerated_file(self, tmp_path):
+        """SITE_STATE_WRITE models rename-persisted-data-lost power
+        loss: the save leaves a TRUNCATED file at the final path and
+        surfaces the fault; the loader classifies it as None (cold
+        flush), never a crash."""
+        from cilium_tpu import faults
+        from cilium_tpu.datapath.ct_snapshot import (
+            load_ct_state,
+            save_ct_state,
+        )
+
+        ct, _ = self._table()
+        p = str(tmp_path / "ct.npz")
+        good = save_ct_state(p, ct, basis=(1, 1, 1), ct_epoch=0)
+        faults.hub.reset()
+        try:
+            faults.hub.fail(
+                faults.SITE_STATE_WRITE, faults.KIND_TRANSIENT, times=1
+            )
+            with pytest.raises(faults.FaultError):
+                save_ct_state(p, ct, basis=(1, 1, 1), ct_epoch=0)
+        finally:
+            faults.hub.reset()
+        assert os.path.getsize(p) < good  # the torn half
+        assert load_ct_state(p) is None
+        # the next (clean) save heals the file in place
+        save_ct_state(p, ct, basis=(1, 1, 1), ct_epoch=0)
+        assert load_ct_state(p) is not None
+
+    def test_loader_tolerates_absent_garbage_and_foreign_schema(
+        self, tmp_path
+    ):
+        from cilium_tpu.datapath.ct_snapshot import load_ct_state
+
+        assert load_ct_state(str(tmp_path / "absent.npz")) is None
+        bad = tmp_path / "bad.npz"
+        bad.write_bytes(b"not an npz at all")
+        assert load_ct_state(str(bad)) is None
+        foreign = str(tmp_path / "foreign.npz")
+        np.savez(
+            foreign,
+            meta=np.frombuffer(
+                json.dumps({"schema": 99}).encode(), np.uint8
+            ).copy(),
+        )
+        assert load_ct_state(foreign) is None
 
 
 def test_restart_with_coincidental_revision_recompiles(tmp_path):

@@ -157,6 +157,28 @@ def test_robust002_verdict_path_stays_clean():
     assert [x for x in f if x.rule == "ROBUST002"] == []
 
 
+def test_robust003_state_writes():
+    f = analyze_paths([fixture("hot_robust003.py")])
+    # plain "w" / "wb" on a joined path / append / mode= kwarg "r+b" —
+    # negatives (tmp sibling, mkstemp path, reads, suppressed) silent
+    assert lines_of(f, "ROBUST003") == [14, 19, 24, 29]
+    assert all(x.severity == "warning" for x in f if x.rule == "ROBUST003")
+    assert len(f) == 4
+
+
+def test_robust003_hot_modules_stay_clean():
+    """The regression gate policyd-survive bought: every state-file
+    write reachable from the verdict path must use the atomic
+    tmp + fsync + os.replace idiom, or a restart restores a torn
+    file."""
+    f = analyze_paths([
+        os.path.join(PKG, "datapath", "pipeline.py"),
+        os.path.join(PKG, "engine.py"),
+        os.path.join(PKG, "ops"),
+    ])
+    assert [x for x in f if x.rule == "ROBUST003"] == []
+
+
 def test_hot_gating_rules_need_hot_module(tmp_path):
     cold = tmp_path / "cold.py"
     cold.write_text(
