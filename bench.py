@@ -417,11 +417,11 @@ def _bench_l7() -> dict:
     return {
         "l7_dfa_rps": round(fused_rps),
         "split_l7_dfa_rps": round(split_rps),
-        "fused_vs_split": round(fused_rps / split_rps, 1),
+        "fused_vs_split_ratio": round(fused_rps / split_rps, 1),
         "rung_rps": rung_rps,
         "pair_table": bool(table.has_pair),
-        "e2e_submit_rps_depth2": round(e2e_d2),
-        "e2e_submit_rps_depth1": round(e2e_d1),
+        "e2e_submit_depth2_rps": round(e2e_d2),
+        "e2e_submit_depth1_rps": round(e2e_d1),
         "overlap_ratio": round(e2e_d2 / e2e_d1, 2),
         "kafka_acl_rps": round(kafka_host),
         "kafka_acl_device_rps": round(kafka_dev),
@@ -1849,8 +1849,8 @@ def _bench_cluster(attached):
     return {
         "nodes": len(names),
         "keys": n_keys,
-        "contended_alloc_ops_s": round(contended_ops / contended_s, 1),
-        "cached_alloc_ops_s": round(10 * n_keys / cached_s, 1),
+        "contended_alloc_rps": round(contended_ops / contended_s, 1),
+        "cached_alloc_rps": round(10 * n_keys / cached_s, 1),
         "epoch_converged": bool(converged),
         "epoch_converge_ms": round(epoch_converge_s * 1e3, 2),
         "alloc_outcomes": {
@@ -1874,7 +1874,7 @@ def _bench_mesh(repo, reg, idents, nrng: np.random.Generator, attached):
       identity count (reduction ≈ the ident factor);
     - verdicts asserted bit-identical 2D vs 1D before any rate is
       reported, so the number can never come from a diverged program;
-    - ``verdicts_vps_2d`` measured through the real pipelined submit
+    - ``verdicts_2d_vps`` measured through the real pipelined submit
       path at depth 2;
     - the OFF path spy-asserted: with 2D off, a fresh batch shape is
       traced with the one-hot ident-gather kernel replaced by a
@@ -1990,12 +1990,12 @@ def _bench_mesh(repo, reg, idents, nrng: np.random.Generator, attached):
         "ident_factor": ident,
         "plan_generation": plan.generation,
         "mesh_2d_formed": bool(plan.is_2d),
-        "verdicts_vps_1d": round(k * b / t_1d),
-        "verdicts_vps_2d": round(k * b / t_2d),
+        "verdicts_1d_vps": round(k * b / t_1d),
+        "verdicts_2d_vps": round(k * b / t_2d),
         "parity_2d_vs_1d": True,  # asserted above, batch-for-batch
         "pm_bytes_per_device_replicated": pm_total,
         "pm_bytes_per_device_sharded": pm_sharded,
-        "pm_bytes_reduction_x": round(pm_total / max(1, pm_sharded), 2),
+        "pm_bytes_reduction_ratio": round(pm_total / max(1, pm_sharded), 2),
         "rt_bytes_per_device_replicated": rt_total,
         "rt_bytes_per_device_sharded": rt_sharded,
         "off_path_spy": off_spy,
@@ -2253,7 +2253,7 @@ def _bench_stretch() -> dict:
         "local_identities": sum(1 for x in idents if x.is_local),
         "rules": n_rules,
         "endpoints": N_ENDPOINTS,
-        "verdicts_per_s": round(vps),
+        "verdicts_vps": round(vps),
         "compile_s": round(compile_s, 1),
         "materialize_s": round(materialize_s, 1),
         "snapshot_save_s": round(save_s, 1),
@@ -2625,34 +2625,50 @@ def _attach_backend(
 
 
 def _lint_preflight() -> None:
-    """``--lint``: refuse the round when the hot path carries NEW
-    policyd-lint findings — a fresh device sync or loop-dispatch would
-    make the numbers lie about the architecture. Same one-line-JSON
-    idiom as the attach watchdog so the refusal is visible in round
-    logs, and it runs BEFORE device attach (pure-AST, costs ~100ms)."""
+    """``--lint``: refuse the round when the package carries NEW
+    policyd-lint findings — a fresh device sync, lock convoy, or
+    contract drift would make the numbers lie about the architecture.
+    Always emits one per-rule finding-count stats line first (no
+    "metric" key, so --diff never mistakes it for the round's record;
+    same backend/host_cpus pair every artifact line carries), then the
+    same one-line-JSON refusal idiom as the attach watchdog when new
+    findings exist. Runs BEFORE device attach (pure-AST, ~1s)."""
     from cilium_tpu.analysis import analyze_paths, default_target
     from cilium_tpu.analysis.baseline import (
         default_baseline_path, load_baseline, new_findings,
     )
 
     counts, _ = load_baseline(default_baseline_path())
-    fresh = new_findings(analyze_paths([default_target()]), counts)
-    hot = [f for f in fresh if f.rule.startswith("TPU")]
-    if not hot:
+    bench_path = os.path.abspath(__file__)
+    findings = analyze_paths([default_target(), bench_path])
+    fresh = new_findings(findings, counts)
+    per_rule: dict = {}
+    for f in findings:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    print(json.dumps({
+        "lint": {
+            "findings_per_rule": dict(sorted(per_rule.items())),
+            "total": len(findings),
+            "new": len(fresh),
+        },
+        # no device attached yet (lint runs first) but the line keeps
+        # the always-present pair every artifact line carries
+        "backend": "unattached",
+        "host_cpus": os.cpu_count(),
+    }), flush=True)
+    if not fresh:
         return
     print(json.dumps({
         "metric": f"policy verdicts/sec at {N_RULES} rules",
         "value": 0,
         "unit": "verdicts/s",
         "vs_baseline": 0.0,
-        # no device attached yet (lint runs first) but the line keeps
-        # the always-present pair every metric line carries
         "backend": "unattached",
         "host_cpus": os.cpu_count(),
         "error": (
-            f"lint pre-flight: {len(hot)} new hot-path finding(s) — "
-            + "; ".join(f.render() for f in hot[:3])
-            + (" ..." if len(hot) > 3 else "")
+            f"lint pre-flight: {len(fresh)} new finding(s) — "
+            + "; ".join(f.render() for f in fresh[:3])
+            + (" ..." if len(fresh) > 3 else "")
             + " — fix or baseline (python -m cilium_tpu.analysis) "
             "before benching"
         ),
@@ -2662,14 +2678,13 @@ def _lint_preflight() -> None:
 
 # ── --diff: bench regression diffing (policyd-prof) ──────────────────
 
-# lower-is-better comes from the unit suffix; anything unmatched is
-# not auto-comparable (flags, depths, counts)
-_DIFF_HIGHER = ("_vps", "_rps", "_lps", "_qps", "_ratio")
-_DIFF_LOWER = ("_ms", "_us", "_ns", "_s", "_pct")
-# environment/bookkeeping keys a slow CI node must never fail a round
-# on; calib_* are the normalizers themselves
-_DIFF_SKIP = ("value", "vs_baseline", "build_s", "compile_s",
-              "host_cpus", "sample_every")
+# the direction vocabulary is a STABLE contract shared with the
+# BENCH001 lint rule — cilium_tpu/contracts.py is the one definition
+from cilium_tpu.contracts import (  # noqa: E402
+    DIFF_HIGHER_SUFFIXES as _DIFF_HIGHER,
+    DIFF_LOWER_SUFFIXES as _DIFF_LOWER,
+    DIFF_SKIP_KEYS as _DIFF_SKIP,
+)
 
 
 def _flag_value(argv, name):
@@ -2927,7 +2942,7 @@ def main() -> None:
         attached.set()
         print(json.dumps({
             "metric": "federated contended identity allocation rate",
-            "value": out["contended_alloc_ops_s"],
+            "value": out["contended_alloc_rps"],
             "unit": "ops/s",
             **out,
             "backend": backend,
@@ -3028,7 +3043,7 @@ def main() -> None:
         attached.set()
         print(json.dumps({
             "metric": f"2D mesh verdicts/sec at {N_RULES} rules",
-            "value": out["verdicts_vps_2d"],
+            "value": out["verdicts_2d_vps"],
             "unit": "verdicts/s",
             **out,
             "backend": backend,
@@ -3240,9 +3255,9 @@ def main() -> None:
     # rounds for the host-side paths — a machine change moves the raw
     # rate and the calibration together, leaving the ratio stable
     calib = max(1.0, envelope["calib_py_loops_per_s"])
-    result["kafka_acl_per_py_loop"] = round(kafka_acl / calib, 4)
+    result["kafka_acl_per_py_loop_ratio"] = round(kafka_acl / calib, 4)
     sha = max(1.0, envelope["calib_sha256_mb_per_s"])
-    result["native_vps_per_sha_mb"] = round(native_vps / sha / 1000, 2)
+    result["native_vps_per_sha_mb_ratio"] = round(native_vps / sha / 1000, 2)
     print(json.dumps(result))
     print(
         json.dumps(

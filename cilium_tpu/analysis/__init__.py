@@ -8,6 +8,10 @@ paper's target (≥100M verdicts/s, p99 <50µs) silently:
   ``hotpath``.
 - Family B (lock discipline): lock-order cycles, blocking ops and
   callbacks under locks, guard inconsistency — see ``locks``.
+- Family C (stable-API contracts): option discipline, stable-literal
+  drift, bench metric-key direction — see ``contracts``. Family C and
+  the one-edge-deep inter-procedural variants of TPU001/LOCK002 run on
+  a package-wide call graph (``callgraph``).
 
 Run ``python -m cilium_tpu.analysis`` (CI gate: exits non-zero on any
 finding not covered by the checked-in ``baseline.json``). See
@@ -20,15 +24,19 @@ from __future__ import annotations
 import os
 from typing import Iterable, List, Optional, Sequence, Set
 
+from .callgraph import CallGraph, build_callgraph
+from .contracts import analyze_contracts
 from .core import Finding, ModuleSource
 from .hotpath import analyze_hotpath
 from .locks import LockIndex, analyze_locks_module, cycle_findings
 from .obsdocs import analyze_obsdocs
 
 __all__ = [
+    "CallGraph",
     "Finding",
     "ModuleSource",
     "analyze_paths",
+    "build_callgraph",
     "collect_files",
     "default_target",
 ]
@@ -60,11 +68,21 @@ def collect_files(paths: Sequence[str]) -> List[str]:
 def analyze_paths(
     paths: Sequence[str],
     rules: Optional[Iterable[str]] = None,
+    restrict: Optional[Iterable[str]] = None,
+    changed: Optional[Iterable[str]] = None,
 ) -> List[Finding]:
-    """Run both rule families over every .py under ``paths``.
+    """Run all three rule families over every .py under ``paths``.
 
     Suppressions (line/file) are already applied; the baseline is NOT —
     callers diff against it via ``baseline.new_findings``.
+
+    ``restrict`` (relpaths) keeps only findings anchored in those
+    files — the whole set is still parsed and graphed (cross-module
+    rules need full context), only the reporting is narrowed.
+    ``changed`` (relpaths) is the incremental mode: the restriction
+    set becomes the changed files plus their direct call-graph
+    dependents (modules importing them), so a changed helper still
+    surfaces the caller-side inter-procedural findings it causes.
     """
     files = collect_files(paths)
     modules: List[ModuleSource] = []
@@ -89,14 +107,26 @@ def analyze_paths(
         index.add_module(mod)
     index.finalize()
 
+    # pass 2: package-wide call graph (inter-procedural TPU001/LOCK002
+    # and Family C consume it)
+    graph = build_callgraph(modules, lock_index=index)
+
     all_edges = []
     for mod in modules:
-        findings.extend(analyze_hotpath(mod))
+        findings.extend(analyze_hotpath(mod, graph=graph))
         findings.extend(analyze_obsdocs(mod))
-        lock_findings, edges = analyze_locks_module(mod, index)
+        lock_findings, edges = analyze_locks_module(mod, index, graph=graph)
         findings.extend(lock_findings)
         all_edges.extend(edges)
     findings.extend(cycle_findings(all_edges))
+    findings.extend(analyze_contracts(modules, graph))
+
+    if changed is not None:
+        closure = graph.dependents_of(list(changed))
+        restrict = closure if restrict is None else set(restrict) | closure
+    if restrict is not None:
+        keep_paths = set(restrict)
+        findings = [f for f in findings if f.path in keep_paths]
 
     # apply suppressions (cycle findings self-filter on edge sites,
     # but their anchor line suppression is honored here too)

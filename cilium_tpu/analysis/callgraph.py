@@ -1,0 +1,506 @@
+"""Cross-module symbol table + call graph for policyd-lint.
+
+The per-function analyzers (``hotpath``, ``locks``) see one body at a
+time, so a helper in ``ops/`` doing the ``.item()`` for a caller in
+``datapath/pipeline.py`` is invisible to both. This module builds the
+package-wide view the inter-procedural rules consume:
+
+- a symbol table of every module-level function, class, and method in
+  the analyzed set, keyed ``"pkg.mod:func"`` / ``"pkg.mod:Class.meth"``;
+- import resolution (absolute, relative, aliased, ``from X import Y``)
+  against the analyzed set only — nothing outside the set (jax, numpy,
+  stdlib) ever resolves, by design;
+- method binding for ``self.m()``, for locals typed by construction
+  (``e = Engine(...)``; ``e.run()``), and for module-level singletons
+  (``hub = FaultHub()`` in one module, ``faults.hub.enable()`` in
+  another);
+- per-function effect summaries: which parameters the body host-pulls
+  (``int(x)`` / ``x.item()`` / ``np.asarray(x)`` — feeds TPU001 one
+  edge deep) and which blocking operations it performs (``open`` /
+  subprocess / socket / sleep / ``block_until_ready`` — feeds LOCK002
+  one edge deep);
+- held-context lifted from ``locks.LockIndex``: a callee whose every
+  entry already assumes a lock held (``*_locked`` naming or the
+  all-call-sites fixpoint) reports its blocking sites directly, so the
+  caller-side propagation skips it rather than double-reporting.
+
+Resolution is deliberately conservative: a call resolves only through
+an explicit chain of evidence (import alias, constructor-typed name,
+``self``). There is no resolve-by-method-name fallback, so the graph
+adds edges, never guesses them.
+
+Everything here is pure stdlib; the graph is built once per
+``analyze_paths`` run and shared by every rule (and by the CLI's
+``--changed`` dependent closure).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import ModuleSource, attr_chain, call_name, walk_skipping
+from .locks import LockIndex, blocking_kind
+
+# host-pull shapes a summary records on a parameter (mirrors the
+# hotpath TPU001 vocabulary — kept small so a summary hit is always a
+# guaranteed sync, never a maybe)
+_COERCIONS = {"int", "float", "bool"}
+_NP_SYNC_FUNCS = {"asarray", "array", "copy"}
+_SYNC_METHODS = {"item", "tolist", "__array__"}
+_NP_MODULES = {"numpy"}
+
+
+def module_name_of(mod: ModuleSource) -> str:
+    """Dotted module name derived from the package-relative path
+    (``cilium_tpu/ops/verdict.py`` → ``cilium_tpu.ops.verdict``)."""
+    rel = mod.relpath
+    if rel.endswith("/__init__.py"):
+        rel = rel[: -len("/__init__.py")]
+    elif rel.endswith(".py"):
+        rel = rel[:-3]
+    return rel.replace("/", ".")
+
+
+class FuncInfo:
+    """One function/method in the symbol table, with its effect
+    summaries."""
+
+    __slots__ = (
+        "qual", "mod", "node", "cls_name", "params",
+        "pull_params", "blocking", "held_on_entry", "calls",
+    )
+
+    def __init__(
+        self,
+        qual: str,
+        mod: ModuleSource,
+        node: ast.AST,
+        cls_name: Optional[str],
+    ) -> None:
+        self.qual = qual
+        self.mod = mod
+        self.node = node
+        self.cls_name = cls_name
+        args = node.args
+        names = [
+            a.arg
+            for a in list(args.posonlyargs) + list(args.args)
+            + list(args.kwonlyargs)
+        ]
+        if cls_name is not None and names and names[0] in ("self", "cls"):
+            names = names[1:]
+        self.params: List[str] = names
+        # param name -> (line, pull shape) for host pulls ON the param
+        self.pull_params: Dict[str, Tuple[int, str]] = {}
+        # (line, kind, call name) for blocking ops in the body
+        self.blocking: List[Tuple[int, str, str]] = []
+        # locks assumed held on entry (lifted from LockIndex.finalize)
+        self.held_on_entry: Tuple[str, ...] = ()
+        # resolved callee quals (call-graph edges out of this body)
+        self.calls: List[str] = []
+
+    @property
+    def display(self) -> str:
+        leaf = self.qual.split(":", 1)[1]
+        return leaf
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<FuncInfo {self.qual}>"
+
+
+class _ModuleSymbols:
+    """Per-module import aliases + top-level defs."""
+
+    def __init__(self, mod: ModuleSource, name: str) -> None:
+        self.mod = mod
+        self.name = name
+        # local alias -> dotted module name (may be outside the set)
+        self.mod_aliases: Dict[str, str] = {}
+        # local alias -> (dotted module, symbol name)
+        self.sym_aliases: Dict[str, Tuple[str, str]] = {}
+        # module-level names -> class qual ("mod:Class") by construction
+        self.var_types: Dict[str, str] = {}
+        self.np_aliases: Set[str] = set()
+
+    def package(self) -> str:
+        if self.mod.relpath.endswith("/__init__.py"):
+            return self.name
+        return self.name.rpartition(".")[0]
+
+
+class CallGraph:
+    """Package-wide symbol table, resolved call edges, and summaries."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleSource] = {}  # dotted name -> mod
+        self.symbols: Dict[str, _ModuleSymbols] = {}
+        self.functions: Dict[str, FuncInfo] = {}  # qual -> info
+        # class qual "mod:Class" -> {method name -> func qual}
+        self.classes: Dict[str, Dict[str, str]] = {}
+        # id(ast.Call) -> resolved callee (the analyzers' entry point)
+        self.resolved: Dict[int, FuncInfo] = {}
+        # dotted module -> analyzed modules it imports from
+        self.module_deps: Dict[str, Set[str]] = {}
+
+    # -- queries ----------------------------------------------------------
+    def resolved_callee(self, call: ast.Call) -> Optional[FuncInfo]:
+        return self.resolved.get(id(call))
+
+    def dependents_of(self, relpaths: Iterable[str]) -> Set[str]:
+        """Relpaths of modules that directly import any of ``relpaths``
+        (the --changed closure: changed files + one reverse edge)."""
+        by_rel = {m.relpath: name for name, m in self.modules.items()}
+        changed = {by_rel[r] for r in relpaths if r in by_rel}
+        out = set(relpaths)
+        for name, deps in self.module_deps.items():
+            if deps & changed:
+                out.add(self.modules[name].relpath)
+        return out
+
+    # -- construction -----------------------------------------------------
+    def build(
+        self,
+        modules: Sequence[ModuleSource],
+        lock_index: Optional[LockIndex] = None,
+    ) -> "CallGraph":
+        for mod in modules:
+            name = module_name_of(mod)
+            self.modules[name] = mod
+            self.symbols[name] = _ModuleSymbols(mod, name)
+        for name in self.modules:
+            self._collect_defs(name)
+        for name in self.modules:
+            self._collect_imports(name)
+        # module-level singletons need aliases, so a third pass
+        for name in self.modules:
+            self._collect_module_vars(name)
+        for name in self.modules:
+            self._resolve_module(name)
+        self._summarize()
+        if lock_index is not None:
+            self._lift_held_context(lock_index)
+        return self
+
+    def _collect_defs(self, name: str) -> None:
+        mod = self.modules[name]
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{name}:{node.name}"
+                self.functions[qual] = FuncInfo(qual, mod, node, None)
+            elif isinstance(node, ast.ClassDef):
+                cqual = f"{name}:{node.name}"
+                methods: Dict[str, str] = {}
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        mq = f"{name}:{node.name}.{item.name}"
+                        self.functions[mq] = FuncInfo(
+                            mq, mod, item, node.name
+                        )
+                        methods[item.name] = mq
+                self.classes[cqual] = methods
+
+    def _collect_imports(self, name: str) -> None:
+        sym = self.symbols[name]
+        deps = self.module_deps.setdefault(name, set())
+        for node in ast.walk(sym.mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in _NP_MODULES:
+                        sym.np_aliases.add(a.asname or a.name)
+                        continue
+                    if a.asname:
+                        sym.mod_aliases[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        sym.mod_aliases.setdefault(root, root)
+                    self._note_dep(deps, a.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = sym.package()
+                    for _ in range(node.level - 1):
+                        base = base.rpartition(".")[0]
+                    prefix = (
+                        f"{base}.{node.module}" if node.module else base
+                    )
+                else:
+                    prefix = node.module or ""
+                if prefix in _NP_MODULES:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    full = f"{prefix}.{a.name}" if prefix else a.name
+                    if full in self.modules:
+                        sym.mod_aliases[local] = full
+                        deps.add(full)
+                    else:
+                        sym.sym_aliases[local] = (prefix, a.name)
+                        self._note_dep(deps, prefix)
+
+    def _note_dep(self, deps: Set[str], target: str) -> None:
+        # an import of pkg.sub counts as depending on every analyzed
+        # prefix (pkg/__init__.py re-exports make the prefix real)
+        parts = target.split(".")
+        for i in range(1, len(parts) + 1):
+            cand = ".".join(parts[:i])
+            if cand in self.modules:
+                deps.add(cand)
+
+    def _collect_module_vars(self, name: str) -> None:
+        sym = self.symbols[name]
+        for node in sym.mod.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                hit = self._lookup_chain(
+                    name, attr_chain(node.value.func), None, None
+                )
+                if hit and hit[0] == "class":
+                    sym.var_types[node.targets[0].id] = hit[1]
+
+    # -- resolution -------------------------------------------------------
+    def _lookup(
+        self, modname: str, parts: Sequence[str]
+    ) -> Optional[Tuple[str, str]]:
+        """Resolve ``parts`` inside analyzed module ``modname``:
+        ("func", qual) / ("class", qual) / None. Walks into submodules
+        while the prefix names one."""
+        parts = list(parts)
+        while parts and f"{modname}.{parts[0]}" in self.modules:
+            modname = f"{modname}.{parts[0]}"
+            parts.pop(0)
+        if modname not in self.modules:
+            return None
+        if len(parts) == 1:
+            leaf = parts[0]
+            if f"{modname}:{leaf}" in self.functions:
+                return ("func", f"{modname}:{leaf}")
+            if f"{modname}:{leaf}" in self.classes:
+                return ("class", f"{modname}:{leaf}")
+            sym = self.symbols[modname]
+            if leaf in sym.var_types:
+                return ("class", sym.var_types[leaf])
+            # re-exported symbol: follow one alias hop
+            if leaf in sym.sym_aliases:
+                tmod, tname = sym.sym_aliases[leaf]
+                if tmod in self.modules:
+                    return self._lookup(tmod, [tname])
+            if leaf in sym.mod_aliases and sym.mod_aliases[leaf] in self.modules:
+                return ("module", sym.mod_aliases[leaf])
+            return None
+        if len(parts) == 2:
+            first, second = parts
+            # Class.method
+            meth = self.classes.get(f"{modname}:{first}", {}).get(second)
+            if meth:
+                return ("func", meth)
+            # module-level instance: singleton.method()
+            sym = self.symbols[modname]
+            inst_cls = sym.var_types.get(first)
+            if inst_cls:
+                meth = self.classes.get(inst_cls, {}).get(second)
+                if meth:
+                    return ("func", meth)
+        return None
+
+    def _lookup_chain(
+        self,
+        modname: str,
+        chain: Optional[List[str]],
+        cls_name: Optional[str],
+        local_types: Optional[Dict[str, str]],
+    ) -> Optional[Tuple[str, str]]:
+        if not chain:
+            return None
+        sym = self.symbols[modname]
+        root = chain[0]
+        if root == "self" and cls_name is not None and len(chain) == 2:
+            meth = self.classes.get(f"{modname}:{cls_name}", {}).get(
+                chain[1]
+            )
+            return ("func", meth) if meth else None
+        if local_types and root in local_types and len(chain) == 2:
+            meth = self.classes.get(local_types[root], {}).get(chain[1])
+            return ("func", meth) if meth else None
+        if root in sym.var_types and len(chain) == 2:
+            meth = self.classes.get(sym.var_types[root], {}).get(chain[1])
+            return ("func", meth) if meth else None
+        if root in sym.sym_aliases:
+            tmod, tname = sym.sym_aliases[root]
+            if tmod in self.modules:
+                return self._lookup(tmod, [tname] + chain[1:])
+            return None
+        if root in sym.mod_aliases:
+            target = sym.mod_aliases[root]
+            rest = chain[1:]
+            if target in self.modules:
+                # _lookup walks into submodules, so ``import pkg`` +
+                # ``pkg.sub.f()`` resolves when pkg/__init__ is analyzed
+                return (
+                    self._lookup(target, rest) if rest
+                    else ("module", target)
+                )
+            return None
+        # same-module bare name
+        if len(chain) <= 2:
+            return self._lookup(modname, chain)
+        return None
+
+    def _resolve_module(self, name: str) -> None:
+        mod = self.modules[name]
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._resolve_function(name, node, None)
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        self._resolve_function(name, item, node.name)
+
+    def _resolve_function(
+        self, modname: str, func: ast.AST, cls_name: Optional[str]
+    ) -> None:
+        qual = (
+            f"{modname}:{cls_name}.{func.name}" if cls_name
+            else f"{modname}:{func.name}"
+        )
+        info = self.functions.get(qual)
+        local_types: Dict[str, str] = {}
+        # statement-ordered walk so ``e = Engine(); e.run()`` types e
+        # before the method call resolves
+        for node in ast.walk(func):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                hit = self._lookup_chain(
+                    modname, attr_chain(node.value.func), cls_name,
+                    local_types,
+                )
+                if hit and hit[0] == "class":
+                    local_types[node.targets[0].id] = hit[1]
+        for node in walk_skipping(
+            func, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            if node is func or not isinstance(node, ast.Call):
+                continue
+            hit = self._lookup_chain(
+                modname, attr_chain(node.func), cls_name, local_types
+            )
+            if hit is None:
+                continue
+            kind, target = hit
+            if kind == "class":
+                # constructor call: bind to __init__ when it exists
+                target = self.classes.get(target, {}).get("__init__")
+                if target is None:
+                    continue
+                kind = "func"
+            if kind != "func":
+                continue
+            callee = self.functions.get(target)
+            if callee is None or callee.node is func:
+                continue
+            self.resolved[id(node)] = callee
+            if info is not None:
+                info.calls.append(target)
+
+    # -- summaries --------------------------------------------------------
+    def _summarize(self) -> None:
+        for info in self.functions.values():
+            sym = self.symbols[module_name_of(info.mod)]
+            params = set(info.params)
+            for node in walk_skipping(
+                info.node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+            ):
+                if node is not info.node and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = blocking_kind(node)
+                if kind is not None:
+                    self._record_blocking(info, node, kind)
+                self._record_pull(info, sym, params, node)
+
+    @staticmethod
+    def _param_of(expr: ast.AST, params: Set[str]) -> Optional[str]:
+        while isinstance(expr, ast.Subscript):
+            expr = expr.value
+        if isinstance(expr, ast.Name) and expr.id in params:
+            return expr.id
+        return None
+
+    def _record_pull(
+        self,
+        info: FuncInfo,
+        sym: _ModuleSymbols,
+        params: Set[str],
+        node: ast.Call,
+    ) -> None:
+        fchain = attr_chain(node.func)
+        # param.item() / param.tolist() / param.block_until_ready()
+        if isinstance(node.func, ast.Attribute):
+            p = self._param_of(node.func.value, params)
+            if p is not None and (
+                node.func.attr in _SYNC_METHODS
+                or node.func.attr == "block_until_ready"
+            ):
+                info.pull_params.setdefault(
+                    p, (node.lineno, f".{node.func.attr}()")
+                )
+                return
+        if not fchain or not node.args:
+            return
+        p = self._param_of(node.args[0], params)
+        if p is None:
+            return
+        if len(fchain) == 1 and fchain[0] in _COERCIONS:
+            info.pull_params.setdefault(p, (node.lineno, f"{fchain[0]}()"))
+        elif (
+            len(fchain) == 2
+            and fchain[0] in sym.np_aliases
+            and fchain[1] in _NP_SYNC_FUNCS
+        ):
+            info.pull_params.setdefault(
+                p, (node.lineno, f"{'.'.join(fchain)}()")
+            )
+        elif fchain[-1] == "block_until_ready":
+            info.pull_params.setdefault(
+                p, (node.lineno, "block_until_ready()")
+            )
+
+    def _record_blocking(
+        self, info: FuncInfo, node: ast.Call, kind_name: Tuple[str, str]
+    ) -> None:
+        kind, cn = kind_name
+        info.blocking.append((node.lineno, kind, cn))
+
+    def _lift_held_context(self, index: LockIndex) -> None:
+        for ci in index.classes:
+            modname = module_name_of(ci.mod)
+            for mname, held in ci.assumed_held.items():
+                info = self.functions.get(
+                    f"{modname}:{ci.name}.{mname}"
+                )
+                if info is not None:
+                    info.held_on_entry = held
+
+
+def build_callgraph(
+    modules: Sequence[ModuleSource],
+    lock_index: Optional[LockIndex] = None,
+) -> CallGraph:
+    return CallGraph().build(modules, lock_index)

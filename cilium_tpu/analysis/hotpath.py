@@ -214,11 +214,13 @@ class _FuncTaint:
         jit_names: Set[str],
         func: ast.AST,
         findings: List[Finding],
+        graph=None,
     ) -> None:
         self.mod = mod
         self.imports = imports
         self.jit_names = jit_names
         self.findings = findings
+        self.graph = graph
         self.device: Set[str] = set()
         self.arrayish: Set[str] = set()
         args = func.args
@@ -367,6 +369,7 @@ class _FuncTaint:
     def _check_call(self, node: ast.Call) -> None:
         fchain = attr_chain(node.func)
         fname = ".".join(fchain) if fchain else None
+        self._check_callee_pull(node)
 
         # .item() / .tolist() on a device-tainted value
         if (
@@ -419,6 +422,41 @@ class _FuncTaint:
                     "syncs if the array is device-resident; coerce on "
                     "numpy before device_put or hoist off the hot path",
                 )
+
+    def _check_callee_pull(self, node: ast.Call) -> None:
+        """Inter-procedural TPU001, one call-graph edge deep: a device
+        value handed to a resolved callee whose body host-pulls that
+        parameter. The sync is exactly as real as a local ``int(x)`` —
+        it just happens one frame down, often in another module."""
+        if self.graph is None:
+            return
+        callee = self.graph.resolved_callee(node)
+        if callee is None or not callee.pull_params:
+            return
+        bindings = []
+        for i, arg in enumerate(node.args):
+            if isinstance(arg, ast.Starred):
+                break  # positions after *args are unknowable
+            if i < len(callee.params):
+                bindings.append((callee.params[i], arg))
+        for kw in node.keywords:
+            if kw.arg is not None:
+                bindings.append((kw.arg, kw.value))
+        for pname, arg in bindings:
+            pull = callee.pull_params.get(pname)
+            if pull is None or not self._mentions_device(arg):
+                continue
+            line, how = pull
+            self._emit(
+                node,
+                SEV_ERROR,
+                f"passes a device value to {callee.display}() which "
+                f"host-pulls it ({how} on '{pname}' at "
+                f"{callee.mod.relpath}:{line}) — the sync happens one "
+                "call away; pull once at the intended host boundary or "
+                "keep the helper on device",
+            )
+            return  # one finding per call site is enough signal
 
     def _emit(self, node: ast.AST, severity: str, message: str) -> None:
         self.findings.append(
@@ -913,10 +951,12 @@ def _check_state_writes(mod: ModuleSource, findings: List[Finding]) -> None:
 # ---------------------------------------------------------------------------
 
 
-def analyze_hotpath(mod: ModuleSource) -> List[Finding]:
+def analyze_hotpath(mod: ModuleSource, graph=None) -> List[Finding]:
     """Run Family A over one module. TPU003 applies everywhere (jit
     closures are a correctness bug wherever they live); the rest only
-    fire inside hot modules."""
+    fire inside hot modules. With a call graph, TPU001 additionally
+    follows device values one resolved call deep into helpers that
+    host-pull them."""
     findings: List[Finding] = []
     imports = _Imports(mod.tree)
     _check_jit_globals(mod, imports, mod.tree, findings)
@@ -924,7 +964,8 @@ def analyze_hotpath(mod: ModuleSource) -> List[Finding]:
         jit_names = _collect_jit_names(mod.tree, imports)
         for node in ast.walk(mod.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                _FuncTaint(mod, imports, jit_names, node, findings)
+                _FuncTaint(mod, imports, jit_names, node, findings,
+                           graph=graph)
                 _check_loops(mod, imports, node, findings)
                 if _is_refresh_marked(mod, node):
                     _RefreshPull(mod, imports, node, findings)

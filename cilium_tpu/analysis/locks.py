@@ -95,6 +95,25 @@ _GENERIC_METHODS = {
 }
 
 
+def blocking_kind(node: ast.Call) -> Optional[Tuple[str, str]]:
+    """(kind, dotted call name) when ``node`` is a blocking operation
+    per the LOCK002 vocabulary, else None. Shared with the call-graph
+    effect summaries so caller-side propagation and direct findings
+    can never disagree on what "blocking" means."""
+    cn = call_name(node) or ""
+    for pat, k in BLOCKING_CALLS:
+        if pat.endswith("."):
+            if cn.startswith(pat) or ("." + pat) in ("." + cn):
+                return (k, cn)
+        elif cn == pat or cn.endswith("." + pat):
+            return (k, cn)
+    if isinstance(node.func, ast.Attribute):
+        k = BLOCKING_METHODS.get(node.func.attr)
+        if k:
+            return (k, cn or node.func.attr)
+    return None
+
+
 def _is_lock_expr(expr: ast.AST) -> Optional[str]:
     """Lock identity for a with-item / acquire receiver, or None.
 
@@ -262,10 +281,12 @@ class _MethodWalk:
             Dict[str, List[Tuple[str, Tuple[str, ...]]]]
         ] = None,
         entry_held: Tuple[str, ...] = (),
+        graph=None,
     ) -> None:
         self.mod = mod
         self.ci = ci
         self.index = index
+        self.graph = graph
         self.func = func
         self.findings = findings
         self.edges = edges
@@ -457,31 +478,55 @@ class _MethodWalk:
                     )
 
     def _check_blocking(self, node: ast.Call, held) -> None:
-        cn = call_name(node) or ""
-        kind = None
-        for pat, k in BLOCKING_CALLS:
-            if pat.endswith("."):
-                if cn.startswith(pat) or ("." + pat) in ("." + cn):
-                    kind = k
-                    break
-            elif cn == pat or cn.endswith("." + pat):
-                kind = k
-                break
-        if kind is None and isinstance(node.func, ast.Attribute):
-            k = BLOCKING_METHODS.get(node.func.attr)
-            if k:
-                kind = k
-        if kind is None:
+        hit = blocking_kind(node)
+        if hit is None:
+            self._check_blocking_via_callee(node, held)
             return
+        kind, cn = hit
         self.findings.append(
             self.mod.finding(
                 "LOCK002",
                 SEV_ERROR,
                 node.lineno,
-                f"{kind} call ({cn or node.func.attr}) while holding "
+                f"{kind} call ({cn}) while holding "
                 f"{', '.join(held)} in {self.where} — every thread "
                 "contending on the lock convoys behind it; move the "
                 "blocking work outside the critical section",
+            )
+        )
+
+    def _check_blocking_via_callee(self, node: ast.Call, held) -> None:
+        """Inter-procedural LOCK002, one call-graph edge deep: the call
+        itself is innocuous but the resolved callee's body blocks.
+        Same-class callees are skipped (the held-context fixpoint
+        analyzes those bodies with the lock as entry state, so their
+        blocking sites already report directly), as are callees that
+        assume a lock held on entry for the same reason."""
+        if self.graph is None:
+            return
+        callee = self.graph.resolved_callee(node)
+        if callee is None or not callee.blocking or callee.held_on_entry:
+            return
+        if (
+            callee.mod.path == self.mod.path
+            and callee.cls_name == self.ci.name
+        ):
+            return
+        line, kind, cn = callee.blocking[0]
+        more = len(callee.blocking) - 1
+        self.findings.append(
+            self.mod.finding(
+                "LOCK002",
+                SEV_ERROR,
+                node.lineno,
+                f"call to {callee.display}() while holding "
+                f"{', '.join(held)} in {self.where} — the callee "
+                f"performs a {kind} call ({cn}) at "
+                f"{callee.mod.relpath}:{line}"
+                + (f" (+{more} more)" if more > 0 else "")
+                + "; the block happens one call away — move the call "
+                "outside the critical section or suppress with the "
+                "invariant written out",
             )
         )
 
@@ -586,9 +631,11 @@ def _cycles(edges: List[_Edge]) -> List[List[_Edge]]:
 
 
 def analyze_locks_module(
-    mod: ModuleSource, index: LockIndex
+    mod: ModuleSource, index: LockIndex, graph=None
 ) -> Tuple[List[Finding], List[_Edge]]:
-    """LOCK002/003/004 findings + acquisition edges for one module."""
+    """LOCK002/003/004 findings + acquisition edges for one module.
+    With a call graph, LOCK002 additionally propagates one edge deep
+    (a held-lock call into a callee whose body blocks)."""
     findings: List[Finding] = []
     edges: List[_Edge] = []
     for cls in ast.walk(mod.tree):
@@ -606,6 +653,7 @@ def analyze_locks_module(
             _MethodWalk(
                 mod, ci, index, mnode, findings, edges, mutations,
                 entry_held=ci.assumed_held.get(mname, ()),
+                graph=graph,
             )
         if ci.lock_attrs:
             _guard_inconsistency(mod, ci, mutations, findings)

@@ -189,10 +189,25 @@ class Daemon:
         self.options.set("MeshSharding2D", cfg.mesh_sharding_2d)
         self.options.set("EpochSwap", cfg.policy_epoch_swap)
         self.options.on_change(self._on_option_change)
-        # L7DeviceBatch's boot value needs its side effect (the shared
-        # L7 pipeline), so it is seeded AFTER on_change is wired
-        if cfg.l7_device_batch:
-            self.options.set("L7DeviceBatch", True)
+        # the remaining datapath-gated options need their on_change
+        # side effect (pipeline setters / shared L7 pipeline / fault
+        # hub), so their boot values seed AFTER on_change is wired;
+        # contracts.OPTION_BOOT_FIELDS pairs each with its field and
+        # rule OPT001 machine-checks the pairing
+        for opt_name, boot_on in (
+            ("L7DeviceBatch", cfg.l7_device_batch),
+            ("PolicyVerdictNotification", cfg.policy_verdict_notification),
+            ("PhaseTracing", cfg.phase_tracing),
+            ("FlowAttribution", cfg.flow_attribution),
+            ("DispatchAutoTune", cfg.dispatch_autotune),
+            ("FailOpen", cfg.fail_open),
+            ("AdmissionControl", cfg.admission_control),
+            ("Prefilter", cfg.prefilter_shed),
+            ("DeviceProfiling", cfg.device_profiling),
+            ("FaultInjection", cfg.fault_injection),
+        ):
+            if boot_on:
+                self.options.set(opt_name, True)
         # fleet regeneration is synchronous by default (tests and
         # small deployments observe effects immediately); a busy node
         # sets regen_debounce > 0 to fold bursts of endpoint churn
@@ -815,7 +830,7 @@ class Daemon:
             "FlowAttribution", "DispatchAutoTune", "FailOpen",
             "FaultInjection", "EpochSwap", "L7DeviceBatch",
             "AdmissionControl", "Prefilter", "DeviceProfiling",
-            "ClusterFederation",
+            "ClusterFederation", "PolicyVerdictNotification",
         }
     )
 
@@ -834,6 +849,11 @@ class Daemon:
             self.pipeline.conntrack = self.conntrack if value else None
         elif name == "DropNotification":
             self.pipeline.drop_notifications = value
+        elif name == "PolicyVerdictNotification":
+            # per-verdict monitor events (pkg/monitor PolicyVerdict
+            # notifications): one PolicyVerdictNotify per sampled flow
+            # on the event path; OFF keeps the emit loop untouched
+            self.pipeline.verdict_notifications = value
         elif name == "PhaseTracing":
             # policyd-trace: span tracing on the verdict path
             if value:
@@ -1377,7 +1397,11 @@ class Daemon:
                         },
                     }
                     json.dump(body, f, indent=1)
-                os.replace(tmp, os.path.join(self.state_dir, "state.json"))
+                # _save_lock is a single-purpose snapshot-serialization
+                # lock (CLI save vs shutdown poller); holding it across
+                # the atomic tmp+rename IS its job — no verdict-path
+                # thread ever contends on it
+                os.replace(tmp, os.path.join(self.state_dir, "state.json"))  # policyd-lint: disable=LOCK002
                 metrics.state_snapshot_bytes.set(
                     float(os.path.getsize(
                         os.path.join(self.state_dir, "state.json")
@@ -1478,7 +1502,11 @@ class Daemon:
             from .datapath.ct_snapshot import save_ct_state
 
             try:
-                nbytes = save_ct_state(
+                # same _save_lock invariant as save_state above: the
+                # callee's tmp+fsync+rename is exactly what the lock
+                # serializes (snapshot writers), so the one-call-away
+                # file I/O is the design, not a convoy
+                nbytes = save_ct_state(  # policyd-lint: disable=LOCK002
                     os.path.join(self.state_dir, "ct.npz"),
                     self.conntrack,
                     basis=basis,

@@ -568,9 +568,10 @@ def _bucket_multiple(n: int, ndev: int, floor: int = 1024) -> int:
 # traffic — so the jit shape-bucket count is bounded by construction at
 # len(BUCKET_LADDER) per static-arg combination, and a rung warmed by
 # any batch stays reusable by every later batch. STABLE CONTRACT
-# (ROADMAP): changing the rungs invalidates every warm compiled
-# program and the staging-pool sizing.
-BUCKET_LADDER = (1024, 2048, 4096, 8192)
+# (ROADMAP): the rungs live in cilium_tpu/contracts.py (single source
+# of truth, machine-checked by rule API001) because changing them
+# invalidates every warm compiled program and the staging-pool sizing.
+from ..contracts import BUCKET_LADDER
 
 
 def _ladder_rungs(ndev: int, ladder: Tuple[int, ...] = BUCKET_LADDER):
@@ -848,6 +849,10 @@ class DatapathPipeline:
         # by the DropNotification runtime option.
         self.trace_enabled = False
         self.drop_notifications = True
+        # PolicyVerdictNotify for EVERY flow (allowed included) is
+        # opt-in (PolicyVerdictNotification runtime option) — it walks
+        # the whole batch, so it stays off unless asked for
+        self.verdict_notifications = False
         # optional per-endpoint option resolver:
         # fn(endpoint_id, option_name, default) -> bool. The daemon
         # points this at each endpoint's OptionMap so `cilium endpoint
@@ -2060,15 +2065,20 @@ class DatapathPipeline:
                     raise RuntimeError("reserved:world identity has no device row")
                 # sharding-aware upload (ops/lpm.py place_table):
                 # tries are replicated across the verdict mesh — every
-                # flow shard walks the whole trie
+                # flow shard walks the whole trie. The device_put runs
+                # under _lock BY DESIGN: rebuild() is the control
+                # plane's table swap, and publishing a trie ref before
+                # its device buffers exist would hand the verdict path
+                # a half-placed table (EpochSwap is the stall-free
+                # alternative; this is the non-shadow path).
                 tsh = self._table_sharding
                 self._tries = (
                     tuple(
-                        place_table(a, tsh)
+                        place_table(a, tsh)  # policyd-lint: disable=LOCK002
                         for a in (*pf_wide, *ip_wide, *merged)
                     ),
-                    tuple(place_table(a, tsh) for a in (*pf6, *ip6, *merged6)),
-                    place_table(np.int32(world_row), tsh),
+                    tuple(place_table(a, tsh) for a in (*pf6, *ip6, *merged6)),  # policyd-lint: disable=LOCK002
+                    place_table(np.int32(world_row), tsh),  # policyd-lint: disable=LOCK002
                 )
                 self._trie_versions = trie_versions
 
@@ -2228,9 +2238,11 @@ class DatapathPipeline:
                         shed_tab = compile_shed_table(
                             mat_in.allow_nc, mat_in.ep_slots
                         )
+                        # placed under _lock by design: same publish-
+                        # whole-tables invariant as the trie upload
                         self._shed_cache = (
                             gen,
-                            place_table(shed_tab, self._table_sharding),
+                            place_table(shed_tab, self._table_sharding),  # policyd-lint: disable=LOCK002
                         )
                     shed_el = self._shed_cache[1]
             else:
@@ -2395,12 +2407,15 @@ class DatapathPipeline:
         gen, src, placed = self._placed_pm.get(direction, (-1, None, None))
         if src is pm and gen == plan.generation:
             return placed
+        # identity-cached: the callee's device_put fires only when a
+        # rebuild swapped the policymap (same publish-whole-tables
+        # invariant and same _lock as the trie upload in rebuild)
         if plan.is_2d:
-            placed = shard_tables_ident(
+            placed = shard_tables_ident(  # policyd-lint: disable=LOCK002
                 pm, plan.ident_sharding, plan.table_sharding
             )
         else:
-            placed = replicate_tables(pm, plan.table_sharding)
+            placed = replicate_tables(pm, plan.table_sharding)  # policyd-lint: disable=LOCK002
         self._placed_pm[direction] = (plan.generation, pm, placed)
         return placed
 
@@ -2695,9 +2710,12 @@ class DatapathPipeline:
             REASON_POLICY_NO_L3,
             REASON_POLICY_NO_L4,
             REASON_PREFILTER,
+            REASON_PROXY_REDIRECT,
+            REASON_UNKNOWN,
             TRACE_TO_ENDPOINT,
             TRACE_TO_PROXY,
             DropNotify,
+            PolicyVerdictNotify,
             TraceNotify,
         )
         import ipaddress as _ipa
@@ -2782,6 +2800,41 @@ class DatapathPipeline:
                         ingress=ingress,
                     )
                 )
+        # PolicyVerdictNotify reports EVERY flow's decision, allowed
+        # flows included — same skip-unless-possibly-on contract as the
+        # trace walk (this whole function is listener-gated cold path)
+        vn_possible = (
+            self.verdict_notifications or self.endpoint_options is not None
+        )
+        for i in range(len(verdict)) if vn_possible else ():
+            if not _opt(
+                _ep(i), "PolicyVerdictNotification",
+                self.verdict_notifications,
+            ):
+                continue
+            code = int(verdict[i])
+            if code == FORWARD:
+                if redirect is not None and bool(redirect[i]):
+                    action, reason = 2, REASON_PROXY_REDIRECT
+                else:
+                    action, reason = 1, REASON_UNKNOWN
+            else:
+                action, reason = 0, _reason(i)
+            addr = bytes(int(b) & 0xFF for b in peer_bytes[i])
+            events.append(
+                PolicyVerdictNotify(
+                    action=action,
+                    reason=reason,
+                    endpoint=_ep(i),
+                    src_identity=_identity(addr),
+                    family=family,
+                    peer_addr=addr,
+                    dport=int(dports[i]),
+                    proto=int(protos[i]),
+                    ingress=ingress,
+                    rule_index=int(rule[i]) if rule is not None else -1,
+                )
+            )
         if events:
             hub.publish_many(events)
 

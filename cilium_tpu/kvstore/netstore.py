@@ -242,6 +242,10 @@ class KVStoreServer:
         })
 
     def _write_snapshot(self) -> None:
+        # _snap_lock exists solely to serialize stop() against the
+        # periodic snapshot loop over one tmp file; the fsync+rename
+        # under it is the lock's entire purpose and no request path
+        # takes it — every blocking call below is the design
         with self._snap_lock:  # stop() vs periodic loop share one tmp
             durable_rev, global_rev, data = self.store.snapshot_non_lease()
             if durable_rev == self._dirty_rev:
@@ -251,16 +255,16 @@ class KVStoreServer:
                 for k, v in data.items()
             }
             tmp = f"{self.state_path}.tmp"
-            with open(tmp, "w") as f:
+            with open(tmp, "w") as f:  # policyd-lint: disable=LOCK002
                 f.write(json.dumps({"rev": global_rev, "kv": kv}))
                 f.flush()
-                os.fsync(f.fileno())  # rename must not outlive the data
-            os.replace(tmp, self.state_path)  # atomic: never torn
+                os.fsync(f.fileno())  # rename must not outlive the data  # policyd-lint: disable=LOCK002
+            os.replace(tmp, self.state_path)  # atomic: never torn  # policyd-lint: disable=LOCK002
             try:  # make the rename itself durable
-                dfd = os.open(os.path.dirname(self.state_path) or ".",
+                dfd = os.open(os.path.dirname(self.state_path) or ".",  # policyd-lint: disable=LOCK002
                               os.O_RDONLY)
                 try:
-                    os.fsync(dfd)
+                    os.fsync(dfd)  # policyd-lint: disable=LOCK002
                 finally:
                     os.close(dfd)
             except OSError:

@@ -8,6 +8,7 @@ from .events import (
     EVENT_CAPTURE,
     EVENT_DROP,
     EVENT_L7,
+    EVENT_POLICY_VERDICT,
     EVENT_TRACE,
     EVENT_TRACE_SUMMARY,
     REASON_NO_SERVICE,
@@ -17,6 +18,7 @@ from .events import (
     DebugCapture,
     DropNotify,
     L7Notify,
+    PolicyVerdictNotify,
     TraceNotify,
     TraceSummary,
     decode,
@@ -35,9 +37,11 @@ __all__ = [
     "EVENT_AGENT",
     "EVENT_DROP",
     "EVENT_L7",
+    "EVENT_POLICY_VERDICT",
     "EVENT_TRACE",
     "EVENT_TRACE_SUMMARY",
     "L7Notify",
+    "PolicyVerdictNotify",
     "TraceSummary",
     "render_waterfall",
     "MonitorHub",

@@ -24,6 +24,7 @@ EVENT_AGENT = 3
 EVENT_L7 = 4
 EVENT_CAPTURE = 5  # DebugCapture (datapath_debug.go:368)
 EVENT_TRACE_SUMMARY = 6  # policyd-trace per-batch phase breakdown
+EVENT_POLICY_VERDICT = 7  # PolicyVerdictNotify (datapath_policy.go:21)
 
 # drop reasons (bpf/lib/common.h DROP_* / pkg/monitor/api errors)
 REASON_POLICY = 133  # DROP_POLICY (generic / attribution off)
@@ -133,6 +134,48 @@ class TraceNotify:
 
 
 @dataclasses.dataclass(frozen=True)
+class PolicyVerdictNotify:
+    """One policy verdict (PolicyVerdictNotify, pkg/monitor/
+    datapath_policy.go:21), emitted per sampled flow while the
+    PolicyVerdictNotification option is on — unlike DropNotify/
+    TraceNotify it reports ALLOWED flows too, with the wire reason
+    that decided them."""
+
+    action: int  # 0 = denied, 1 = allowed, 2 = redirected (L7)
+    reason: int  # REASON_* wire code (REASON_UNKNOWN for plain allow)
+    endpoint: int
+    src_identity: int
+    family: int
+    peer_addr: bytes
+    dport: int
+    proto: int
+    ingress: bool
+    # matched rule position from the attribution kernel's origin
+    # output; -1 while FlowAttribution is off (no recompile either way)
+    rule_index: int = -1
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+    @property
+    def type(self) -> int:
+        return EVENT_POLICY_VERDICT
+
+    def summary(self) -> str:
+        import ipaddress
+
+        verdict = {0: "denied", 1: "allowed", 2: "redirected"}.get(
+            self.action, f"action-{self.action}"
+        )
+        ip = ipaddress.ip_address(self.peer_addr)
+        rule = f" rule {self.rule_index}" if self.rule_index >= 0 else ""
+        return (
+            f"policy-verdict {verdict} ({reason_name(self.reason)})"
+            f"{rule} ep {self.endpoint} peer {ip} "
+            f"identity {self.src_identity} dport {self.dport} "
+            f"proto {self.proto}"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class AgentNotify:
     """Control-plane event (pkg/monitor/agent.go AgentNotify):
     policy imports, endpoint lifecycle, regenerations."""
@@ -225,6 +268,10 @@ class TraceSummary:
 
 _FLOW_FMT = "<BBBBIIHHd16s"
 _FLOW_LEN = struct.calcsize(_FLOW_FMT)
+# verdict events: the flow layout (sub = reason) with action u8 and
+# rule index i16 appended
+_VERDICT_FMT = "<BBBBIIHHd16sBh"
+_VERDICT_LEN = struct.calcsize(_VERDICT_FMT)
 
 
 def encode(ev) -> bytes:
@@ -236,6 +283,14 @@ def encode(ev) -> bytes:
             _FLOW_FMT, t, sub, flags, ev.proto, ev.endpoint,
             ev.src_identity, ev.dport, 0, ev.timestamp,
             bytes(ev.peer_addr).ljust(16, b"\x00"),
+        )
+    if t == EVENT_POLICY_VERDICT:
+        flags = (1 if ev.ingress else 0) | (2 if ev.family == 6 else 0)
+        return struct.pack(
+            _VERDICT_FMT, t, ev.reason, flags, ev.proto, ev.endpoint,
+            ev.src_identity, ev.dport, 0, ev.timestamp,
+            bytes(ev.peer_addr).ljust(16, b"\x00"),
+            ev.action, ev.rule_index,
         )
     if t == EVENT_AGENT:
         kind = ev.kind.encode()
@@ -287,6 +342,19 @@ def decode(buf: bytes):
         if t == EVENT_DROP:
             return DropNotify(reason=sub, **kw)
         return TraceNotify(obs_point=sub, **kw)
+    if t == EVENT_POLICY_VERDICT:
+        (
+            t, reason, flags, proto, ep, ident, dport, _pad, ts, addr,
+            action, rule_index,
+        ) = struct.unpack(_VERDICT_FMT, buf[:_VERDICT_LEN])
+        family = 6 if flags & 2 else 4
+        return PolicyVerdictNotify(
+            action=action, reason=reason, endpoint=ep,
+            src_identity=ident, family=family,
+            peer_addr=addr[:16] if family == 6 else addr[:4],
+            dport=dport, proto=proto, ingress=bool(flags & 1),
+            rule_index=rule_index, timestamp=ts,
+        )
     if t in (EVENT_AGENT, EVENT_L7):
         _, la, lb = struct.unpack("<BHH", buf[:5])
         a = buf[5:5 + la].decode()

@@ -93,6 +93,21 @@ class DaemonConfig:
     # / d2h. 1 = profile every batch (bench --prof); 64 keeps sampled
     # overhead under the <2% budget on pipeline_e2e_vps.
     profile_sample_every: int = 64
+    # Boot-time values of the remaining datapath-gated runtime options.
+    # Every OPTION_SPECS entry maps to exactly one of these fields (or
+    # an annotated None) in contracts.OPTION_BOOT_FIELDS, and rule
+    # OPT001 machine-checks the pairing — a new option without a boot
+    # field (or a field the daemon never seeds from) fails the lint
+    # gate, which is how the L7DeviceBatch dead-toggle bug class dies.
+    policy_verdict_notification: bool = False
+    phase_tracing: bool = False
+    flow_attribution: bool = False
+    dispatch_autotune: bool = False
+    fail_open: bool = False
+    admission_control: bool = False
+    prefilter_shed: bool = False
+    device_profiling: bool = False
+    fault_injection: bool = False
 
     def validate(self) -> None:
         if self.enforcement_mode not in ("default", "always", "never"):

@@ -53,7 +53,10 @@ class ChildLauncher:
 
         env = dict(os.environ)
         env["CILIUM_TPU_PARENT_PID"] = str(os.getpid())
-        return subprocess.Popen(
+        # _lock guards the child Popen handle; _spawn runs only on
+        # start and on crash-restart (rare), and racing spawns would
+        # leak sidecars — accepted hold
+        return subprocess.Popen(  # policyd-lint: disable=LOCK002
             self.argv,
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,

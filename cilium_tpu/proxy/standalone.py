@@ -968,18 +968,22 @@ class StandaloneProxy:
     def _log_record(self, record: dict) -> None:
         if self._accesslog_path is None:
             return
+        # _al_lock serializes access-log frames onto one unix socket;
+        # the lazy connect under it happens once per collector
+        # (re)start and the framed sendall (via _send_msg) is the
+        # lock's entire purpose — accepted hold
         with self._al_lock:
             for _attempt in (0, 1):
                 if self._accesslog_sock is None:
                     try:
-                        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                        s.connect(self._accesslog_path)
+                        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)  # policyd-lint: disable=LOCK002
+                        s.connect(self._accesslog_path)  # policyd-lint: disable=LOCK002
                         self._accesslog_sock = s
                     except OSError:
                         self._accesslog_sock = None
                         return
                 try:
-                    _send_msg(self._accesslog_sock, record)
+                    _send_msg(self._accesslog_sock, record)  # policyd-lint: disable=LOCK002
                     return
                 except OSError:
                     try:

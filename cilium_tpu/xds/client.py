@@ -43,7 +43,10 @@ class XDSClient:
         with self._lock:
             self._handlers[type_url] = handler
             self._subscribed[type_url] = resource_names
-            _send_msg(self._sock, {
+            # _lock serializes xDS frames onto the one client socket
+            # (subscribe vs the ACK loop); the sendall under it is the
+            # lock's purpose — control-plane only, never verdict-path
+            _send_msg(self._sock, {  # policyd-lint: disable=LOCK002
                 "type_url": type_url,
                 "version_info": 0,
                 "response_nonce": "",
@@ -88,7 +91,8 @@ class XDSClient:
                 if err:
                     ack["error_detail"] = err
                 try:
-                    _send_msg(self._sock, ack)
+                    # same frame-serialization invariant as subscribe()
+                    _send_msg(self._sock, ack)  # policyd-lint: disable=LOCK002
                 except OSError:
                     return
             if not err:

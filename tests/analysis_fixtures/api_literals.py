@@ -1,0 +1,21 @@
+"""API001 fixture: stable-literal drift against the canonical tables.
+
+Canon comes from the ``cilium_tpu.contracts`` import fallback (no
+module named contracts.py in a single-file analysis of this fixture).
+"""
+
+REASON_POLICY = 133        # NEG: matches canon
+REASON_POLICY_DENY = 150   # POS: drifts from the canonical 151
+REASON_FIXTURE_LOCAL = 199  # POS: unknown drop-reason constant
+REASON_LABEL = "shed"      # NEG: string-valued, out of API001 scope
+
+ATTR_DENY_RULE = 1         # NEG: matches canon
+ATTR_NO_L3 = 7             # POS: drifts from the canonical 2
+
+BUCKET_LADDER = (512, 1024)  # POS: drifts from the canonical ladder
+
+
+class Tracer:
+    def run(self, bt):
+        bt.phase("prepare")    # NEG: canonical phase name
+        bt.phase("warpdrive")  # POS: unknown trace phase literal

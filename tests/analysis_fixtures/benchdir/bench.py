@@ -1,0 +1,26 @@
+"""BENCH001 fixture — the rule scopes to modules NAMED bench.py, so
+this lives in its own subdirectory to get the basename right."""
+
+
+def fixture_record(vps, lat_s, ops, calib):
+    return {
+        "metric": "fixture verdicts/sec",
+        "value": round(vps),                      # NEG: bookkeeping key
+        "fixture_vps": round(vps),                # NEG: rate suffix
+        "fixture_p99_ms": round(lat_s * 1e3, 3),  # NEG: duration suffix
+        "fixture_ops_s": round(ops),              # POS: rate read as duration (error)
+        "fixture_throughput": round(ops / 2.0),   # POS: no direction suffix (warning)
+        "calib_py_loops": round(calib),           # NEG: calib_ prefix skipped
+        "host_cpus": 8,
+    }
+
+
+def fixture_subscript(rec, ratio):
+    rec["fixture_norm"] = round(ratio, 4)        # POS: no suffix (warning)
+    rec["fixture_norm_ratio"] = round(ratio, 4)  # NEG: ratio suffix
+    return rec
+
+
+def fixture_not_a_record(x):
+    # NEG: not record-like — no "metric" key, fewer than 3 rounded keys
+    return {"fixture_scratch": round(x), "label": "x"}

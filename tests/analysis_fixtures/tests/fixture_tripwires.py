@@ -1,0 +1,12 @@
+"""Tripwire-test directory for the xmod fixture package (OPT001 C5).
+
+This file is NOT collected by pytest (no ``test_`` prefix) — it exists
+so the analyzer's tests-dir scan finds the quoted option names below.
+``GateBeta`` is deliberately absent: its missing-tripwire finding is
+what ``tests/test_static_analysis.py`` asserts.
+"""
+
+NAMED_OPTIONS = (
+    "GateAlpha",
+    "GateEpsilon",
+)

@@ -1,0 +1,6 @@
+"""Multi-module fixture package for the cross-module analysis rules.
+
+Rooted at ``analysis_fixtures`` (which has no ``__init__.py``), so the
+package name seen by the call graph is ``xmod`` and its tripwire-test
+directory (OPT001 check C5) is ``analysis_fixtures/tests/``.
+"""

@@ -1,0 +1,15 @@
+"""Fixture canon: OPT001 resolves OPTION_BOOT_FIELDS from the module
+named ``contracts.py`` inside the analyzed set, so the xmod fixture is
+self-contained (the real table in ``cilium_tpu/contracts.py`` is never
+consulted when this package is analyzed on its own)."""
+
+OPTION_BOOT_FIELDS = {
+    "GateAlpha": "gate_alpha",
+    "GateBeta": "gate_beta",
+    "GateGamma": None,  # runtime-only toggle, no boot surface
+    # POS: declares a boot field DaemonConfig does not have
+    "GateEpsilon": "gate_epsilon",
+    "GateZeta": None,  # boot-exempt: seeded unconditionally
+    # POS (reverse): stale row — no OPTION_SPECS registration
+    "GateOmega": None,
+}

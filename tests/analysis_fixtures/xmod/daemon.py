@@ -1,0 +1,32 @@
+"""OPT001 fixture daemon: seeds options from boot fields and handles
+runtime mutations. GateGamma is declared mutable but has no handler
+branch and no literal read anywhere — the L7DeviceBatch-class bug."""
+
+
+class OptionMap:
+    def __init__(self):
+        self._values = {}
+
+    def set(self, name, value):
+        self._values[name] = value
+
+    def get(self, name, default=False):
+        return self._values.get(name, default)
+
+
+class MiniDaemon:
+    _MUTABLE_OPTIONS = frozenset({"GateAlpha", "GateGamma"})
+
+    def __init__(self, cfg):
+        self.options = OptionMap()
+        self.alpha_enabled = False
+        if cfg.gate_alpha:
+            self.options.set("GateAlpha", True)
+        if cfg.gate_beta:
+            self.options.set("GateBeta", True)
+        # boot-exempt option seeded unconditionally
+        self.options.set("GateZeta", True)
+
+    def _on_option_change(self, name, value):
+        if name == "GateAlpha":
+            self.alpha_enabled = value

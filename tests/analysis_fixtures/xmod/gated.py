@@ -1,0 +1,35 @@
+# policyd: hot
+"""OPT002 fixture: option-gated mutation read by a gate-blind method."""
+
+
+class VerdictCache:
+    def __init__(self):
+        self.attribution = False
+        self._origin = None
+        self._depth = 1
+
+    def set_attribution(self, value):
+        self.attribution = bool(value)
+
+    def process(self, batch):
+        if self.attribution:
+            # POS: OPT002 — mutated only under the gate, but read by
+            # explain() which never consults the gate
+            self._origin = batch
+        # NEG: mutated outside any gate — not option-gated state
+        self._depth = len(batch)
+        return self._depth
+
+    def explain(self):
+        return self._origin
+
+    def explain_gated(self):
+        # NEG reader: consults the gate before observing gated state
+        if self.attribution:
+            return self._origin
+        return None
+
+
+def check_gate(options):
+    # POS: OPT001 — per-batch options.get read in a hot module
+    return options.get("GateAlpha", False)

@@ -1,0 +1,28 @@
+"""Cold helper module: callee summaries for the inter-procedural rules.
+
+Nothing here is flagged directly — the findings land at the CALL sites
+in ``hotcaller.py`` (TPU001 one edge deep) and ``locked.py`` (LOCK002
+one edge deep).
+"""
+
+
+def pull_stats(batch):
+    # host-pull on the parameter: callers in hot modules inherit this
+    total = batch.item()
+    return total
+
+
+def shape_of(batch):
+    # NEG: metadata only, no device->host transfer
+    return batch.shape
+
+
+def write_out(path, payload):
+    # blocking file I/O: callers holding a lock inherit this
+    with open(path, "w") as f:
+        f.write(payload)
+
+
+def render(payload):
+    # NEG: pure compute, nothing blocking
+    return payload.upper()

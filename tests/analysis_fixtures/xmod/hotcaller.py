@@ -1,0 +1,28 @@
+# policyd: hot
+"""Hot module that hands device values to helpers in another module.
+
+The pull lives in ``helpers.pull_stats`` — a module-local analysis sees
+nothing wrong here; only the call graph connects the device value to
+the ``.item()`` one frame down.
+"""
+
+import jax.numpy as jnp
+
+from . import helpers
+
+
+def process(n):
+    dev = jnp.ones(n)
+    # POS: TPU001 (inter-procedural) — callee host-pulls 'batch'
+    return helpers.pull_stats(dev)
+
+
+def sizes(n):
+    dev = jnp.ones(n)
+    # NEG: callee reads metadata only, never pulls
+    return helpers.shape_of(dev)
+
+
+def label(text):
+    # NEG: host value to a host helper — nothing device-resident
+    return helpers.render(text)

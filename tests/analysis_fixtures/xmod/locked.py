@@ -1,0 +1,26 @@
+"""Lock holder that calls a blocking helper one module away.
+
+``write_out`` opens a file — module-local LOCK002 cannot see that from
+this call site; the call-graph edge carries the callee's blocking
+summary back to the held context.
+"""
+
+import threading
+
+from .helpers import write_out
+
+
+class SnapshotKeeper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data = ""
+
+    def save(self, path):
+        with self._lock:
+            # POS: LOCK002 (inter-procedural) — callee blocks on open()
+            write_out(path, self._data)
+
+    def stage(self, payload):
+        with self._lock:
+            # NEG: pure in-memory mutation under the lock
+            self._data = payload

@@ -1,0 +1,28 @@
+"""OPT001 fixture: option registrations + boot config, with deliberate
+discipline violations (see line comments). The matching daemon view is
+in ``daemon.py``; the canonical boot-field table is in ``contracts.py``.
+"""
+
+
+class OptionSpec:
+    def __init__(self, name, requires=()):
+        self.name = name
+        self.requires = tuple(requires)
+
+
+OPTION_SPECS = {
+    spec.name: spec
+    for spec in (
+        OptionSpec("GateAlpha"),    # NEG: boot field + handler + tripwire
+        OptionSpec("GateBeta"),     # POS C5: no tripwire test names it
+        OptionSpec("GateGamma"),    # POS C1: mutable, no consumption site
+        OptionSpec("GateDelta"),    # POS: no OPTION_BOOT_FIELDS entry
+        OptionSpec("GateEpsilon"),  # POS C4: boot field not on DaemonConfig
+        OptionSpec("GateZeta"),     # NEG: boot-exempt, seeded at boot
+    )
+}
+
+
+class DaemonConfig:
+    gate_alpha: bool = False
+    gate_beta: bool = False

@@ -69,6 +69,48 @@ class TestRuntimeConfig:
         assert not daemon.pipeline.drop_notifications
         daemon.config_patch({"DropNotification": True})
 
+    def test_policy_verdict_notification_wiring(self, daemon):
+        """The "PolicyVerdictNotification" tripwire (OPT001): the patch
+        drives the pipeline attribute, ON emits verdict events for
+        allowed AND denied flows, and OFF returns to silence."""
+        from cilium_tpu.monitor import PolicyVerdictNotify
+
+        assert not daemon.pipeline.verdict_notifications
+        sub = daemon.monitor.subscribe()
+        ep = daemon.pipeline.endpoint_index(7)
+        args = (ip_strings_to_u32(["10.200.0.9", "10.200.0.77"]),
+                np.array([ep, ep], np.int32),
+                np.array([80, 80], np.int32), np.array([6, 6], np.int32))
+        daemon.pipeline.process(*args)
+        assert [e for e in sub.drain()
+                if isinstance(e, PolicyVerdictNotify)] == []
+        out = daemon.config_patch({"PolicyVerdictNotification": True})
+        assert "PolicyVerdictNotification" in out["changed"]
+        assert daemon.pipeline.verdict_notifications
+        daemon.pipeline.process(*args)
+        evs = [e for e in sub.drain() if isinstance(e, PolicyVerdictNotify)]
+        assert sorted(e.action for e in evs) == [0, 1]  # denied + allowed
+        daemon.config_patch({"PolicyVerdictNotification": False})
+        daemon.pipeline.process(*args)
+        assert [e for e in sub.drain()
+                if isinstance(e, PolicyVerdictNotify)] == []
+        sub.close()
+
+    def test_policy_verdict_notification_boot_field(self):
+        """DaemonConfig.policy_verdict_notification seeds the option at
+        boot (the OPTION_BOOT_FIELDS pairing OPT001 machine-checks)."""
+        from cilium_tpu.option import DaemonConfig, get_config, set_config
+
+        saved = get_config()
+        try:
+            set_config(DaemonConfig(policy_verdict_notification=True))
+            d = Daemon()
+            assert d.options.get("PolicyVerdictNotification")
+            assert d.pipeline.verdict_notifications
+            d.shutdown()
+        finally:
+            set_config(saved)
+
     def test_endpoint_option_gates_events(self, daemon):
         """`cilium endpoint config` overrides must actually gate that
         endpoint's events — not just echo back from the API."""

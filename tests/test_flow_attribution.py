@@ -228,3 +228,34 @@ class TestOffPathProgram:
         ct_pipe.set_endpoints([i.id for i in idents[:4]])
         ct_pipe.rebuild()
         return ct_pipe, idents
+
+
+class TestOptionWiring:
+    def test_flow_attribution_option_name(self):
+        """The "FlowAttribution" runtime option (not just the raw
+        set_attribution setter) drives the pipeline, and the
+        DaemonConfig boot field seeds it — the OPT001 tripwire pairing
+        for this option."""
+        from cilium_tpu.daemon import Daemon
+        from cilium_tpu.option import DaemonConfig, get_config, set_config
+
+        d = Daemon()
+        try:
+            assert not d.pipeline._attrib_requested
+            out = d.config_patch({"FlowAttribution": True})
+            assert "FlowAttribution" in out["changed"]
+            assert d.pipeline._attrib_requested
+            d.config_patch({"FlowAttribution": False})
+            assert not d.pipeline._attrib_requested
+        finally:
+            d.shutdown()
+
+        saved = get_config()
+        try:
+            set_config(DaemonConfig(flow_attribution=True))
+            boot = Daemon()
+            assert boot.options.get("FlowAttribution")
+            assert boot.pipeline._attrib_requested
+            boot.shutdown()
+        finally:
+            set_config(saved)

@@ -27,6 +27,7 @@ from cilium_tpu.monitor import (
     L7Notify,
     MonitorHub,
     MonitorServer,
+    PolicyVerdictNotify,
     TraceNotify,
     decode,
     encode,
@@ -65,6 +66,25 @@ class TestCodec:
         assert decode(encode(a)) == a
         l7 = L7Notify(verdict="Denied", detail='{"path": "/admin"}')
         assert decode(encode(l7)) == l7
+
+    def test_policy_verdict_roundtrip(self):
+        ev = PolicyVerdictNotify(
+            action=0, reason=REASON_POLICY, endpoint=7, src_identity=1002,
+            family=4, peer_addr=bytes([10, 0, 0, 9]), dport=443, proto=6,
+            ingress=True, rule_index=3,
+        )
+        out = decode(encode(ev))
+        assert out == ev
+        assert "denied" in out.summary() and "rule 3" in out.summary()
+        # allowed flows report too (the whole point vs DropNotify), and
+        # rule_index=-1 (FlowAttribution off) survives the signed field
+        allowed = PolicyVerdictNotify(
+            action=1, reason=0, endpoint=3, src_identity=5, family=6,
+            peer_addr=bytes(range(16)), dport=80, proto=6, ingress=False,
+        )
+        back = decode(encode(allowed))
+        assert back == allowed and back.rule_index == -1
+        assert "allowed" in back.summary() and "rule" not in back.summary()
 
 
 class TestHub:
@@ -160,6 +180,31 @@ class TestPipelineEmission:
         src = ip_strings_to_u32(["10.0.0.4"])
         pipe.process(src, np.zeros(1, np.int32), np.array([80]), np.array([6]))
         assert hub.published == 0  # hub.active gate short-circuits
+
+    def test_policy_verdict_events_option_gated(self):
+        """The "PolicyVerdictNotification" tripwire: OFF emits no
+        verdict events at all; ON reports EVERY flow's decision —
+        allowed included — with the wire reason that decided it."""
+        pipe, hub, ids = _pipeline()
+        sub = hub.subscribe()
+        src = ip_strings_to_u32(["10.0.0.2", "10.0.0.4"])
+        args = (src, np.zeros(2, np.int32),
+                np.array([80, 80]), np.array([6, 6]))
+        pipe.process(*args)
+        off = [e for e in sub.drain() if isinstance(e, PolicyVerdictNotify)]
+        assert off == []  # OFF path untouched
+        pipe.verdict_notifications = True  # what the option push sets
+        pipe.process(*args)
+        evs = [e for e in sub.drain() if isinstance(e, PolicyVerdictNotify)]
+        assert len(evs) == 2
+        by_action = {e.action: e for e in evs}
+        allowed, denied = by_action[1], by_action[0]
+        assert allowed.src_identity == ids["lb"].id
+        assert allowed.reason == 0  # plain allow carries REASON_UNKNOWN
+        assert denied.reason == REASON_POLICY
+        assert denied.src_identity == ids["other"].id
+        assert denied.rule_index == -1  # FlowAttribution off
+        assert denied.endpoint == 7  # endpoint ID, not index
 
 
 class TestMonitorSocket:
