@@ -1499,13 +1499,14 @@ elif phase == "drain":
 '''
 
 
-def _drv_spawn(phase, state_dir):
+def _drv_spawn(phase, state_dir, src=None, extra=()):
     import subprocess
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     return subprocess.Popen(
-        [sys.executable, "-u", "-c", _SURVIVE_DRIVER_SRC, phase, state_dir],
+        [sys.executable, "-u", "-c", src or _SURVIVE_DRIVER_SRC,
+         phase, state_dir, *extra],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
     )
@@ -1532,6 +1533,168 @@ def _drv_expect(proc, prefix, timeout_s=300.0):
     proc.kill()
     raise RuntimeError("timeout waiting for %r:\n%s"
                        % (prefix, "".join(tail[-20:])))
+
+
+_FLEETOBS_DRIVER_SRC = r'''
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+
+node, state_dir, store_path = sys.argv[1], sys.argv[2], sys.argv[3]
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.kvstore.filestore import FileBackend
+from cilium_tpu.observe.fleet import TelemetryExchange
+from cilium_tpu.ops.lpm import ip_strings_to_u32
+
+ALLOW = json.dumps([{
+    "endpointSelector": {"matchLabels": {"app": "web"}},
+    "ingress": [{"fromEndpoints": [{"matchLabels": {"app": "client"}}]}],
+}])
+N = 256
+
+dm = Daemon(state_dir=state_dir)
+dm.policy_add(ALLOW)
+dm.endpoint_add(1, ["unspec:app=web"], ipv4="10.0.0.1")
+dm.endpoint_add(2, ["unspec:app=client"], ipv4="10.0.0.2")
+dm.config_patch({"FleetTelemetry": "true"})
+sampler = dm._fleet_sampler
+sampler.attach_exchange(TelemetryExchange(
+    FileBackend(store_path, node, lease_ttl=60.0), node, cluster="bench",
+))
+
+peers = ip_strings_to_u32(["10.0.0.2"] * N)
+eps = np.zeros(N, np.int32)
+dports = np.full(N, 80, np.int32)
+protos = np.full(N, 6, np.int32)
+dm.pipeline.process(peers, eps, dports, protos)  # warm: compile before t0
+print("READY", flush=True)
+
+verdicts = 0
+t0 = time.perf_counter()
+i = 0
+while True:
+    dm.pipeline.process(peers, eps, dports, protos)
+    verdicts += N
+    i += 1
+    if i % 4 == 0:
+        # deterministic extra cadence beside the 1s sampler thread so
+        # short storm windows still fill the ring
+        sampler.sample_once()
+        print("SYNC " + json.dumps({
+            "i": i, "vps": verdicts / (time.perf_counter() - t0),
+        }), flush=True)
+'''
+
+
+def _bench_fleetobs(attached):
+    """``--fleetobs``: policyd-fleetobs round → result dict for the
+    one-line JSON. Three REAL daemon processes (FleetTelemetry on)
+    storm the verdict path and publish telemetry frames over ONE
+    FileBackend SQLite store; the parent runs the aggregator side:
+
+    - aggregation parity: the scoreboard's fleet vps must match the
+      sum of the drivers' independently-accounted verdict rates
+      within tolerance;
+    - chaos: one node dies by SIGKILL — its frames age out by
+      wall-clock staleness (the lease is deliberately slower), the
+      scoreboard drops to 2 reporting nodes, nothing crashes."""
+    import tempfile
+    import threading
+
+    from cilium_tpu import metrics as _metrics
+    from cilium_tpu.kvstore.filestore import FileBackend
+    from cilium_tpu.observe import fleet as _fleet
+
+    attached.stage("fleetobs-build")
+    path, names, _ = _cluster_store(attach=False)
+
+    attached.stage("fleetobs-spawn")
+    procs = []
+    for n in names:
+        sd = tempfile.mkdtemp(prefix=f"bench-fleetobs-{n}-")
+        procs.append(_drv_spawn(n, sd, src=_FLEETOBS_DRIVER_SRC,
+                                extra=(path,)))
+    try:
+        for p in procs:
+            _drv_expect(p, "READY")
+
+        # reader threads keep the pipes drained (no 64K stalls) and
+        # remember each node's latest self-reported rate
+        last_sync = {}
+
+        def _reader(name, proc):
+            for line in iter(proc.stdout.readline, ""):
+                if line.startswith("SYNC "):
+                    last_sync[name] = json.loads(line[5:])
+
+        for n, p in zip(names, procs):
+            threading.Thread(
+                target=_reader, args=(n, p), daemon=True
+            ).start()
+
+        attached.stage("fleetobs-storm")
+        time.sleep(10.0)  # long enough for the 10s frame window to fill
+
+        agg_be = FileBackend(path, "bench-agg", lease_ttl=60.0)
+        ex = _fleet.TelemetryExchange(agg_be, "bench-agg", cluster="bench")
+        deadline = time.time() + 30.0
+        frames = {}
+        while time.time() < deadline:
+            ex.pump()
+            frames = ex.frames(stale_s=10.0)
+            if len(frames) == len(names):
+                break
+            time.sleep(0.2)
+        assert len(frames) == len(names), (
+            f"only {sorted(frames)} of {names} published frames"
+        )
+        agg = _fleet.aggregate(frames)
+        node_sum_vps = sum(
+            last_sync[n]["vps"] for n in names if n in last_sync
+        )
+        parity = (
+            node_sum_vps > 0
+            and abs(agg["fleet_vps"] - node_sum_vps) / node_sum_vps < 0.5
+        )
+        assert parity, (
+            f"aggregation parity broke: fleet_vps={agg['fleet_vps']} "
+            f"vs node sum {node_sum_vps}"
+        )
+        worst = agg.get("worst_burn") or {}
+
+        attached.stage("fleetobs-kill")
+        procs[-1].kill()  # SIGKILL: no drain, no lease revoke
+        procs[-1].wait()
+        time.sleep(4.0)
+        ex.pump()
+        agg2 = _fleet.aggregate(ex.frames(stale_s=3.0))
+        survivors = {r["node"] for r in agg2["nodes"]}
+        assert agg2["nodes_reporting"] == len(names) - 1, (
+            f"expected {len(names) - 1} nodes after kill, "
+            f"got {agg2['nodes_reporting']} ({sorted(survivors)})"
+        )
+        assert names[-1] not in survivors, "killed node's frame not aged out"
+        assert _metrics.fleet_nodes_reporting.get() == len(names) - 1
+
+        ex.close()
+        return {
+            "nodes": len(names),
+            "fleet_agg_vps": round(agg["fleet_vps"]),
+            "node_sum_vps": round(node_sum_vps),
+            "agg_parity": bool(parity),
+            "fleet_epoch_lag_max": int(agg["epoch_lag_max"] or 0),
+            "epoch_skew": int(agg["epoch_skew"] or 0),
+            "slo_worst_burn_ratio": round(float(worst.get("ratio") or 0.0), 4),
+            "slo_worst_objective": worst.get("objective") or "",
+            "nodes_reporting_after_kill": int(agg2["nodes_reporting"]),
+            "kill_survived": True,
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
 
 
 def _chaos_survive(attached):
@@ -1741,6 +1904,27 @@ def _bench_overload(repo, reg, idents, nrng: np.random.Generator, attached):
 
 
 
+def _cluster_store(n_nodes=3, attach=True):
+    """Shared FileBackend harness for the kvstore-backed rounds
+    (``--cluster``, ``--fleetobs``): ONE durable SQLite store under a
+    fresh tempdir plus the node-name roster. With ``attach`` each node
+    gets an in-process backend handle (the --cluster thread harness);
+    without, callers spawn real subprocesses that open their own
+    handles against the returned path (the --fleetobs storm)."""
+    import tempfile
+
+    from cilium_tpu.kvstore.filestore import FileBackend
+
+    tmp = tempfile.mkdtemp(prefix="bench-cluster-")
+    path = os.path.join(tmp, "kvstore.sqlite")
+    names = [f"node-{i}" for i in range(n_nodes)]
+    backends = (
+        [FileBackend(path, n, lease_ttl=60.0) for n in names]
+        if attach else []
+    )
+    return path, names, backends
+
+
 def _bench_cluster(attached):
     """``--cluster``: policyd-fed round → result dict for the one-line
     JSON. Three in-process federation nodes share ONE FileBackend
@@ -1755,25 +1939,19 @@ def _bench_cluster(attached):
     - epoch convergence: wall time from all nodes publishing a new
       policy epoch to ``wait_cluster_epoch`` observing the fleet
       minimum reach it."""
-    import tempfile
     import threading
 
     from cilium_tpu.federation import ClusterIdentityAllocator, EpochExchange
-    from cilium_tpu.kvstore.filestore import FileBackend
     from cilium_tpu.kvstore.paths import IDENTITIES_PATH
     from cilium_tpu.utils.backoff import Backoff
 
     attached.stage("cluster-build")
-    tmp = tempfile.mkdtemp(prefix="bench-cluster-")
-    path = os.path.join(tmp, "kvstore.sqlite")
-    names = ["node-0", "node-1", "node-2"]
+    path, names, backends = _cluster_store()
 
     def bo():
         return Backoff(
             min_s=0.001, max_s=0.05, full_jitter=True, max_elapsed_s=30.0
         )
-
-    backends = [FileBackend(path, n, lease_ttl=60.0) for n in names]
     allocs = [
         ClusterIdentityAllocator(
             be, IDENTITIES_PATH, node_name=n,
@@ -2944,6 +3122,24 @@ def main() -> None:
             "metric": "federated contended identity allocation rate",
             "value": out["contended_alloc_rps"],
             "unit": "ops/s",
+            **out,
+            "backend": backend,
+            "host_cpus": os.cpu_count(),
+        }))
+        return
+
+    if "--fleetobs" in sys.argv[1:]:
+        # policyd-fleetobs round: 3 real daemon processes publish
+        # telemetry frames over one filestore; the aggregator side is
+        # gated inline on vps parity and on surviving a SIGKILL'd
+        # node (frames age out, scoreboard drops to 2, no crash). No
+        # world build needed.
+        out = _bench_fleetobs(attached)
+        attached.set()
+        print(json.dumps({
+            "metric": "fleet-aggregated verdict rate over 3 nodes",
+            "value": out["fleet_agg_vps"],
+            "unit": "vps",
             **out,
             "backend": backend,
             "host_cpus": os.cpu_count(),

@@ -30,6 +30,7 @@ from .core import Finding, ModuleSource
 from .hotpath import analyze_hotpath
 from .locks import LockIndex, analyze_locks_module, cycle_findings
 from .obsdocs import analyze_obsdocs
+from .obslabels import analyze_obslabels
 
 __all__ = [
     "CallGraph",
@@ -120,6 +121,7 @@ def analyze_paths(
         all_edges.extend(edges)
     findings.extend(cycle_findings(all_edges))
     findings.extend(analyze_contracts(modules, graph))
+    findings.extend(analyze_obslabels(modules))
 
     if changed is not None:
         closure = graph.dependents_of(list(changed))

@@ -77,6 +77,7 @@ _CANON_NAMES = (
     "DIFF_LOWER_SUFFIXES",
     "BENCH_BOOKKEEPING_KEYS",
     "OPTION_BOOT_FIELDS",
+    "METRIC_BOUNDED_LABEL_KEYS",
 )
 
 

@@ -1,0 +1,117 @@
+"""OBS002: no unbounded runtime data interpolated into metric labels.
+
+Prometheus-style label values are series keys: every distinct value
+materializes a new child series that lives for the process lifetime.
+A label value built by interpolating runtime data at a HOT call site
+(``{"id": f"{identity}"}``, ``{"peer": str(addr)}``) therefore turns
+an unbounded domain — identity ids, endpoint ids, addresses, ports —
+into unbounded registry growth, and the /metrics exposition walk gets
+slower every batch. The classic offenders all share one shape: an
+f-string / ``str(...)`` / ``.format(...)`` / ``%`` expression as a
+label VALUE in the dict passed to ``.inc/.set/.observe/.dec``.
+
+Some interpolated labels are fine because their domain is bounded *by
+construction* (a device ordinal is capped by the mesh complement, a
+bucket rung by the ladder). Those label KEYS are declared once, in
+``cilium_tpu.contracts.METRIC_BOUNDED_LABEL_KEYS`` — the canonical
+allowed-label table — and exempt here. Everything else interpolated
+into a label value in a hot module is a finding.
+
+Rule
+----
+OBS002  in a hot module (``*/ops/*.py``, ``*/engine.py``,
+        ``*/datapath/pipeline.py``, or ``# policyd: hot``), a metric
+        mutation call (``.inc/.dec/.set/.observe``) passing a labels
+        dict where some string-keyed value is an interpolation
+        (f-string, ``str(...)``, ``.format(...)``, ``%`` formatting)
+        and the key is not in METRIC_BOUNDED_LABEL_KEYS. Warning.
+
+Only dict literals whose keys are all string constants are treated as
+labels dicts (that is the repo's registry idiom); a computed labels
+dict can't be judged statically. Suppress a justified exception with
+``# policyd-lint: disable=OBS002``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Tuple
+
+from .contracts import _Canon
+from .core import SEV_WARNING, Finding, ModuleSource
+
+_MUTATORS = ("inc", "dec", "set", "observe")
+
+
+def _is_interpolation(expr: ast.AST) -> bool:
+    """True for the value shapes that smuggle runtime data into a
+    label: f-strings, str()/repr()/format()/hex() calls, .format()
+    method calls, and %-formatting on a string literal."""
+    if isinstance(expr, ast.JoinedStr):
+        # an f-string with no substitution is just a literal
+        return any(
+            isinstance(v, ast.FormattedValue) for v in expr.values
+        )
+    if isinstance(expr, ast.Call):
+        if isinstance(expr.func, ast.Name) and expr.func.id in (
+            "str", "repr", "format", "hex", "oct", "bin",
+        ):
+            return True
+        if isinstance(expr.func, ast.Attribute) and expr.func.attr == "format":
+            return True
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Mod):
+        left = expr.left
+        return isinstance(left, ast.Constant) and isinstance(left.value, str)
+    return False
+
+
+def _labels_dict(call: ast.Call) -> Tuple[ast.Dict, ...]:
+    """Dict-literal arguments whose keys are all string constants —
+    the only shape the registry idiom passes as labels."""
+    out = []
+    exprs = list(call.args) + [kw.value for kw in call.keywords]
+    for a in exprs:
+        if isinstance(a, ast.Dict) and a.keys and all(
+            isinstance(k, ast.Constant) and isinstance(k.value, str)
+            for k in a.keys
+        ):
+            out.append(a)
+    return tuple(out)
+
+
+def analyze_obslabels(modules: Sequence[ModuleSource]) -> List[Finding]:
+    """Run OBS002 over the analyzed set. Cross-file because the
+    allowed-key table resolves through the canonical-table machinery
+    (a fixture package defining METRIC_BOUNDED_LABEL_KEYS in its own
+    contracts.py stays self-contained)."""
+    canon = _Canon(modules)
+    bounded = frozenset(canon.get("METRIC_BOUNDED_LABEL_KEYS") or ())
+    findings: List[Finding] = []
+    for mod in modules:
+        if not mod.is_hot():
+            continue
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+            ):
+                continue
+            for d in _labels_dict(node):
+                for k, v in zip(d.keys, d.values):
+                    if not _is_interpolation(v):
+                        continue
+                    key = k.value  # str constant per _labels_dict
+                    if key in bounded:
+                        continue
+                    findings.append(mod.finding(
+                        "OBS002", SEV_WARNING, v.lineno,
+                        f"label {key!r} gets an interpolated runtime "
+                        "value at a hot metric call site — every "
+                        "distinct value becomes a permanent series "
+                        "(cardinality explosion); use a bounded "
+                        "vocabulary, or declare the key in "
+                        "contracts.METRIC_BOUNDED_LABEL_KEYS if its "
+                        "domain is bounded by construction",
+                    ))
+    return findings

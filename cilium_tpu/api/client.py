@@ -198,3 +198,9 @@ class APIClient:
 
     def cluster_status(self):
         return self._request("GET", "/cluster")
+
+    def fleet_status(self):
+        return self._request("GET", "/fleet")
+
+    def fleet_history(self, limit: int = 64):
+        return self._request("GET", f"/fleet/history?limit={limit}")

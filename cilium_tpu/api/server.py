@@ -146,6 +146,11 @@ class _Handler(UnixHandler):
             self._json(200, d.node_list())
         elif path == "/cluster" and method == "GET":
             self._json(200, d.cluster_status())
+        elif path == "/fleet" and method == "GET":
+            self._json(200, d.fleet_status())
+        elif path == "/fleet/history" and method == "GET":
+            limit = int(q.get("limit", ["64"])[0])
+            self._json(200, d.fleet_history(limit=limit))
         elif (m := re.fullmatch(r"/map/(\w+)", path)) and method == "GET":
             self._json(200, d.map_dump(m.group(1)))
         elif path == "/ipam" and method == "POST":

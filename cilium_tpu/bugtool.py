@@ -71,6 +71,9 @@ def collect_debuginfo(daemon) -> Dict:
         # policyd-fed → cluster.json: federation membership, per-node
         # published policy epochs, and identity-allocator accounting
         "cluster": daemon.cluster_status(),
+        # policyd-fleetobs → fleet.json: the aggregated telemetry
+        # scoreboard ({"enabled": false} when FleetTelemetry is off)
+        "fleet": daemon.fleet_status(),
         "accesslog": [r.to_dict() for r in daemon.proxy.accesslog.recent(200)],
         # policyd-trace ring (metrics.prom in the archive carries the
         # matching /metrics snapshot via write_archive_from)

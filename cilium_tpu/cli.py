@@ -195,6 +195,12 @@ class _Surface:
     def _d_cluster_status(self):
         return self._daemon.cluster_status()
 
+    def _d_fleet_status(self):
+        return self._daemon.fleet_status()
+
+    def _d_fleet_history(self, limit=64):
+        return self._daemon.fleet_history(limit=limit)
+
 
 def _parse_frontend(text: str) -> dict:
     """'10.96.0.10:80/TCP' → frontend dict (cilium service update
@@ -480,6 +486,17 @@ def build_parser() -> argparse.ArgumentParser:
     ).add_subparsers(dest="sub", required=True)
     cf.add_parser("nodes", help="fleet nodes + published policy epochs")
     cf.add_parser("status", help="full federation membership view")
+    # policyd-fleetobs: the aggregated telemetry plane (GET /fleet)
+    fl = sub.add_parser(
+        "fleet", help="fleet telemetry scoreboard (policyd-fleetobs)"
+    ).add_subparsers(dest="sub", required=True)
+    fl.add_parser("status", help="aggregated scoreboard (raw JSON)")
+    fl.add_parser("top", help="per-node health grid, one line per node")
+    flh = fl.add_parser("history", help="local time-series ring samples")
+    flh.add_argument("-n", "--last", type=int, default=32,
+                     help="how many ring samples to show (default 32)")
+    flh.add_argument("--json", action="store_true",
+                     help="raw sample dicts instead of one-liners")
     mp2 = sub.add_parser("map", help="open-map inventory").add_subparsers(
         dest="sub", required=True
     )
@@ -911,6 +928,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         if getattr(args, "all_controllers", False):
             _print(status.get("controllers", []))
         else:
+            slo = status.get("slo")
+            if slo:
+                # one-line health summary (policyd-fleetobs); absent
+                # when FleetTelemetry is off so stdout stays pure JSON
+                print(f"SLO: worst={slo['worst_objective']} "
+                      f"state={slo['state']} burn={slo['ratio']}",
+                      file=sys.stderr)
             _print(status)
     elif args.cmd == "metrics":
         _print(s.metrics())
@@ -1314,6 +1338,52 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.cmd == "cluster":
         st = s.cluster_status()
         _print(st.get("nodes", []) if args.sub == "nodes" else st)
+    elif args.cmd == "fleet":
+        if args.sub == "history":
+            out = s.fleet_history(limit=args.last)
+            if args.json:
+                _print(out)
+            elif not out.get("enabled"):
+                print("fleet telemetry is disabled (enable with "
+                      "`cilium-tpu config FleetTelemetry=true`)")
+            else:
+                import datetime as _dt
+
+                for rec in out.get("history", ()):
+                    ts = _dt.datetime.fromtimestamp(rec["ts"])
+                    rest = " ".join(
+                        f"{k}={rec[k]}" for k in sorted(rec) if k != "ts"
+                    )
+                    print(f"{ts:%H:%M:%S} {rest}")
+        else:
+            out = s.fleet_status()
+            if not out.get("enabled"):
+                print("fleet telemetry is disabled (enable with "
+                      "`cilium-tpu config FleetTelemetry=true`)")
+            elif args.sub == "status":
+                _print(out)
+            else:  # top: per-node health grid, worst burn first
+                agg = out
+                print(f"{agg.get('nodes_reporting', 0)} node(s) "
+                      f"reporting, fleet vps "
+                      f"{agg.get('fleet_vps', 0.0):.1f}, epoch skew "
+                      f"{agg.get('epoch_skew', 0)}")
+                wb = agg.get("worst_burn") or {}
+                if wb.get("objective"):
+                    print(f"worst burn: {wb['objective']} on "
+                          f"{wb.get('node')} ({wb.get('state')}, "
+                          f"ratio {wb.get('ratio')})")
+                print(f"{'node':<16}{'state':<9}{'vps':>10}"
+                      f"{'p99_ms':>9}{'epoch':>7}{'lag':>5}"
+                      f"{'age_s':>7}  mode")
+                for n in agg.get("nodes", ()):
+                    print(f"{n['node']:<16}{n['slo_state'] or '-':<9}"
+                          f"{(n['vps'] or 0.0):>10.1f}"
+                          f"{(n['verdict_p99_ms'] or 0.0):>9.2f}"
+                          f"{(n['policy_epoch'] if n['policy_epoch'] is not None else '-'):>7}"
+                          f"{(n['epoch_lag'] if n['epoch_lag'] is not None else '-'):>5}"
+                          f"{n['age_s']:>7.1f}  "
+                          f"{n['pipeline_mode'] or '-'}")
     elif args.cmd == "map":
         if args.sub == "list":
             _print(s.map_list())

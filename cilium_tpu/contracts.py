@@ -126,6 +126,26 @@ BENCH_BOOKKEEPING_KEYS: Tuple[str, ...] = DIFF_SKIP_KEYS + (
     "prev", "cur", "threshold_pct",
 )
 
+# -- metric label cardinality (observe/, analysis OBS002) -------------
+# Label keys whose values are allowed to be INTERPOLATED at a metric
+# call site in a hot module (f-string/str()/format of runtime data):
+# each one is bounded by construction, so it cannot explode series
+# cardinality. Everything else interpolated into a label value in a
+# hot module is an OBS002 finding — identity ids, endpoint ids and
+# addresses are the classic unbounded offenders.
+METRIC_BOUNDED_LABEL_KEYS: Tuple[str, ...] = (
+    # bounded by the mesh device complement (VerdictSharding per-device
+    # verdict series; at most len(jax.devices()) values)
+    "device",
+    # bounded by the shape-bucket ladder (BUCKET_LADDER rungs)
+    "bucket",
+    # bounded by the SLO window vocabulary (observe/timeseries.WINDOWS)
+    "window",
+    # bounded by the IP family domain ("v4"/"v6" — pipeline dispatch
+    # pad-lane accounting)
+    "family",
+)
+
 # -- runtime options ↔ DaemonConfig boot fields (option.py) -----------
 # OPT001: every option registered in OPTION_SPECS needs an entry here.
 # The value is the DaemonConfig field that seeds the option at boot,
@@ -158,6 +178,7 @@ OPTION_BOOT_FIELDS: Dict[str, Optional[str]] = {
     "FaultInjection": "fault_injection",
     "AdmissionControl": "admission_control",
     "DeviceProfiling": "device_profiling",
+    "FleetTelemetry": "fleet_telemetry",
     # None: requires an attached federation membership object (kvstore
     # join happens after boot), so there is nothing to enable at
     # DaemonConfig time

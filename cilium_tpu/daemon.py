@@ -177,6 +177,13 @@ class Daemon:
         # proxies by an XDSServer the embedder/CLI attaches
         self.xds_cache = ResourceCache()
         wire_nphds(self.xds_cache, self.ipcache)
+        # policyd-fleetobs: the FleetTelemetry sampler slot + its boot
+        # knobs exist BEFORE option seeding so a boot-enabled option
+        # can start the sampler from the on_change handler; None while
+        # the option is off (the fleet plane stays unimported)
+        self._fleet_sampler = None
+        self._telemetry_sample_s = cfg.telemetry_sample_s
+        self._telemetry_ring_rows = cfg.telemetry_ring_rows
         # runtime-mutable option map (pkg/option: PATCH /config /
         # `cilium config`); endpoints inherit it (applyOptsLocked)
         self.options = OptionMap()
@@ -205,6 +212,7 @@ class Daemon:
             ("Prefilter", cfg.prefilter_shed),
             ("DeviceProfiling", cfg.device_profiling),
             ("FaultInjection", cfg.fault_injection),
+            ("FleetTelemetry", cfg.fleet_telemetry),
         ):
             if boot_on:
                 self.options.set(opt_name, True)
@@ -831,6 +839,7 @@ class Daemon:
             "FaultInjection", "EpochSwap", "L7DeviceBatch",
             "AdmissionControl", "Prefilter", "DeviceProfiling",
             "ClusterFederation", "PolicyVerdictNotification",
+            "FleetTelemetry",
         }
     )
 
@@ -929,6 +938,16 @@ class Daemon:
             else:
                 self.allocate_identity = self.registry.allocate
                 self.release_identity = self.registry.release
+        elif name == "FleetTelemetry":
+            # policyd-fleetobs: start/stop the cadence sampler thread.
+            # The fleet plane is imported lazily HERE and only here —
+            # the off path never loads the frame codec and the verdict
+            # path never reads anything fleet-related, so off is
+            # bit-identical (tripwire-tested)
+            if value:
+                self._start_fleet_sampler()
+            else:
+                self._stop_fleet_sampler()
         elif name == "FaultInjection":
             # policyd-failsafe: arm/disarm the injection hub; off keeps
             # rules queued so a re-enable resumes a chaos scenario
@@ -1158,6 +1177,18 @@ class Daemon:
         if self.options.get("ClusterFederation"):
             self.allocate_identity = member.allocate
             self.release_identity = member.release
+        # policyd-fleetobs: a running sampler gains the telemetry
+        # exchange the moment a membership exists — frames publish
+        # beside the member's epoch-exchange node descriptor
+        sampler = self._fleet_sampler
+        if sampler is not None and sampler.exchange is None:
+            from .observe.fleet import TelemetryExchange
+
+            sampler.attach_exchange(
+                TelemetryExchange(
+                    member.backend, member.node_name, cluster=member.cluster
+                )
+            )
 
     def detach_federation(self) -> None:
         """Drop the membership and restore the local identity source
@@ -1167,6 +1198,15 @@ class Daemon:
         self._federation = None
         self.allocate_identity = self.registry.allocate
         self.release_identity = self.registry.release
+        # the telemetry exchange rode the member's backend: close it;
+        # the sampler keeps ticking locally (single-node scoreboard)
+        sampler = self._fleet_sampler
+        if sampler is not None and sampler.exchange is not None:
+            exchange, sampler.exchange = sampler.exchange, None
+            try:
+                exchange.close()
+            except (ConnectionError, TimeoutError, OSError, RuntimeError):
+                pass
 
     def cluster_status(self) -> Dict:
         """GET /cluster (policyd-fed): federation membership view —
@@ -1181,6 +1221,84 @@ class Daemon:
         else:
             out.update({"node": None, "node_count": 0, "nodes": []})
         return out
+
+    # -- fleet telemetry (policyd-fleetobs) ------------------------------
+    def _start_fleet_sampler(self) -> None:
+        if self._fleet_sampler is not None:
+            return
+        # lazy import: the FleetTelemetry OFF path never loads the
+        # fleet plane or the frame codec (tripwire-tested)
+        from .observe import fleet as _fleet
+
+        sampler = _fleet.FleetSampler(
+            interval_s=self._telemetry_sample_s,
+            capacity=self._telemetry_ring_rows,
+            epoch_source=lambda: self.pipeline.policy_epoch,
+        )
+        member = getattr(self, "_federation", None)
+        if member is not None:
+            sampler.attach_exchange(
+                _fleet.TelemetryExchange(
+                    member.backend, member.node_name, cluster=member.cluster
+                )
+            )
+        sampler.start()
+        self._fleet_sampler = sampler
+
+    def _stop_fleet_sampler(self) -> None:
+        sampler, self._fleet_sampler = self._fleet_sampler, None
+        if sampler is not None:
+            sampler.stop()
+
+    def fleet_status(self) -> Dict:
+        """GET /fleet: the aggregated scoreboard — fleet-wide when a
+        telemetry exchange is attached (federated), a single-node fold
+        of the local sampler otherwise — plus local sampler state."""
+        sampler = self._fleet_sampler
+        if sampler is None:
+            return {"enabled": False}
+        from .observe import fleet as _fleet  # already loaded: sampler runs
+
+        if sampler.exchange is not None:
+            try:
+                sampler.exchange.pump()
+            except (ConnectionError, TimeoutError, OSError, RuntimeError):
+                pass  # partition: serve the last applied view
+            frames = sampler.exchange.frames()
+            node = sampler.exchange.node_name
+        else:
+            node = "local"
+            frames = {
+                node: _fleet.encode_frame(
+                    node, sampler.ring.appended, sampler.frame_body()
+                )
+            }
+        out = _fleet.aggregate(frames)
+        out["enabled"] = True
+        out["node"] = node
+        out["local"] = sampler.local_status()
+        return out
+
+    def fleet_history(self, limit: int = 64) -> Dict:
+        """GET /fleet/history: newest-last local sampler rows (the
+        ``cilium-tpu fleet history`` payload)."""
+        sampler = self._fleet_sampler
+        if sampler is None:
+            return {"enabled": False, "history": []}
+        return {
+            "enabled": True,
+            "fields": list(sampler.ring.fields),
+            "interval_s": sampler.interval_s,
+            "history": sampler.ring.history(limit),
+        }
+
+    def _slo_summary(self):
+        """One-line SLO block for /status, None while FleetTelemetry
+        is off (status must not wake the fleet plane)."""
+        sampler = self._fleet_sampler
+        if sampler is None:
+            return None
+        return sampler.slo_summary()
 
     def health_report(self) -> Dict:
         """GET /health (the cilium-health status surface)."""
@@ -1346,6 +1464,12 @@ class Daemon:
                     else 0
                 ),
             },
+            # policyd-fleetobs: the one-line SLO summary (worst
+            # objective + state) so health is visible without the
+            # fleet CLI; None while FleetTelemetry is off. /healthz
+            # keys on the plain bool.
+            "slo": (slo := self._slo_summary()),
+            "slo_burning": bool(slo and slo["burning"]),
         }
 
     def _peek_features(self):
@@ -1713,6 +1837,7 @@ class Daemon:
         # degrades) everything in flight, persists CT + compiled +
         # state.json under the deadline
         self.drain(deadline_s=deadline_s)
+        self._stop_fleet_sampler()
         self.controllers.remove_all()
         self.health.stop()
         self.fqdn.stop()

@@ -12,6 +12,9 @@ IP_IDENTITIES_PATH = "cilium/state/ip/v1"
 # policyd-fed: per-node descriptor + policy_epoch records (the
 # federation epoch exchange; federation/epochs.py)
 CLUSTER_EPOCHS_PATH = "cilium/state/epochs/v1"
+# policyd-fleetobs: per-node telemetry frames, published beside the
+# epoch records (observe/fleet.py TelemetryExchange)
+CLUSTER_TELEMETRY_PATH = "cilium/state/telemetry/v1"
 
 
 def key_to_label_strings(key: str):

@@ -27,6 +27,11 @@ def _fmt_labels(key: _LabelKey) -> str:
 
 
 class Counter:
+    # Exposition TYPE word. Subclasses override this instead of
+    # duplicating expose(): the HELP/TYPE header emission lives in
+    # exactly one place, so the two can never drift apart.
+    _TYPE = "counter"
+
     def __init__(self, name: str, help_: str) -> None:
         self.name, self.help = name, help_
         self._values: Dict[_LabelKey, float] = {}
@@ -47,7 +52,10 @@ class Counter:
             return dict(self._values)
 
     def expose(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self._TYPE}",
+        ]
         # snapshot under the lock: a concurrent inc() on a fresh label
         # set would otherwise mutate the dict mid-iteration
         with self._lock:
@@ -58,17 +66,11 @@ class Counter:
 
 
 class Gauge(Counter):
+    _TYPE = "gauge"
+
     def set(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
         with self._lock:
             self._values[_labels_key(labels)] = value
-
-    def expose(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        with self._lock:
-            items = sorted(self._values.items())
-        for k, v in items:
-            out.append(f"{self.name}{_fmt_labels(k)} {v}")
-        return out
 
 
 class _HistSeries:
@@ -496,4 +498,33 @@ state_snapshot_bytes = registry.gauge(
     "cilium_tpu_state_snapshot_bytes",
     "Bytes of the last state-dir snapshot written (label kind: "
     "compiled|ct|state_json)",
+)
+
+# -- policyd-fleetobs (fleet telemetry plane) families ---------------------
+timeseries_snapshots_total = registry.counter(
+    "cilium_tpu_timeseries_snapshots_total",
+    "Sampler ticks appended to the fleet time-series ring (one row "
+    "per FleetTelemetry cadence tick; rate ~= 1/telemetry_sample_s "
+    "while the option is on)",
+)
+slo_burn_ratio = registry.gauge(
+    "cilium_tpu_slo_burn_ratio",
+    "Observed/target burn ratio per declared SLO objective and "
+    "reduction window (labels: objective = the observe/fleet.py "
+    "DEFAULT_OBJECTIVES names, window = 10s|1m|5m; >= 1.0 means the "
+    "objective is out of budget over that window)",
+)
+telemetry_frames_total = registry.counter(
+    "cilium_tpu_telemetry_frames_total",
+    "Fleet telemetry frame outcomes (label result: published = frame "
+    "written to the exchange, publish_error = kvstore down at publish "
+    "time, rejected = peer frame failed version/stamp validation, "
+    "stale = peer frame aged past the staleness horizon at read time)",
+)
+fleet_nodes_reporting = registry.gauge(
+    "cilium_tpu_fleet_nodes_reporting",
+    "Nodes with a live (non-stale, version-compatible) telemetry "
+    "frame in the last fleet aggregation — the scoreboard's liveness "
+    "denominator; drops within seconds of a node dying, ahead of its "
+    "kvstore lease expiry",
 )

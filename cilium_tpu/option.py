@@ -108,6 +108,16 @@ class DaemonConfig:
     prefilter_shed: bool = False
     device_profiling: bool = False
     fault_injection: bool = False
+    # Boot-time value of the FleetTelemetry runtime option (policyd-
+    # fleetobs): the cadence sampler snapshots metric families into
+    # the fleet time-series ring, evaluates SLO burn rates, and (with
+    # a federation membership attached) publishes telemetry frames.
+    fleet_telemetry: bool = False
+    # FleetTelemetry sampler cadence in seconds and ring capacity in
+    # rows; together they bound the observable history window
+    # (capacity × sample_s seconds).
+    telemetry_sample_s: float = 1.0
+    telemetry_ring_rows: int = 600
 
     def validate(self) -> None:
         if self.enforcement_mode not in ("default", "always", "never"):
@@ -133,6 +143,10 @@ class DaemonConfig:
             raise ValueError("dispatch-stall-ms must be >= 0")
         if self.profile_sample_every < 1:
             raise ValueError("profile-sample-every must be >= 1")
+        if self.telemetry_sample_s <= 0:
+            raise ValueError("telemetry-sample-s must be > 0")
+        if self.telemetry_ring_rows < 2:
+            raise ValueError("telemetry-ring-rows must be >= 2")
         if not 2 <= self.mesh_ident_axis <= 64:
             raise ValueError("mesh-ident-axis must be 2-64")
         if self.mesh_process_index < 0:
@@ -286,6 +300,18 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
             "exchange policy epochs; off restores the local registry "
             "allocator — numbering is the only difference, compiled "
             "device programs are bit-identical either way",
+        ),
+        OptionSpec(
+            "FleetTelemetry",
+            "Fleet telemetry plane (policyd-fleetobs): a cadence "
+            "sampler thread snapshots verdict/drop/shed rates, phase "
+            "quantiles, pipeline mode and epoch lag into a bounded "
+            "time-series ring, evaluates multi-window SLO burn rates "
+            "(slo_burn_ratio gauges, /status summary), and — when a "
+            "federation membership is attached — publishes versioned "
+            "telemetry frames for the fleet scoreboard (GET /fleet); "
+            "off starts no thread and never imports the frame codec — "
+            "the verdict path is bit-identical",
         ),
         OptionSpec(
             "Prefilter",

@@ -1,0 +1,38 @@
+"""OBS002 fixture: interpolated metric label values at hot call sites
+(three positives), bounded-key / literal / suppressed negatives."""
+# policyd: hot
+
+
+class _Fam:
+    def inc(self, n=1, labels=None):
+        pass
+
+    def set(self, v, labels=None):
+        pass
+
+    def observe(self, v, labels=None):
+        pass
+
+
+verdicts_total = _Fam()
+queue_depth = _Fam()
+latency_seconds = _Fam()
+
+
+def tick(identity, ep_id, d, outcome):
+    # POS: f-string of an identity id — unbounded series domain
+    verdicts_total.inc(1, {"id": f"{identity}"})
+    # POS: str() of an endpoint id
+    queue_depth.set(3, {"endpoint": str(ep_id)})
+    # POS: %-formatting of an address-shaped value
+    latency_seconds.observe(0.1, {"peer": "ip-%s" % ep_id})
+    # NEG: "device" is in METRIC_BOUNDED_LABEL_KEYS (mesh-bounded)
+    verdicts_total.inc(1, {"outcome": outcome, "device": str(int(d))})
+    # NEG: literal label values
+    verdicts_total.inc(1, {"outcome": "forwarded"})
+    # NEG: a bare name is not an interpolation (vocabulary decided
+    # upstream — OBS002 only judges the call-site shape)
+    verdicts_total.inc(1, {"outcome": outcome})
+    # NEG: justified exception
+    # policyd-lint: disable=OBS002
+    verdicts_total.inc(1, {"ring": str(ep_id)})

@@ -223,6 +223,42 @@ class TestMetricsExposition:
         assert 't_obs_h_sum{phase="a"} 5.05' in lines
         assert 't_obs_h_count{phase="a"} 2' in lines
 
+    def test_type_header_golden_never_drifts(self):
+        """Counter/Gauge share one expose() (Gauge only overrides
+        _TYPE): the HELP/TYPE header pair must be the first two lines
+        of every family's exposition, with the TYPE word matching the
+        metric kind — byte-for-byte, so the headers can never drift
+        from the values again."""
+        c = metrics.Counter("t_hdr_c", "counter help")
+        g = metrics.Gauge("t_hdr_g", "gauge help")
+        h = metrics.Histogram("t_hdr_h", "histogram help", buckets=(1.0,))
+        c.inc({"outcome": "forwarded"}, 2.0)
+        g.set(7.0)
+        assert c.expose() == [
+            "# HELP t_hdr_c counter help",
+            "# TYPE t_hdr_c counter",
+            't_hdr_c{outcome="forwarded"} 2.0',
+        ]
+        assert g.expose() == [
+            "# HELP t_hdr_g gauge help",
+            "# TYPE t_hdr_g gauge",
+            "t_hdr_g 7.0",
+        ]
+        assert h.expose()[:2] == [
+            "# HELP t_hdr_h histogram help",
+            "# TYPE t_hdr_h histogram",
+        ]
+        # the process-wide registry: exactly one TYPE line per family,
+        # and the TYPE word agrees with the python class everywhere
+        text = metrics.registry.expose()
+        type_lines = [l for l in text.splitlines() if l.startswith("# TYPE ")]
+        names = [l.split()[2] for l in type_lines]
+        assert len(names) == len(set(names))
+        by_name = {l.split()[2]: l.split()[3] for l in type_lines}
+        for name, obj in metrics.registry._metrics.items():
+            want = getattr(obj, "_TYPE", "histogram")
+            assert by_name[name] == want, name
+
 
 class TestEngineTelemetry:
     def test_refresh_kinds_observed(self):

@@ -263,6 +263,60 @@ def test_obs001_missing_readme_flags_everything(tmp_path):
     assert "no observe/README.md" in f[0].message
 
 
+def test_obs002_fixture_positives_and_negatives():
+    """f-string / str() / %-format label values in a hot module are
+    flagged; the bounded 'device' key, literal values, bare names, and
+    the suppressed site stay silent."""
+    f = analyze_paths([fixture("obs_labels.py")])
+    obs = [x for x in f if x.rule == "OBS002"]
+    assert lines_of(f, "OBS002") == [24, 26, 28]
+    assert all(x.severity == "warning" for x in obs)
+    msgs = "\n".join(x.message for x in obs)
+    for key in ("'id'", "'endpoint'", "'peer'"):
+        assert key in msgs
+    assert "'device'" not in msgs and "'ring'" not in msgs
+
+
+def test_obs002_cold_module_is_exempt(tmp_path):
+    """The same interpolated-label shape outside a hot module is not
+    flagged — OBS002 polices the per-batch verdict path, not one-shot
+    registration-time plumbing."""
+    mod = tmp_path / "cold.py"
+    mod.write_text(
+        "class _F:\n"
+        "    def inc(self, n, labels=None):\n"
+        "        pass\n"
+        "fam = _F()\n"
+        "def tick(identity):\n"
+        "    fam.inc(1, {'id': f'{identity}'})\n"
+    )
+    assert lines_of(analyze_paths([str(mod)]), "OBS002") == []
+
+
+def test_obs002_allowed_table_resolves_from_fixture_contracts(tmp_path):
+    """A fixture package defining METRIC_BOUNDED_LABEL_KEYS in its own
+    contracts.py overrides the shipped table (the _Canon resolution
+    every Family C rule uses)."""
+    (tmp_path / "contracts.py").write_text(
+        'METRIC_BOUNDED_LABEL_KEYS = ("peer",)\n'
+    )
+    hot = tmp_path / "hot.py"
+    hot.write_text(
+        "# policyd: hot\n"
+        "class _F:\n"
+        "    def inc(self, n, labels=None):\n"
+        "        pass\n"
+        "fam = _F()\n"
+        "def tick(addr):\n"
+        "    fam.inc(1, {'peer': str(addr)})\n"
+        "    fam.inc(1, {'device': str(addr)})\n"
+    )
+    f = analyze_paths([str(tmp_path)])
+    # 'peer' is allowed by the local table; 'device' (allowed only in
+    # the SHIPPED table) is now flagged — the local canon won
+    assert lines_of(f, "OBS002") == [8]
+
+
 def test_obs001_package_metrics_stay_documented():
     """The real catalogue gate: every family registered in metrics.py
     is documented in observe/README.md (beyond-baseline drift is also
@@ -497,7 +551,7 @@ def test_family_c_repo_stays_clean():
     """The shipping package + bench.py satisfy every Family C contract
     outright (no baseline entries, no suppressions)."""
     f = analyze_paths([PKG, BENCH])
-    for rule in ("OPT001", "OPT002", "API001", "BENCH001"):
+    for rule in ("OPT001", "OPT002", "API001", "BENCH001", "OBS002"):
         offenders = [x.render() for x in f if x.rule == rule]
         assert offenders == [], f"{rule} regressions:\n" + "\n".join(offenders)
 
