@@ -1258,7 +1258,10 @@ def _chaos_overload(eng, cache, idents, nrng, attached):
     )
     pipe.set_stall_ms(100.0)
 
-    reason0 = _m.drop_reasons_total.get({"reason": "prefilter"})
+    # the overload round sheds at the HOST admission gate — reason
+    # 144's producer="admission" slice, not the device prefilter's
+    reason0 = _m.drop_reasons_total.get(
+        {"reason": "prefilter", "producer": "admission"})
     _faults.hub.fail(_faults.SITE_QUEUE_FULL, _faults.KIND_TRANSIENT, times=4)
     _faults.hub.fail(_faults.SITE_STALL, _faults.KIND_TRANSIENT, times=2)
 
@@ -1298,7 +1301,9 @@ def _chaos_overload(eng, cache, idents, nrng, attached):
         ),
         "shed_verdict_flows": shed_verdicts,
         "reason_144_flows": int(
-            _m.drop_reasons_total.get({"reason": "prefilter"}) - reason0
+            _m.drop_reasons_total.get(
+                {"reason": "prefilter", "producer": "admission"})
+            - reason0
         ),
         "admission_limit": adm["limit"],
         "admission_shed": adm["shed"],
@@ -1315,7 +1320,12 @@ def _chaos_federation(attached):
     both landing during concurrent two-node identity allocation. The
     reserve/confirm allocator must converge to identical injective
     id maps (zero double-assigns), ride ``utils/backoff`` through the
-    partition, and ``run_gc`` must reap only the dead node's ids."""
+    partition, and ``run_gc`` must reap only the dead node's ids.
+
+    A journal leg rides along (policyd-journal): three event journals
+    with wall clocks skewed ±120s exchange tail frames over the same
+    store — the merged fleet timeline must stay HLC-consistent with
+    the causal emission order preserved despite the skew."""
     import threading
 
     from cilium_tpu.federation import ClusterIdentityAllocator
@@ -1368,6 +1378,49 @@ def _chaos_federation(attached):
 
     reaped = a.run_gc()  # release-on-lease-expiry: c's masters go
     ids = sorted(got["a"].values())
+
+    # --- merged fleet timeline under injected wall-clock skew
+    attached.stage("chaos-fed-timeline")
+    from cilium_tpu.observe import journal as _journal
+
+    skews = {"jn-a": 120.0, "jn-b": 0.0, "jn-c": -120.0}
+    journals, pubs = {}, {}
+    for name, skew in skews.items():
+        j = _journal.EventJournal(
+            node=name, capacity=64,
+            clock=(lambda s=skew: time.time() + s),
+        )
+        pub = _journal.JournalPublisher(j, tail_n=32)
+        pub.attach_exchange(_journal.JournalExchange(
+            InMemoryBackend(store, name), name, cluster="chaos-journal",
+        ))
+        journals[name], pubs[name] = j, pub
+    # a causal chain hopping across the skewed nodes: every node hears
+    # the fleet (publish_once folds peer HLCs) before its own step, so
+    # the merge order must reproduce the emission order even though
+    # jn-c's wall clock lags jn-a's by 240s
+    chain = [
+        ("jn-a", "drain_begin"), ("jn-b", "boot"),
+        ("jn-c", "ct_restore"), ("jn-a", "drain_end"),
+        ("jn-b", "rebuild"), ("jn-c", "restore_done"),
+    ]
+    for name, kind in chain:
+        for pub in pubs.values():
+            pub.publish_once()
+        journals[name].emit(kind=kind)
+        pubs[name].publish_once()
+    merged = pubs["jn-b"].merged_timeline(limit=64)
+    timeline_ok = (
+        _journal.timeline_consistent(merged)
+        and [e["kind"] for e in merged] == [k for _, k in chain]
+    )
+    assert timeline_ok, (
+        "skewed 3-node merge broke causal order: "
+        + str([(e["node"], e["kind"]) for e in merged])
+    )
+    for pub in pubs.values():
+        pub.stop()
+
     return {
         "keys": len(keys),
         "identical_maps": got["a"] == got["b"],
@@ -1377,6 +1430,9 @@ def _chaos_federation(attached):
         "reap_sound": set(reaped) == c_ids,
         "partition_retries": b.state()["allocations"].get("retry", 0),
         "kv_op_errors": flaky.op_errors,
+        "timeline_nodes": len(skews),
+        "timeline_skew_spread_s": 240.0,
+        "timeline_hlc_consistent": bool(timeline_ok),
     }
 
 
@@ -1392,6 +1448,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import numpy as np
 
 phase, state_dir = sys.argv[1], sys.argv[2]
+
+from cilium_tpu.option import DaemonConfig, set_config
+
+# every phase boots with the lifecycle journal on — the chaos round
+# asserts the journal-derived restore/drain story against the
+# independently measured numbers (policyd-journal)
+set_config(DaemonConfig(lifecycle_journal=True))
 
 from cilium_tpu.daemon import Daemon
 from cilium_tpu.ops.lpm import ip_strings_to_u32
@@ -1449,6 +1512,15 @@ elif phase == "restore":
     dm._save_compiled_snapshot(force=True)
     dm._save_ct_snapshot(force=True)
     from cilium_tpu import metrics as _m
+    # the journal's version of the same restart: boot anchors the
+    # downtime window, ct_restore carries the basis verdict,
+    # restore_done closes the window
+    jevs = dm.events(limit=128)["events"]
+    jfirst = {}
+    for e in jevs:
+        jfirst.setdefault(e["kind"], e)
+    jboot, jct = jfirst.get("boot"), jfirst.get("ct_restore")
+    jdone = jfirst.get("restore_done")
     print("RESULT " + json.dumps({
         "downtime_ms": downtime_ms,
         "downtime_gauge_ms": _m.restart_downtime_seconds.get() * 1000.0,
@@ -1458,6 +1530,11 @@ elif phase == "restore":
         "basis_match": bool(info.get("basis_match", False)),
         "verdict_forward": bool(int(v[0]) == 1),
         "ct_len": len(dm.conntrack),
+        "journal_basis_match": bool(
+            jct and jct["attrs"].get("basis_match", False)),
+        "journal_downtime_ms": (
+            (jdone["wall_ts"] - jboot["wall_ts"]) * 1000.0
+            if jboot and jdone else -1.0),
     }), flush=True)
 
 elif phase == "mutate":
@@ -1494,6 +1571,16 @@ elif phase == "drain":
         rep = dm.drain(deadline_s=5.0)
         rep = {k: v for k, v in rep.items()
                if isinstance(v, (int, float, bool, str))}
+        # the drain bracket on the journal: drain_begin ... drain_end
+        # with the structural zero-loss stamp in drain_end's attrs
+        jevs = dm.events(limit=128)["events"]
+        kinds = [e["kind"] for e in jevs]
+        jend = [e for e in jevs if e["kind"] == "drain_end"]
+        rep["journal_drain_bracket"] = bool(
+            "drain_begin" in kinds and "drain_end" in kinds
+            and kinds.index("drain_begin") < kinds.index("drain_end"))
+        rep["journal_drain_verdicts_lost"] = (
+            int(jend[-1]["attrs"]["verdicts_lost"]) if jend else -1)
         print("DRAIN " + json.dumps(rep), flush=True)
         sys.exit(0)
 '''
@@ -1562,6 +1649,16 @@ sampler = dm._fleet_sampler
 sampler.attach_exchange(TelemetryExchange(
     FileBackend(store_path, node, lease_ttl=60.0), node, cluster="bench",
 ))
+# lifecycle journal beside the telemetry plane (policyd-journal): the
+# rebuild/epoch_swap events from the storm below ride tail frames the
+# parent merges into one fleet timeline
+dm.config_patch({"LifecycleJournal": "true"})
+dm._journal.node = node  # unfederated daemon defaults to "local"
+from cilium_tpu.observe.journal import JournalExchange
+dm._journal_publisher.attach_exchange(JournalExchange(
+    FileBackend(store_path, node + "-j", lease_ttl=60.0), node,
+    cluster="bench",
+))
 
 peers = ip_strings_to_u32(["10.0.0.2"] * N)
 eps = np.zeros(N, np.int32)
@@ -1596,6 +1693,10 @@ def _bench_fleetobs(attached):
     - aggregation parity: the scoreboard's fleet vps must match the
       sum of the drivers' independently-accounted verdict rates
       within tolerance;
+    - timeline: every node also publishes its lifecycle-journal tail
+      (LifecycleJournal on) — the parent merges the three tails into
+      one fleet timeline that must be HLC-consistent;
+
     - chaos: one node dies by SIGKILL — its frames age out by
       wall-clock staleness (the lease is deliberately slower), the
       scoreboard drops to 2 reporting nodes, nothing crashes."""
@@ -1663,6 +1764,36 @@ def _bench_fleetobs(attached):
         )
         worst = agg.get("worst_burn") or {}
 
+        # merged fleet timeline (policyd-journal): every node's journal
+        # tail frame must be live on the store and the merge must be
+        # HLC-consistent
+        attached.stage("fleetobs-timeline")
+        from cilium_tpu.observe import journal as _journal
+
+        jex = _journal.JournalExchange(
+            FileBackend(path, "bench-agg-j", lease_ttl=60.0),
+            "bench-agg", cluster="bench",
+        )
+        jdeadline = time.time() + 30.0
+        jframes = {}
+        while time.time() < jdeadline:
+            jex.pump()
+            jframes = jex.frames()
+            if len(jframes) == len(names):
+                break
+            time.sleep(0.2)
+        assert set(jframes) == set(names), (
+            f"journal frames from {sorted(jframes)}, expected {names}"
+        )
+        jmerged = _journal.merge_timelines(jframes)
+        timeline_ok = bool(jmerged) and _journal.timeline_consistent(
+            jmerged)
+        assert timeline_ok, "merged fleet timeline not HLC-consistent"
+        journal_events = sum(
+            len(f.get("events", [])) for f in jframes.values()
+        )
+        jex.close()
+
         attached.stage("fleetobs-kill")
         procs[-1].kill()  # SIGKILL: no drain, no lease revoke
         procs[-1].wait()
@@ -1689,6 +1820,8 @@ def _bench_fleetobs(attached):
             "slo_worst_objective": worst.get("objective") or "",
             "nodes_reporting_after_kill": int(agg2["nodes_reporting"]),
             "kill_survived": True,
+            "timeline_merge_ok": bool(timeline_ok),
+            "journal_events_total": int(journal_events),
         }
     finally:
         for p in procs:
@@ -1708,7 +1841,12 @@ def _chaos_survive(attached):
     - SIGTERM drain: in-flight storm completes, state persists,
       ``verdicts_lost == 0``, exit code 0;
     - torn write: SITE_STATE_WRITE truncates ct.npz mid-write -> the
-      next boot classifies, cold-starts, never crashes."""
+      next boot classifies, cold-starts, never crashes.
+
+    Every daemon boots with LifecycleJournal on: the journal's event
+    spine (boot/ct_restore/restore_done, drain_begin/drain_end) is
+    asserted against the independently measured downtime, basis
+    verdict, and zero-loss drain (policyd-journal)."""
     import signal as _signal
     import tempfile
 
@@ -1729,6 +1867,23 @@ def _chaos_survive(attached):
     keep = json.loads(_drv_expect(rest, "RESULT ")[len("RESULT "):])
     rest.wait(timeout=60)
 
+    # journal-derived restore invariants (policyd-journal): the event
+    # spine must tell the same restart story the measured numbers do
+    assert keep["journal_basis_match"] == keep["basis_match"], (
+        "journal ct_restore event disagrees with ct_restore_info"
+    )
+    jdt = keep["journal_downtime_ms"]
+    assert jdt > 0, "journal boot/restore_done events missing"
+    # boot→restore_done wall span vs the driver's perf_counter window:
+    # same restart, two clocks — they must agree within ±20% (with a
+    # small absolute floor so a near-instant warm restore can't flake)
+    assert abs(jdt - keep["downtime_ms"]) <= max(
+        0.2 * keep["downtime_ms"], 50.0
+    ), (
+        f"journal downtime {jdt:.1f}ms vs measured "
+        f"{keep['downtime_ms']:.1f}ms"
+    )
+
     # --- leg 2: raced rule change voids the stale CT snapshot
     attached.stage("chaos-restart-raced")
     mut = _drv_spawn("mutate", sdir)
@@ -1747,6 +1902,15 @@ def _chaos_survive(attached):
     drainp.send_signal(_signal.SIGTERM)
     drain_rep = json.loads(_drv_expect(drainp, "DRAIN ")[len("DRAIN "):])
     drain_rc = drainp.wait(timeout=60)
+    # the journal brackets the drain with verdicts_lost == 0 stamped
+    # in drain_end — the invariant a rolling-restart runbook reads
+    assert drain_rep["journal_drain_bracket"], (
+        "drain_begin/drain_end events missing or out of order"
+    )
+    assert drain_rep["journal_drain_verdicts_lost"] == 0, (
+        f"journal drain_end carries verdicts_lost="
+        f"{drain_rep['journal_drain_verdicts_lost']}"
+    )
 
     # --- leg 4: torn CT write -> next boot cold-starts, no crash
     attached.stage("chaos-torn-write")
@@ -1792,6 +1956,10 @@ def _chaos_survive(attached):
         "restart_basis_match": bool(keep["basis_match"]),
         "restart_established_forward": bool(keep["verdict_forward"]),
         "restart_downtime_gauge_ms": round(keep["downtime_gauge_ms"], 3),
+        # journal-derived mirror of leg 1 (asserted above)
+        "journal_restore_basis_match": bool(keep["journal_basis_match"]),
+        "journal_restore_downtime_ms": round(
+            keep["journal_downtime_ms"], 3),
         # leg 2: stale snapshot classified, cold-flushed
         "raced_flushed": raced["flushed"],
         "raced_basis_match": bool(raced["basis_match"]),
@@ -1799,6 +1967,7 @@ def _chaos_survive(attached):
         # leg 3: graceful drain
         "drain_exit_code": drain_rc,
         "drain_verdicts_lost": drain_rep["verdicts_lost"],
+        "journal_drain_bracket": bool(drain_rep["journal_drain_bracket"]),
         "drain_report": drain_rep,
         # leg 4: torn write never crashes a boot
         "torn_ct_bytes": torn_bytes,

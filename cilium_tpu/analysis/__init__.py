@@ -30,6 +30,7 @@ from .core import Finding, ModuleSource
 from .hotpath import analyze_hotpath
 from .locks import LockIndex, analyze_locks_module, cycle_findings
 from .obsdocs import analyze_obsdocs
+from .obsjournal import analyze_obsjournal
 from .obslabels import analyze_obslabels
 
 __all__ = [
@@ -122,6 +123,7 @@ def analyze_paths(
     findings.extend(cycle_findings(all_edges))
     findings.extend(analyze_contracts(modules, graph))
     findings.extend(analyze_obslabels(modules))
+    findings.extend(analyze_obsjournal(modules))
 
     if changed is not None:
         closure = graph.dependents_of(list(changed))

@@ -78,6 +78,7 @@ _CANON_NAMES = (
     "BENCH_BOOKKEEPING_KEYS",
     "OPTION_BOOT_FIELDS",
     "METRIC_BOUNDED_LABEL_KEYS",
+    "JOURNAL_KINDS",
 )
 
 

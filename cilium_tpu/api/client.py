@@ -204,3 +204,17 @@ class APIClient:
 
     def fleet_history(self, limit: int = 64):
         return self._request("GET", f"/fleet/history?limit={limit}")
+
+    def fleet_timeline(self, limit: int = 256):
+        return self._request("GET", f"/fleet/timeline?limit={limit}")
+
+    def events_get(self, limit: int = 64, *, kind=None, severity=None,
+                   since=None):
+        params = [f"limit={limit}"]
+        if kind is not None:
+            params.append(f"kind={kind}")
+        if severity is not None:
+            params.append(f"severity={severity}")
+        if since is not None:
+            params.append(f"since={since}")
+        return self._request("GET", "/events?" + "&".join(params))

@@ -151,6 +151,17 @@ class _Handler(UnixHandler):
         elif path == "/fleet/history" and method == "GET":
             limit = int(q.get("limit", ["64"])[0])
             self._json(200, d.fleet_history(limit=limit))
+        elif path == "/fleet/timeline" and method == "GET":
+            limit = int(q.get("limit", ["256"])[0])
+            self._json(200, d.fleet_timeline(limit=limit))
+        elif path == "/events" and method == "GET":
+            since = q.get("since", [None])[0]
+            self._json(200, d.events(
+                limit=int(q.get("limit", ["64"])[0]),
+                kind=q.get("kind", [None])[0],
+                severity=q.get("severity", [None])[0],
+                since=float(since) if since is not None else None,
+            ))
         elif (m := re.fullmatch(r"/map/(\w+)", path)) and method == "GET":
             self._json(200, d.map_dump(m.group(1)))
         elif path == "/ipam" and method == "POST":

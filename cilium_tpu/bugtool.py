@@ -17,6 +17,27 @@ import tarfile
 import time
 from typing import Dict
 
+# Every bundled JSON artifact that external tooling diffs offline
+# carries a top-level identification header: ``schema`` (the artifact
+# vocabulary, versioned independently of the package) + ``generated_at``
+# (one capture-wide wall timestamp — all artifacts of an archive stamp
+# the SAME instant, so cross-artifact joins don't skew).
+ARTIFACT_SCHEMAS: Dict[str, str] = {
+    "traces": "cilium-tpu/traces/v1",
+    "flows": "cilium-tpu/flows/v1",
+    "profile": "cilium-tpu/profile/v1",
+    "fleet": "cilium-tpu/fleet/v1",
+    "ct": "cilium-tpu/ct/v1",
+    "cluster": "cilium-tpu/cluster/v1",
+    "events": "cilium-tpu/events/v1",
+}
+
+
+def _stamp(key: str, payload: Dict, ts: float) -> Dict:
+    out = {"schema": ARTIFACT_SCHEMAS[key], "generated_at": ts}
+    out.update(payload)
+    return out
+
 
 def collect_debuginfo(daemon) -> Dict:
     """The GET /debuginfo payload (daemon/debuginfo.go)."""
@@ -38,8 +59,9 @@ def collect_debuginfo(daemon) -> Dict:
         except Exception as e:  # a broken endpoint must not kill capture
             policymaps[eid] = {"error": f"{type(e).__name__}: {e}"}
     ct = daemon.conntrack
+    now = time.time()
     return {
-        "timestamp": time.time(),
+        "timestamp": now,
         "status": daemon.status(),
         "policy": daemon.policy_get(),
         "endpoints": endpoints,
@@ -56,13 +78,13 @@ def collect_debuginfo(daemon) -> Dict:
         # summary plus the provenance of the last restart's CT restore
         # (where it loaded from, snapshot age, kept vs flushed), so an
         # operator can tell a warm restart from a forced cold flush
-        "ct": {
+        "ct": _stamp("ct", {
             "entries": len(ct) if ct is not None else 0,
             "capacity": ct.capacity if ct is not None else 0,
             "version": ct.version if ct is not None else 0,
             "sample": daemon.ct_dump()[:32],
             "restore": daemon.ct_restore_info(),
-        },
+        }, now),
         "fqdn": {
             "names": daemon.fqdn.tracked_names(),
             "failures": daemon.fqdn.failures,
@@ -70,22 +92,26 @@ def collect_debuginfo(daemon) -> Dict:
         "health": daemon.health.report(),
         # policyd-fed → cluster.json: federation membership, per-node
         # published policy epochs, and identity-allocator accounting
-        "cluster": daemon.cluster_status(),
+        "cluster": _stamp("cluster", daemon.cluster_status(), now),
         # policyd-fleetobs → fleet.json: the aggregated telemetry
         # scoreboard ({"enabled": false} when FleetTelemetry is off)
-        "fleet": daemon.fleet_status(),
+        "fleet": _stamp("fleet", daemon.fleet_status(), now),
         "accesslog": [r.to_dict() for r in daemon.proxy.accesslog.recent(200)],
         # policyd-trace ring (metrics.prom in the archive carries the
         # matching /metrics snapshot via write_archive_from)
-        "traces": daemon.traces(limit=64),
+        "traces": _stamp("traces", daemon.traces(limit=64), now),
         # policyd-flows ring → flows.json in the archive: the sampled
         # attributed flows an operator replays offline against
         # policy.json to explain each verdict
-        "flows": daemon.flows(limit=64),
+        "flows": _stamp("flows", daemon.flows(limit=64), now),
         # policyd-prof → profile.json: sampled RTT decomposition +
         # memory/transfer ledgers, so offline bundles carry the full
         # telemetry surface
-        "profile": daemon.profile(),
+        "profile": _stamp("profile", daemon.profile(), now),
+        # policyd-journal → events.json: the lifecycle event journal
+        # tail ({"enabled": false} while LifecycleJournal is off), the
+        # causal spine an operator lines the other artifacts up against
+        "events": _stamp("events", daemon.events(limit=256), now),
         # raw Prometheus exposition IN the payload: a remote
         # /debuginfo fetch then archives the same metrics.prom a
         # live-daemon capture gets (write_archive_from pops this key)

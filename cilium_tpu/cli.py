@@ -201,6 +201,15 @@ class _Surface:
     def _d_fleet_history(self, limit=64):
         return self._daemon.fleet_history(limit=limit)
 
+    def _d_fleet_timeline(self, limit=256):
+        return self._daemon.fleet_timeline(limit=limit)
+
+    def _d_events_get(self, limit=64, *, kind=None, severity=None,
+                      since=None):
+        return self._daemon.events(
+            limit=limit, kind=kind, severity=severity, since=since
+        )
+
 
 def _parse_frontend(text: str) -> dict:
     """'10.96.0.10:80/TCP' → frontend dict (cilium service update
@@ -226,6 +235,24 @@ def _print(obj) -> None:
         print(obj, end="" if obj.endswith("\n") else "\n")
     else:
         print(json.dumps(obj, indent=2))
+
+
+def _print_journal_lines(events, *, with_node=False) -> None:
+    """One line per lifecycle event: wall time, severity, kind, attrs
+    (`cilium-tpu events` / `fleet timeline` shared renderer)."""
+    import datetime as _dt
+
+    for ev in events:
+        ts = _dt.datetime.fromtimestamp(ev["wall_ts"])
+        node = f"{ev.get('node', '-'):<12} " if with_node else ""
+        attrs = ev.get("attrs") or {}
+        rest = " ".join(
+            f"{k}={json.dumps(attrs[k])}" for k in sorted(attrs)
+        )
+        print(
+            f"{ts:%H:%M:%S}.{ts.microsecond // 1000:03d} "
+            f"{ev['severity']:<8} {node}{ev['kind']:<15} {rest}"
+        )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -291,6 +318,20 @@ def build_parser() -> argparse.ArgumentParser:
                           "identity")
     flw.add_argument("--json", action="store_true",
                      help="raw flow dicts instead of one-liners")
+
+    # policyd-journal: the causally-ordered lifecycle event journal
+    evt = sub.add_parser(
+        "events", help="lifecycle event journal (policyd-journal)"
+    )
+    evt.add_argument("-n", "--last", type=int, default=20,
+                     help="how many events to show (default 20)")
+    evt.add_argument("--kind", default=None,
+                     help="only this event kind (contracts.JOURNAL_KINDS)")
+    evt.add_argument("--severity", default=None,
+                     choices=["info", "warning", "error"],
+                     help="only this severity")
+    evt.add_argument("--json", action="store_true",
+                     help="raw event dicts instead of one-liners")
 
     # daemon
     d = sub.add_parser("daemon", help="run the agent + API server")
@@ -497,6 +538,14 @@ def build_parser() -> argparse.ArgumentParser:
                      help="how many ring samples to show (default 32)")
     flh.add_argument("--json", action="store_true",
                      help="raw sample dicts instead of one-liners")
+    # policyd-journal: per-node journals merged into one HLC order
+    flt = fl.add_parser(
+        "timeline", help="merged fleet lifecycle timeline (policyd-journal)"
+    )
+    flt.add_argument("-n", "--last", type=int, default=64,
+                     help="how many merged events to show (default 64)")
+    flt.add_argument("--json", action="store_true",
+                     help="raw merged-timeline dict instead of one-liners")
     mp2 = sub.add_parser("map", help="open-map inventory").add_subparsers(
         dest="sub", required=True
     )
@@ -1308,6 +1357,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                 shown = len(out.get("flows", ()))
                 print(f"({shown} shown; {out['recorded']} recorded "
                       "since enable; drops sampled first)")
+    elif args.cmd == "events":
+        out = s.events_get(
+            limit=args.last, kind=args.kind, severity=args.severity
+        )
+        if args.json:
+            _print(out)
+        elif not out.get("enabled"):
+            print("lifecycle journal is disabled (enable with "
+                  "`cilium-tpu config LifecycleJournal=true`)")
+        else:
+            _print_journal_lines(out.get("events", ()))
+            if out.get("dropped", 0):
+                print(f"({out['dropped']} event(s) dropped to the ring "
+                      "bound since enable)")
     elif args.cmd == "bugtool":
         import time as _time
 
@@ -1339,7 +1402,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         st = s.cluster_status()
         _print(st.get("nodes", []) if args.sub == "nodes" else st)
     elif args.cmd == "fleet":
-        if args.sub == "history":
+        if args.sub == "timeline":
+            out = s.fleet_timeline(limit=args.last)
+            if args.json:
+                _print(out)
+            elif not out.get("enabled"):
+                print("lifecycle journal is disabled (enable with "
+                      "`cilium-tpu config LifecycleJournal=true`)")
+            else:
+                _print_journal_lines(out.get("events", ()),
+                                     with_node=True)
+                nodes = out.get("nodes", ())
+                flag = "" if out.get("consistent", True) else \
+                    "  HLC ORDER VIOLATION"
+                print(f"({len(nodes)} node(s) merged: "
+                      f"{', '.join(nodes)}){flag}")
+        elif args.sub == "history":
             out = s.fleet_history(limit=args.last)
             if args.json:
                 _print(out)

@@ -144,7 +144,62 @@ METRIC_BOUNDED_LABEL_KEYS: Tuple[str, ...] = (
     # bounded by the IP family domain ("v4"/"v6" — pipeline dispatch
     # pad-lane accounting)
     "family",
+    # bounded by the reason-144 producer taxonomy: the host admission
+    # gate and the device prefilter kernel are the ONLY two emitters of
+    # REASON_PREFILTER drops (observe/README.md "two producers" note)
+    "producer",
 )
+
+# -- lifecycle journal event kinds (observe/journal.py) ---------------
+# The structured lifecycle-event vocabulary (policyd-journal). STABLE:
+# fleet timelines are merged across nodes running different commits,
+# bugtool events.json archives are diffed offline, and bench --chaos
+# asserts against specific kinds — renaming one breaks all three.
+# OBS003 checks every ``emit(kind="...")`` literal in the package
+# against this table (and flags stale rows no emitter references).
+JOURNAL_KINDS: Tuple[str, ...] = (
+    # daemon boot completed (attrs: pipeline_mode, policy_epoch)
+    "boot",
+    # CT snapshot restore verdict (attrs: kept/expired/flushed counts,
+    # basis_match, snapshot_age_s)
+    "ct_restore",
+    # first verdict batch completed after a restart — closes the
+    # boot-anchored downtime window (attrs: downtime_ms)
+    "restore_done",
+    # compiled-policy or CT snapshot written to disk (attrs: what,
+    # basis / ct_epoch)
+    "snapshot_save",
+    # materialization rebuild committed a new served basis (attrs:
+    # prev/new _mat_basis, policy_epoch)
+    "rebuild",
+    # shadow-built table generation installed (attrs: policy_epoch,
+    # basis)
+    "epoch_swap",
+    # degradation-ladder transition (attrs: from/to mode names)
+    "ladder_move",
+    # device quarantined (attrs: device, ct_epoch, CT rescue outcome)
+    "quarantine",
+    # edge-triggered admission shed episode opened (attrs: reason)
+    "shed_start",
+    # shed episode closed (attrs: per-reason shed deltas, duration_s)
+    "shed_end",
+    # graceful drain entered (attrs: pipeline_mode, policy_epoch)
+    "drain_begin",
+    # drain finished (attrs: drain_s, verdicts_lost, flushed counts)
+    "drain_end",
+    # watchdog declared a verdict-path stall (attrs: site, age_ms)
+    "watchdog_stall",
+    # federation heartbeat found master keys lost to lease expiry and
+    # re-asserted them (attrs: repaired count)
+    "lease_lost",
+    # federation GC reaped orphaned master identities (attrs: reaped
+    # ids)
+    "identity_reap",
+)
+
+# Journal severity domain: bounds the journal_events_total{severity}
+# label and the GET /events?severity= filter.
+JOURNAL_SEVERITIES: Tuple[str, ...] = ("info", "warning", "error")
 
 # -- runtime options ↔ DaemonConfig boot fields (option.py) -----------
 # OPT001: every option registered in OPTION_SPECS needs an entry here.
@@ -184,4 +239,5 @@ OPTION_BOOT_FIELDS: Dict[str, Optional[str]] = {
     # DaemonConfig time
     "ClusterFederation": None,
     "Prefilter": "prefilter_shed",
+    "LifecycleJournal": "lifecycle_journal",
 }

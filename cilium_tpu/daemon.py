@@ -184,6 +184,14 @@ class Daemon:
         self._fleet_sampler = None
         self._telemetry_sample_s = cfg.telemetry_sample_s
         self._telemetry_ring_rows = cfg.telemetry_ring_rows
+        # policyd-journal: the LifecycleJournal slots + boot knobs,
+        # same pre-seeding discipline as the sampler above; None while
+        # the option is off (the journal plane stays unimported)
+        self._journal = None
+        self._journal_publisher = None
+        self._journal_capacity = cfg.journal_ring_capacity
+        self._journal_publish_s = cfg.journal_publish_s
+        self._journal_tail_n = cfg.journal_tail_n
         # runtime-mutable option map (pkg/option: PATCH /config /
         # `cilium config`); endpoints inherit it (applyOptsLocked)
         self.options = OptionMap()
@@ -213,9 +221,17 @@ class Daemon:
             ("DeviceProfiling", cfg.device_profiling),
             ("FaultInjection", cfg.fault_injection),
             ("FleetTelemetry", cfg.fleet_telemetry),
+            ("LifecycleJournal", cfg.lifecycle_journal),
         ):
             if boot_on:
                 self.options.set(opt_name, True)
+        # daemon boot marker: the journal's causal anchor for the
+        # restart-downtime window (restore_done closes it). Emitted
+        # here — before restore_state — so journal-computed downtime
+        # spans the same window as restart_downtime_seconds.
+        self._journal_emit(kind="boot", attrs={
+            "policy_epoch": self.pipeline.policy_epoch,
+        })
         # fleet regeneration is synchronous by default (tests and
         # small deployments observe effects immediately); a busy node
         # sets regen_debounce > 0 to fold bursts of endpoint churn
@@ -839,7 +855,7 @@ class Daemon:
             "FaultInjection", "EpochSwap", "L7DeviceBatch",
             "AdmissionControl", "Prefilter", "DeviceProfiling",
             "ClusterFederation", "PolicyVerdictNotification",
-            "FleetTelemetry",
+            "FleetTelemetry", "LifecycleJournal",
         }
     )
 
@@ -948,6 +964,16 @@ class Daemon:
                 self._start_fleet_sampler()
             else:
                 self._stop_fleet_sampler()
+        elif name == "LifecycleJournal":
+            # policyd-journal: start/stop the event journal + tail
+            # publisher. The journal plane is imported lazily HERE and
+            # only here — off resets every hot-module on_journal slot
+            # to None (one attribute read per site) and the verdict
+            # path is bit-identical (tripwire-tested)
+            if value:
+                self._start_journal()
+            else:
+                self._stop_journal()
         elif name == "FaultInjection":
             # policyd-failsafe: arm/disarm the injection hub; off keeps
             # rules queued so a re-enable resumes a chaos scenario
@@ -1189,10 +1215,26 @@ class Daemon:
                     member.backend, member.node_name, cluster=member.cluster
                 )
             )
+        # policyd-journal: a running journal gains the tail exchange,
+        # the member's node identity, and the member's lease/reap
+        # emission slot the same way
+        pub = self._journal_publisher
+        if pub is not None and pub.exchange is None:
+            from .observe.journal import JournalExchange
+
+            self._journal.node = member.node_name
+            pub.attach_exchange(
+                JournalExchange(
+                    member.backend, member.node_name, cluster=member.cluster
+                )
+            )
+            member.on_journal = self._journal.emit
 
     def detach_federation(self) -> None:
         """Drop the membership and restore the local identity source
         (the member itself is closed by its owner)."""
+        if self._federation is not None:
+            self._federation.on_journal = None
         if self.options.get("ClusterFederation"):
             self.options.set("ClusterFederation", False)
         self._federation = None
@@ -1203,6 +1245,15 @@ class Daemon:
         sampler = self._fleet_sampler
         if sampler is not None and sampler.exchange is not None:
             exchange, sampler.exchange = sampler.exchange, None
+            try:
+                exchange.close()
+            except (ConnectionError, TimeoutError, OSError, RuntimeError):
+                pass
+        # ... and so did the journal exchange; the journal itself keeps
+        # recording locally (single-node timeline)
+        pub = self._journal_publisher
+        if pub is not None and pub.exchange is not None:
+            exchange, pub.exchange = pub.exchange, None
             try:
                 exchange.close()
             except (ConnectionError, TimeoutError, OSError, RuntimeError):
@@ -1299,6 +1350,113 @@ class Daemon:
         if sampler is None:
             return None
         return sampler.slo_summary()
+
+    # -- lifecycle journal (policyd-journal) -----------------------------
+    def _start_journal(self) -> None:
+        if self._journal is not None:
+            return
+        # lazy import: the LifecycleJournal OFF path never loads the
+        # journal plane or the frame codec (tripwire-tested)
+        from .observe import journal as _journal
+
+        member = getattr(self, "_federation", None)
+        node = member.node_name if member is not None else "local"
+        j = _journal.EventJournal(node=node, capacity=self._journal_capacity)
+        pub = _journal.JournalPublisher(
+            j, interval_s=self._journal_publish_s, tail_n=self._journal_tail_n
+        )
+        if member is not None:
+            pub.attach_exchange(
+                _journal.JournalExchange(
+                    member.backend, member.node_name, cluster=member.cluster
+                )
+            )
+            member.on_journal = j.emit
+        # hot modules reach the journal through one None-guarded
+        # attribute read per site; installing the bound emit arms them
+        self.pipeline.on_journal = j.emit
+        adm = self.pipeline._admission
+        if adm is not None:
+            adm.on_journal = j.emit
+        pub.start()
+        self._journal = j
+        self._journal_publisher = pub
+        # shed episodes are edge-triggered with a hold: the poller
+        # closes an episode once the hold expires without new shed
+        # activity (note_shed itself only sees the next storm's edge)
+        self.controllers.update_controller(
+            "journal-shed-poll", self._journal_shed_poll, run_interval=1.0
+        )
+
+    def _journal_shed_poll(self) -> None:
+        adm = self.pipeline._admission
+        if adm is not None:
+            adm.episode_poll()
+
+    def _stop_journal(self) -> None:
+        j, self._journal = self._journal, None
+        pub, self._journal_publisher = self._journal_publisher, None
+        if j is None:
+            return
+        self.controllers.remove_controller("journal-shed-poll")
+        # disarm every hot-module slot before tearing the plane down
+        self.pipeline.on_journal = None
+        adm = self.pipeline._admission
+        if adm is not None:
+            adm.on_journal = None
+        member = getattr(self, "_federation", None)
+        if member is not None:
+            member.on_journal = None
+        if pub is not None:
+            try:
+                pub.publish_once()  # final tail (drain events) for peers
+            except Exception:
+                pass  # kvstore down: peers age our frame out
+            pub.stop()
+
+    def _journal_emit(self, **kw) -> None:
+        """Emit one lifecycle event when the journal is on; the OFF
+        path is a single attribute read (daemon-side sites only — hot
+        modules carry their own on_journal slots)."""
+        j = self._journal
+        if j is not None:
+            j.emit(**kw)
+
+    def events(
+        self,
+        limit: int = 64,
+        *,
+        kind: Optional[str] = None,
+        severity: Optional[str] = None,
+        since: Optional[float] = None,
+    ) -> Dict:
+        """GET /events: the local journal tail + ring accounting."""
+        j = self._journal
+        if j is None:
+            return {"enabled": False, "events": []}
+        out = j.snapshot()
+        out["enabled"] = True
+        out["events"] = j.events(
+            limit, kind=kind, severity=severity, since=since
+        )
+        return out
+
+    def fleet_timeline(self, limit: int = 256) -> Dict:
+        """GET /fleet/timeline: local tail + every live peer tail,
+        merged into one HLC-total-ordered fleet timeline."""
+        pub = self._journal_publisher
+        if pub is None:
+            return {"enabled": False, "events": []}
+        from .observe import journal as _journal  # already loaded
+
+        evs = pub.merged_timeline(limit)
+        return {
+            "enabled": True,
+            "node": pub.journal.node,
+            "nodes": sorted({e.get("node") for e in evs}),
+            "consistent": _journal.timeline_consistent(evs),
+            "events": evs,
+        }
 
     def health_report(self) -> Dict:
         """GET /health (the cilium-health status surface)."""
@@ -1567,6 +1725,7 @@ class Daemon:
             return
         basis = (c.revision, c.identity_version, c.vocab_version)
         now = time.monotonic()
+        saved = False
         with self._save_lock:
             if not force:
                 if basis == self._compiled_saved_basis:
@@ -1584,10 +1743,17 @@ class Daemon:
                 metrics.state_snapshot_bytes.set(
                     float(os.path.getsize(cpath)), {"kind": "compiled"}
                 )
+                saved = True
             except Exception as e:
                 log.warning("compiled snapshot save failed", fields={
                     "err": f"{type(e).__name__}: {e}",
                 })
+        if saved:
+            # outside _save_lock: the journal must never extend the
+            # snapshot writers' critical section
+            self._journal_emit(kind="snapshot_save", attrs={
+                "what": "compiled", "basis": list(basis),
+            })
 
     CT_SNAPSHOT_MIN_INTERVAL_S = 5.0
 
@@ -1618,6 +1784,8 @@ class Daemon:
         if basis != self._compiled_saved_basis:
             self._save_compiled_snapshot(force=True)
         now = time.monotonic()
+        ct_epoch = getattr(self.pipeline, "_ct_epoch", 0)
+        saved = False
         with self._save_lock:
             if not force and (
                 now - self._ct_saved_at < self.CT_SNAPSHOT_MIN_INTERVAL_S
@@ -1634,12 +1802,13 @@ class Daemon:
                     os.path.join(self.state_dir, "ct.npz"),
                     self.conntrack,
                     basis=basis,
-                    ct_epoch=getattr(self.pipeline, "_ct_epoch", 0),
+                    ct_epoch=ct_epoch,
                 )
                 self._ct_saved_at = now
                 metrics.state_snapshot_bytes.set(
                     float(nbytes), {"kind": "ct"}
                 )
+                saved = True
             except Exception as e:
                 # a failed CT save (including an injected torn write)
                 # must never fail the caller's mutation path — the next
@@ -1647,6 +1816,10 @@ class Daemon:
                 log.warning("ct snapshot save failed", fields={
                     "err": f"{type(e).__name__}: {e}",
                 })
+        if saved:
+            self._journal_emit(kind="snapshot_save", attrs={
+                "what": "ct", "basis": list(basis), "ct_epoch": ct_epoch,
+            })
 
     def restore_state(self) -> int:
         """Parse the snapshot and rebuild live state (restoreOldEndpoints
@@ -1735,6 +1908,16 @@ class Daemon:
             self._restore_ct_snapshot(ct_snap, ct_disk_basis)
         finally:
             self._ct_save_suppressed = False
+        # kept-vs-cold restore verdict on the journal: warning when the
+        # basis mismatched (the fleet timeline shows which restarts
+        # came up cold)
+        info = self._ct_restore_info
+        if info is not None:
+            self._journal_emit(
+                kind="ct_restore",
+                severity="info" if info.get("basis_match") else "warning",
+                attrs=dict(info),
+            )
         return n
 
     def _restore_ct_snapshot(self, snap, basis) -> None:
@@ -1791,7 +1974,11 @@ class Daemon:
         if started is None:
             return
         self._restore_started = None
-        metrics.restart_downtime_seconds.set(time.monotonic() - started)
+        downtime = time.monotonic() - started
+        metrics.restart_downtime_seconds.set(downtime)
+        self._journal_emit(kind="restore_done", attrs={
+            "downtime_ms": round(downtime * 1e3, 3),
+        })
 
     def ct_restore_info(self) -> Optional[Dict]:
         """Provenance of the last CT restore attempt (bugtool)."""
@@ -1804,6 +1991,10 @@ class Daemon:
         resolved — completed normally or degraded — so callers observe
         verdicts_lost == 0 structurally."""
         t0 = time.monotonic()
+        self._journal_emit(kind="drain_begin", attrs={
+            "policy_epoch": self.pipeline.policy_epoch,
+            "deadline_s": float(deadline_s),
+        })
         # stop the stall watchdog FIRST: the bounded wait below
         # legitimately blocks on slow completions and must not race an
         # abandonment sweep
@@ -1830,6 +2021,12 @@ class Daemon:
         metrics.drain_seconds.observe(elapsed)
         report = dict(report)
         report.update(drain_s=elapsed, verdicts_lost=0)
+        self._journal_emit(kind="drain_end", attrs={
+            "drain_s": round(elapsed, 6),
+            "verdicts_lost": 0,
+            "completed": report.get("completed", 0),
+            "abandoned": report.get("abandoned", 0),
+        })
         return report
 
     def shutdown(self, deadline_s: float = 5.0) -> None:
@@ -1837,6 +2034,7 @@ class Daemon:
         # degrades) everything in flight, persists CT + compiled +
         # state.json under the deadline
         self.drain(deadline_s=deadline_s)
+        self._stop_journal()
         self._stop_fleet_sampler()
         self.controllers.remove_all()
         self.health.stop()

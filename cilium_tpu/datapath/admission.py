@@ -150,6 +150,13 @@ class AdmissionController:
         self._last_shed = 0.0  # time.monotonic of the last shed
         self.shed = {REASON_SHED_PREFILTER: 0, REASON_SHED_DEADLINE: 0}
         self.admitted = 0  # flows that entered the full verdict path
+        # lifecycle-journal hook (policyd-journal): daemon sets this to
+        # EventJournal.emit while LifecycleJournal is on; None keeps
+        # the hot path at one attribute read. Shed episodes are EDGE
+        # TRIGGERED — one shed_start when shedding begins, one shed_end
+        # after SHED_HOLD_S of quiet — never one event per shed batch.
+        self.on_journal = None
+        self._episode = None  # {"t0": monotonic, "shed0": counts}
 
     @property
     def limit(self) -> float:
@@ -192,10 +199,61 @@ class AdmissionController:
             self._limit = max(1.0, self._limit / 2.0)
 
     def note_shed(self, reason: str, n: int) -> None:
+        end_attrs = start_attrs = None
         with self._lock:
+            now = time.monotonic()
+            # a burst arriving after the hold window first closes the
+            # PREVIOUS episode (its deltas must not include this burst)
+            if (
+                self._episode is not None
+                and now - self._last_shed >= self.SHED_HOLD_S
+            ):
+                end_attrs = self._close_episode_locked(now)
+            if self._episode is None:
+                self._episode = {"t0": now, "shed0": dict(self.shed)}
+                start_attrs = {"reason": reason}
             self.shed[reason] = self.shed.get(reason, 0) + int(n)
-            self._last_shed = time.monotonic()
+            self._last_shed = now
         _metrics.admission_shed_total.inc({"reason": reason}, float(n))
+        oj = self.on_journal
+        if oj is not None:
+            if end_attrs is not None:
+                oj(kind="shed_end", attrs=end_attrs)
+            if start_attrs is not None:
+                oj(kind="shed_start", severity="warning", attrs=start_attrs)
+
+    def _close_episode_locked(self, now: float) -> Dict:
+        """Retire the open episode; returns the shed_end attrs (the
+        caller emits OUTSIDE the lock). Deltas are per-reason counts
+        shed since the episode opened — the journal carries episode
+        totals, never per-flow records."""
+        ep = self._episode
+        self._episode = None
+        deltas = {
+            r: self.shed.get(r, 0) - ep["shed0"].get(r, 0)
+            for r in self.shed
+            if self.shed.get(r, 0) - ep["shed0"].get(r, 0)
+        }
+        return {
+            "shed": deltas,
+            "duration_s": round(self._last_shed - ep["t0"], 6),
+        }
+
+    def episode_poll(self) -> None:
+        """Close an episode that went quiet (called on the daemon's
+        journal-shed-poll controller): without this, the FINAL shed_end
+        of a load spike would wait for the next overload to surface."""
+        end_attrs = None
+        with self._lock:
+            now = time.monotonic()
+            if (
+                self._episode is not None
+                and now - self._last_shed >= self.SHED_HOLD_S
+            ):
+                end_attrs = self._close_episode_locked(now)
+        oj = self.on_journal
+        if oj is not None and end_attrs is not None:
+            oj(kind="shed_end", attrs=end_attrs)
 
     def note_admitted(self, n: int) -> None:
         with self._lock:
@@ -300,6 +358,13 @@ class Watchdog:
             "at": time.time(),
         }
         _metrics.watchdog_stalls_total.inc({"site": site})
+        oj = getattr(self._pipe, "on_journal", None)
+        if oj is not None:
+            oj(
+                kind="watchdog_stall",
+                severity="error",
+                attrs={"site": site, "age_ms": round(age_s * 1000.0, 3)},
+            )
         kind = _faults.classify(exc)
         # a stall is never a programmer error; classify() maps the
         # TimeoutError we synthesize (and injected FaultErrors) to

@@ -1046,6 +1046,14 @@ class DatapathPipeline:
         # pre-option path. The deadline is boot config, consulted only
         # while the controller exists.
         self.deadline_ms = max(0.0, float(deadline_ms))
+        # policyd-journal: lifecycle-event emission slot. None while
+        # the LifecycleJournal option is off (every site pays one
+        # attribute read); the daemon installs the journal's bound
+        # emit — called as ``oj(kind=..., severity=..., attrs=...)``
+        # with OBS003-checked kind literals, always OUTSIDE this
+        # pipeline's locks. Initialized before the admission boot
+        # toggle below, which forwards it to the controller.
+        self.on_journal = None
         self._admission: Optional[AdmissionController] = None
         if admission:
             self.set_admission(True)
@@ -1390,6 +1398,9 @@ class DatapathPipeline:
                     ),
                     deadline_ms=self.deadline_ms,
                 )
+                # a journal armed before the controller existed still
+                # sees its shed episodes
+                self._admission.on_journal = self.on_journal
         else:
             self._admission = None
 
@@ -1525,8 +1536,11 @@ class DatapathPipeline:
                 cls = 0 if verdict_code == FORWARD else 2
                 np.add.at(self.counters, (ep_idx[idx], cls), 1)
         if verdict_code == DROP_PREFILTER:
+            # reason 144 has two producers; this is the host admission
+            # gate, not the device prefilter kernel (observe/README.md)
             _metrics.drop_reasons_total.inc(
-                {"reason": "prefilter"}, float(idx.size)
+                {"reason": "prefilter", "producer": "admission"},
+                float(idx.size),
             )
         elif verdict_code == DROP_DEGRADED:
             _metrics.drop_reasons_total.inc(
@@ -1535,7 +1549,7 @@ class DatapathPipeline:
         self._account_batch(v)
         self._emit_flow_events(
             peer_bytes[idx], ep_idx[idx], dports[idx], protos[idx], v,
-            ingress=ingress, family=family,
+            ingress=ingress, family=family, producer="admission",
         )
 
     def _admission_gate(
@@ -1729,6 +1743,13 @@ class DatapathPipeline:
         self._warm_buckets.clear()
         _metrics.degradations_total.inc({"from": frm, "to": to})
         _metrics.pipeline_mode.set(float(level))
+        oj = self.on_journal
+        if oj is not None:
+            oj(
+                kind="ladder_move",
+                severity="warning" if level > cur else "info",
+                attrs={"from": frm, "to": to, "level": level},
+            )
 
     def _note_fault(self, exc: BaseException, kind: str) -> None:
         """Account one classified fault and trip the breaker when due.
@@ -1797,8 +1818,10 @@ class DatapathPipeline:
         with self._lock:
             dct = self._device_ct
             self._ct_epoch += 1
+            ct_epoch = self._ct_epoch
             self._device_ct = None
             self._quarantined += 1
+            quarantined = self._quarantined
             # the epoch the shadow bound to may be the poisoned one —
             # a swap mid-quarantine must not resurrect it
             self._swap_gen += 1
@@ -1806,11 +1829,19 @@ class DatapathPipeline:
         # best-effort pull its established entries into the host table
         # (outside the lock — the pull can be slow or fail outright on
         # a quarantined device)
+        rescue = None
         if dct is not None and self.conntrack is not None:
-            self._rescue_device_ct(dct)
+            rescue = self._rescue_device_ct(dct)
+        oj = self.on_journal
+        if oj is not None:
+            oj(kind="quarantine", severity="error", attrs={
+                "ct_epoch": ct_epoch,
+                "quarantined": quarantined,
+                "ct_rescue": "skipped" if rescue is None else rescue,
+            })
         return self._degraded_result(inf)
 
-    def _rescue_device_ct(self, state) -> None:
+    def _rescue_device_ct(self, state) -> Optional[Dict]:
         """Quarantine CT rescue (policyd-survive): pull the live
         device-CT entries into the host FlowConntrack so degraded/
         host-mode keeps serving established flows, and mark the next
@@ -1820,8 +1851,9 @@ class DatapathPipeline:
         Bounded (device_ct_rescue_limit) and classified: the device is
         the very thing being quarantined, so ANY failure — including an
         injected fault at the completion-pull site — means "rescue
-        skipped, cold", never a second escalation. Programmer errors
-        still surface raw."""
+        skipped, cold" (returns None), never a second escalation.
+        Programmer errors still surface raw. Returns the
+        {kept, expired} outcome for the quarantine journal event."""
         from .device_ct import pull_live_entries
 
         try:
@@ -1837,7 +1869,7 @@ class DatapathPipeline:
         except BaseException as e:
             if _faults.classify(e) == _faults.KIND_ERROR:
                 raise
-            return  # rescue skipped — quarantine proceeds cold
+            return None  # rescue skipped — quarantine proceeds cold
         if kept:
             _metrics.ct_restored_entries_total.inc(
                 {"result": "kept"}, float(kept)
@@ -1848,6 +1880,7 @@ class DatapathPipeline:
             _metrics.ct_restored_entries_total.inc(
                 {"result": "expired"}, float(expired)
             )
+        return {"kept": int(kept), "expired": int(expired)}
 
     def _seed_device_ct(self):
         """Fresh device-CT state pre-populated from the host table (the
@@ -1922,6 +1955,25 @@ class DatapathPipeline:
 
         Returns {(direction, family): DatapathTables}.
         """
+        oj = self.on_journal
+        prev_basis = self._mat_basis if oj is not None else None
+        tables = self._rebuild_locked(force)
+        # served-basis move → one journal event, AFTER the lock is
+        # released (the journal must never extend the rebuild critical
+        # section the dispatch path competes with)
+        if oj is not None and self._mat_basis != prev_basis:
+            basis = self._mat_basis
+            oj(kind="rebuild", attrs={
+                "prev_basis": None if prev_basis is None else list(prev_basis),
+                "basis": None if basis is None else list(basis),
+                "policy_epoch": self._policy_epoch,
+                "generation": self._plan.generation,
+            })
+        return tables
+
+    def _rebuild_locked(
+        self, force: bool = False
+    ) -> Dict[Tuple[int, int], DatapathTables]:
         with self._lock:
             self._refresh_mesh_locked()
             # Capture versions BEFORE reading the sources: a concurrent
@@ -2654,7 +2706,17 @@ class DatapathPipeline:
             # SITE_CT_EPOCH like every other basis move.
             self._ct_flush_pending = True
             self._policy_epoch += 1
+            epoch = self._policy_epoch
         _metrics.engine_epoch_swaps_total.inc()
+        oj = self.on_journal
+        if oj is not None:
+            oj(kind="epoch_swap", attrs={
+                "policy_epoch": epoch,
+                "basis": [
+                    compiled.revision, compiled.identity_version,
+                    compiled.vocab_version,
+                ],
+            })
 
     def snapshots(self, ingress: bool = True) -> List[EndpointPolicySnapshot]:
         self.rebuild()
@@ -2687,6 +2749,7 @@ class DatapathPipeline:
         redirect: Optional[np.ndarray] = None,
         rule: Optional[np.ndarray] = None,
         l4_covered: Optional[np.ndarray] = None,
+        producer: str = "prefilter",
     ) -> None:
         """DropNotify per dropped flow (+ TraceNotify per forwarded
         flow when trace_enabled). Cold path: runs only while a monitor
@@ -2694,6 +2757,12 @@ class DatapathPipeline:
         small tail of a batch. Peer identity is resolved host-side via
         the ipcache (the event consumer wants labels/identity, the
         datapath only knows rows).
+
+        ``producer`` disambiguates reason-144's two emitters on the
+        DropNotify record: the device path defaults to "prefilter" (the
+        shed kernel), the host admission gate passes "admission". Only
+        REASON_PREFILTER drops carry it — other reasons have one
+        producer.
 
         With attribution arrays (``rule``/``l4_covered``, FlowAttribution
         on) policy drops carry the REAL reason from the policyd-flows
@@ -2769,9 +2838,10 @@ class DatapathPipeline:
             if not _opt(_ep(i), "DropNotification", self.drop_notifications):
                 continue
             addr = bytes(int(b) & 0xFF for b in peer_bytes[i])
+            r = _reason(i)
             events.append(
                 DropNotify(
-                    reason=_reason(i),
+                    reason=r,
                     endpoint=_ep(i),
                     src_identity=_identity(addr),
                     family=family,
@@ -2779,6 +2849,7 @@ class DatapathPipeline:
                     dport=int(dports[i]),
                     proto=int(protos[i]),
                     ingress=ingress,
+                    producer=producer if r == REASON_PREFILTER else "",
                 )
             )
         # forwarded flows are the bulk of a batch — skip the per-flow
@@ -2902,7 +2973,12 @@ class DatapathPipeline:
         ):
             n = int(np.count_nonzero(mask))
             if n:
-                _metrics.drop_reasons_total.inc({"reason": reason}, float(n))
+                labels = {"reason": reason}
+                if reason == "prefilter":
+                    # reason 144's device-kernel producer (the host
+                    # admission gate labels its own rows "admission")
+                    labels["producer"] = "prefilter"
+                _metrics.drop_reasons_total.inc(labels, float(n))
 
     def _record_flows(
         self,

@@ -61,6 +61,11 @@ class FederationMember:
         self.node_name = node_name
         self.cluster = cluster
         self.heartbeat_interval = heartbeat_interval
+        # lifecycle-journal hook (policyd-journal): the daemon points
+        # this at EventJournal.emit while LifecycleJournal is on; None
+        # keeps heartbeat/GC at one attribute read (module is hot —
+        # pump() rides the cluster-sync controller)
+        self.on_journal = None
         self._lock = threading.RLock()
         # ids inserted into the registry on behalf of REMOTE
         # allocations (remote deletes release exactly one ref)
@@ -170,10 +175,27 @@ class FederationMember:
         Returns keys repaired."""
         fixed = self.identities.heartbeat()
         self.epochs.sync()
+        oj = self.on_journal
+        if fixed and oj is not None:
+            # keys repaired means a lease EXPIRED out from under us —
+            # the fleet timeline wants the loss, not the routine renew
+            oj(
+                kind="lease_lost",
+                severity="warning",
+                attrs={"repaired": int(fixed)},
+            )
         return fixed
 
     def run_gc(self):
-        return self.identities.run_gc()
+        reaped = self.identities.run_gc()
+        oj = self.on_journal
+        if reaped and oj is not None:
+            oj(
+                kind="identity_reap",
+                attrs={"reaped": [int(i) for i in reaped],
+                       "count": len(reaped)},
+            )
+        return reaped
 
     def wait_cluster_epoch(
         self, epoch: Optional[int] = None, timeout: float = 10.0, **kw

@@ -15,6 +15,9 @@ CLUSTER_EPOCHS_PATH = "cilium/state/epochs/v1"
 # policyd-fleetobs: per-node telemetry frames, published beside the
 # epoch records (observe/fleet.py TelemetryExchange)
 CLUSTER_TELEMETRY_PATH = "cilium/state/telemetry/v1"
+# policyd-journal: per-node lifecycle-journal tail frames
+# (observe/journal.py JournalExchange)
+CLUSTER_JOURNAL_PATH = "cilium/state/journal/v1"
 
 
 def key_to_label_strings(key: str):

@@ -528,3 +528,23 @@ fleet_nodes_reporting = registry.gauge(
     "denominator; drops within seconds of a node dying, ahead of its "
     "kvstore lease expiry",
 )
+
+# -- policyd-journal (lifecycle event journal) families --------------------
+journal_events_total = registry.counter(
+    "cilium_tpu_journal_events_total",
+    "Lifecycle events recorded by the EventJournal (labels: kind = "
+    "contracts.JOURNAL_KINDS row, severity = info|warning|error); "
+    "counts every emit, including events later evicted from the ring",
+)
+journal_dropped_total = registry.counter(
+    "cilium_tpu_journal_dropped_total",
+    "Lifecycle events evicted from the bounded journal ring to make "
+    "room for newer ones (journal_ring_capacity overflow); the GET "
+    "/events tail is complete iff this stayed 0 since boot",
+)
+journal_frames_total = registry.counter(
+    "cilium_tpu_journal_frames_total",
+    "Journal tail frame outcomes on the federation exchange (label "
+    "result: published | publish_error | rejected | stale — same "
+    "vocabulary as telemetry_frames_total)",
+)

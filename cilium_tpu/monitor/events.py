@@ -86,6 +86,12 @@ class DropNotify:
     dport: int
     proto: int
     ingress: bool
+    # reason-144 disambiguation: WHICH of the two prefilter producers
+    # dropped the flow — "admission" (host admission gate) or
+    # "prefilter" (device shed kernel). Empty for every other reason
+    # (those have a single producer each). Bounded by construction:
+    # contracts.METRIC_BOUNDED_LABEL_KEYS lists "producer".
+    producer: str = ""
     timestamp: float = dataclasses.field(default_factory=time.time)
 
     @property
@@ -97,9 +103,10 @@ class DropNotify:
         import ipaddress
 
         ip = ipaddress.ip_address(self.peer_addr)
+        via = f" via {self.producer}" if self.producer else ""
         return (
-            f"xx drop ({reason_name(self.reason)}) {d} ep {self.endpoint} "
-            f"peer {ip} identity {self.src_identity} "
+            f"xx drop ({reason_name(self.reason)}){via} {d} "
+            f"ep {self.endpoint} peer {ip} identity {self.src_identity} "
             f"dport {self.dport} proto {self.proto}"
         )
 
@@ -268,6 +275,11 @@ class TraceSummary:
 
 _FLOW_FMT = "<BBBBIIHHd16s"
 _FLOW_LEN = struct.calcsize(_FLOW_FMT)
+# DropNotify producer rides the flow layout's previously-zero pad u16
+# (same frame length, old decoders read it as pad): the wire stays
+# layout-stable while reason-144 frames carry WHICH producer shed.
+_PRODUCER_CODES = {"": 0, "admission": 1, "prefilter": 2}
+_PRODUCER_NAMES = {v: k for k, v in _PRODUCER_CODES.items()}
 # verdict events: the flow layout (sub = reason) with action u8 and
 # rule index i16 appended
 _VERDICT_FMT = "<BBBBIIHHd16sBh"
@@ -279,9 +291,12 @@ def encode(ev) -> bytes:
     if t in (EVENT_DROP, EVENT_TRACE):
         sub = ev.reason if t == EVENT_DROP else ev.obs_point
         flags = (1 if ev.ingress else 0) | (2 if ev.family == 6 else 0)
+        pad = (
+            _PRODUCER_CODES.get(ev.producer, 0) if t == EVENT_DROP else 0
+        )
         return struct.pack(
             _FLOW_FMT, t, sub, flags, ev.proto, ev.endpoint,
-            ev.src_identity, ev.dport, 0, ev.timestamp,
+            ev.src_identity, ev.dport, pad, ev.timestamp,
             bytes(ev.peer_addr).ljust(16, b"\x00"),
         )
     if t == EVENT_POLICY_VERDICT:
@@ -340,7 +355,9 @@ def decode(buf: bytes):
             dport=dport, proto=proto, ingress=bool(flags & 1), timestamp=ts,
         )
         if t == EVENT_DROP:
-            return DropNotify(reason=sub, **kw)
+            return DropNotify(
+                reason=sub, producer=_PRODUCER_NAMES.get(_pad, ""), **kw
+            )
         return TraceNotify(obs_point=sub, **kw)
     if t == EVENT_POLICY_VERDICT:
         (

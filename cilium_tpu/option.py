@@ -118,6 +118,18 @@ class DaemonConfig:
     # (capacity × sample_s seconds).
     telemetry_sample_s: float = 1.0
     telemetry_ring_rows: int = 600
+    # Boot-time value of the LifecycleJournal runtime option (policyd-
+    # journal): a bounded ring of structured lifecycle events (boot /
+    # restore / epoch swap / ladder / drain / ...) with hybrid-logical-
+    # clock stamps, published as journal-tail frames when a federation
+    # membership is attached.
+    lifecycle_journal: bool = False
+    # Journal ring capacity in events and publisher cadence / frame
+    # tail length; capacity bounds GET /events history, tail_n bounds
+    # the per-node contribution to the merged fleet timeline.
+    journal_ring_capacity: int = 512
+    journal_publish_s: float = 1.0
+    journal_tail_n: int = 64
 
     def validate(self) -> None:
         if self.enforcement_mode not in ("default", "always", "never"):
@@ -147,6 +159,12 @@ class DaemonConfig:
             raise ValueError("telemetry-sample-s must be > 0")
         if self.telemetry_ring_rows < 2:
             raise ValueError("telemetry-ring-rows must be >= 2")
+        if self.journal_ring_capacity < 1:
+            raise ValueError("journal-ring-capacity must be >= 1")
+        if self.journal_publish_s <= 0:
+            raise ValueError("journal-publish-s must be > 0")
+        if self.journal_tail_n < 1:
+            raise ValueError("journal-tail-n must be >= 1")
         if not 2 <= self.mesh_ident_axis <= 64:
             raise ValueError("mesh-ident-axis must be 2-64")
         if self.mesh_process_index < 0:
@@ -312,6 +330,21 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
             "telemetry frames for the fleet scoreboard (GET /fleet); "
             "off starts no thread and never imports the frame codec — "
             "the verdict path is bit-identical",
+        ),
+        OptionSpec(
+            "LifecycleJournal",
+            "Lifecycle event journal (policyd-journal): a bounded, "
+            "schema-versioned ring of structured lifecycle events "
+            "(boot, CT restore verdict, rebuild/epoch swap, ladder "
+            "moves, quarantine incl. CT rescue, shed episodes, drain "
+            "brackets, watchdog stalls, federation lease/reap, "
+            "snapshot saves) stamped with a hybrid logical clock; "
+            "with a federation membership attached a cadence thread "
+            "publishes the journal tail so fleet timeline merges "
+            "per-node journals into one HLC-total-ordered view; off "
+            "starts no thread and never imports the journal module — "
+            "hot paths stay at one attribute read and the verdict "
+            "path is bit-identical",
         ),
         OptionSpec(
             "Prefilter",

@@ -1,0 +1,5 @@
+"""OBS003 fixture canon: a tiny journal vocabulary (the 'stale_row'
+entry has no emission site — the reverse-direction warning anchors
+here)."""
+
+JOURNAL_KINDS = ("boot", "quarantine", "stale_row")

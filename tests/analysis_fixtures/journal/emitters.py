@@ -1,0 +1,34 @@
+"""OBS003 fixture: emission-shaped calls — two unknown-kind
+positives, known/variable/foreign-callee/suppressed negatives."""
+
+
+class _J:
+    def emit(self, *, kind, severity="info", attrs=None):
+        pass
+
+
+def _agent_notify(**kw):
+    pass
+
+
+j = _J()
+
+
+def tick(oj):
+    # NEG: known kind through the journal method
+    j.emit(kind="boot")
+    # POS: typo'd kind — EventJournal.emit raises on this at runtime
+    j.emit(kind="bot")
+    # POS: unknown kind through the local-alias hook shape
+    oj(kind="quarantin", severity="error")
+    # NEG: known kind through the alias shape
+    oj(kind="quarantine")
+    # NEG: a variable kind can't be judged statically
+    k = "boot"
+    j.emit(kind=k)
+    # NEG: kind= literal on a non-emission callee is a different
+    # vocabulary (AgentNotify kinds, ladder padding kinds, ...)
+    _agent_notify(kind="policy-updated")
+    # NEG: justified exception
+    # policyd-lint: disable=OBS003
+    j.emit(kind="experimental")

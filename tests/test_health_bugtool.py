@@ -154,6 +154,37 @@ class TestDebuginfoAndBugtool:
             )
             assert pm["7"]["ingress"]  # keys stringify through JSON
 
+    def test_artifact_headers_stamped(self, daemon):
+        """Every diffable JSON artifact carries a top-level `schema` +
+        `generated_at` header, all stamping the SAME capture instant so
+        cross-artifact joins don't skew."""
+        from cilium_tpu.bugtool import ARTIFACT_SCHEMAS, collect_debuginfo
+
+        info = collect_debuginfo(daemon)
+        for key, schema in ARTIFACT_SCHEMAS.items():
+            art = info[key]
+            assert art["schema"] == schema, key
+            assert art["generated_at"] == info["timestamp"], key
+        # the journal snapshot's own version field is `journal_schema`
+        # — it must never shadow the artifact header
+        assert info["events"]["schema"] == "cilium-tpu/events/v1"
+        assert "enabled" in info["events"]
+
+    def test_archive_carries_stamped_events_artifact(self, daemon,
+                                                     tmp_path):
+        from cilium_tpu.bugtool import ARTIFACT_SCHEMAS, write_archive
+
+        path = write_archive(daemon, str(tmp_path / "bug3.tar.gz"))
+        with tarfile.open(path) as tar:
+            names = {m.name for m in tar.getmembers()}
+            for key in ARTIFACT_SCHEMAS:
+                assert f"cilium-tpu-bugtool/{key}.json" in names
+            ev = json.load(
+                tar.extractfile("cilium-tpu-bugtool/events.json"))
+            assert ev["schema"] == ARTIFACT_SCHEMAS["events"]
+            # LifecycleJournal was never enabled on this daemon
+            assert ev["enabled"] is False and ev["events"] == []
+
     def test_rest_and_cli(self, daemon, tmp_path):
         from cilium_tpu.api.client import APIClient
         from cilium_tpu.api.server import APIServer

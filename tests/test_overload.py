@@ -278,7 +278,9 @@ class TestShedGate:
         mask = gp._shed_walk(ipv4_to_bytes(bt[0]), bt[2], bt[3], family=4)
         assert mask.any() and not mask.all()  # partial shed exercises merge
         m0 = _m.admission_shed_total.get({"reason": "prefilter"})
-        r0 = _m.drop_reasons_total.get({"reason": "prefilter"})
+        # the admission gate is reason 144's HOST producer
+        r0 = _m.drop_reasons_total.get(
+            {"reason": "prefilter", "producer": "admission"})
         limit0 = gp._admission.limit
         _faults.hub.fail(
             _faults.SITE_QUEUE_FULL, _faults.KIND_TRANSIENT, times=1
@@ -295,7 +297,7 @@ class TestShedGate:
             {"reason": "prefilter"}
         ) - m0 == n_shed
         assert _m.drop_reasons_total.get(
-            {"reason": "prefilter"}
+            {"reason": "prefilter", "producer": "admission"}
         ) - r0 == n_shed
         # overload is NOT a device fault: the ladder must not move
         assert gp.pipeline_mode == "sharded"

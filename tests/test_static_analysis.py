@@ -317,6 +317,43 @@ def test_obs002_allowed_table_resolves_from_fixture_contracts(tmp_path):
     assert lines_of(f, "OBS002") == [8]
 
 
+def test_obs003_fixture_positives_and_negatives():
+    """Unknown kind= literals at emission-shaped call sites are
+    errors; known kinds, variable kinds, foreign callees, and the
+    suppressed site stay silent; the vocabulary row nothing emits
+    draws the stale-row warning at the fixture contracts.py."""
+    f = analyze_paths([fixture("journal")])
+    obs = [x for x in f if x.rule == "OBS003"]
+    by_path = {}
+    for x in obs:
+        by_path.setdefault(os.path.basename(x.path), []).append(x)
+    emit = sorted(by_path.get("emitters.py", []), key=lambda x: x.line)
+    assert [x.line for x in emit] == [21, 23]
+    assert all(x.severity == "error" for x in emit)
+    assert "'bot'" in emit[0].message
+    assert "'quarantin'" in emit[1].message
+    stale = by_path.get("contracts.py", [])
+    assert len(stale) == 1 and stale[0].severity == "warning"
+    assert "'stale_row'" in stale[0].message
+    # the emitted rows draw no stale warning
+    assert "'boot'" not in stale[0].message
+
+
+def test_obs003_vocabulary_resolves_from_shipped_table(tmp_path):
+    """A module with emission sites but no local contracts.py checks
+    against the SHIPPED JOURNAL_KINDS — and without a local table
+    definition the stale-row direction stays quiet (the analyzed set
+    can't see every emitter of the shipped vocabulary)."""
+    mod = tmp_path / "emit.py"
+    mod.write_text(
+        "def tick(oj):\n"
+        "    oj(kind='quarantine')\n"
+        "    oj(kind='not-a-kind')\n"
+    )
+    f = analyze_paths([str(mod)])
+    assert lines_of(f, "OBS003") == [3]
+
+
 def test_obs001_package_metrics_stay_documented():
     """The real catalogue gate: every family registered in metrics.py
     is documented in observe/README.md (beyond-baseline drift is also
@@ -551,7 +588,8 @@ def test_family_c_repo_stays_clean():
     """The shipping package + bench.py satisfy every Family C contract
     outright (no baseline entries, no suppressions)."""
     f = analyze_paths([PKG, BENCH])
-    for rule in ("OPT001", "OPT002", "API001", "BENCH001", "OBS002"):
+    for rule in ("OPT001", "OPT002", "API001", "BENCH001", "OBS002",
+                 "OBS003"):
         offenders = [x.render() for x in f if x.rule == rule]
         assert offenders == [], f"{rule} regressions:\n" + "\n".join(offenders)
 
