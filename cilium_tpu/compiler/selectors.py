@@ -36,6 +36,35 @@ from ..policy.api import EndpointSelector
 WILDCARD_SELECTOR_ID = 0
 
 
+def selector_word_window(sel_lo: int, sel_hi: int) -> np.ndarray:
+    """Packed sel_match word indices covering selector ids
+    [sel_lo, sel_hi) — the column window a selector-append delta
+    scatters (ops/materialize.py patch_selector_cols). Appends land in
+    one or two words for typical batch sizes, so the CSR payload for a
+    selector touching k identities is O(k · window) uint32 words."""
+    if sel_hi <= sel_lo:
+        return np.zeros(0, np.int32)
+    return np.arange(sel_lo >> 5, ((sel_hi - 1) >> 5) + 1, dtype=np.int32)
+
+
+def selector_col_delta(
+    sel_match_host: np.ndarray,  # [N, S/32] uint32 host mirror
+    ident_rows: np.ndarray,  # [k] touched identity rows
+    sel_lo: int,
+    sel_hi: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """CSR column-delta for a selector append: (rows, word_cols, vals)
+    where ``vals[i, j] = sel_match_host[rows[i], word_cols[j]]`` — the
+    final-state packed words for exactly the identities the new
+    selectors [sel_lo, sel_hi) matched. Feed to patch_selector_cols;
+    the payload is O(k · window), never the full matrix."""
+    words = selector_word_window(sel_lo, sel_hi)
+    rows = np.asarray(ident_rows, np.int32)
+    if rows.size == 0 or words.size == 0:
+        return rows, words, np.zeros((rows.size, words.size), np.uint32)
+    return rows, words, sel_match_host[np.ix_(rows, words)]
+
+
 class SelectorTable:
     """Grow-only EndpointSelector → id interner with device lowering."""
 

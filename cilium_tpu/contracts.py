@@ -239,5 +239,6 @@ OPTION_BOOT_FIELDS: Dict[str, Optional[str]] = {
     # DaemonConfig time
     "ClusterFederation": None,
     "Prefilter": "prefilter_shed",
+    "SparseDeltas": "sparse_deltas",
     "LifecycleJournal": "lifecycle_journal",
 }

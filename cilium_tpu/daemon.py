@@ -218,6 +218,7 @@ class Daemon:
             ("FailOpen", cfg.fail_open),
             ("AdmissionControl", cfg.admission_control),
             ("Prefilter", cfg.prefilter_shed),
+            ("SparseDeltas", cfg.sparse_deltas),
             ("DeviceProfiling", cfg.device_profiling),
             ("FaultInjection", cfg.fault_injection),
             ("FleetTelemetry", cfg.fleet_telemetry),
@@ -853,7 +854,8 @@ class Daemon:
             "PhaseTracing", "VerdictSharding", "MeshSharding2D",
             "FlowAttribution", "DispatchAutoTune", "FailOpen",
             "FaultInjection", "EpochSwap", "L7DeviceBatch",
-            "AdmissionControl", "Prefilter", "DeviceProfiling",
+            "AdmissionControl", "Prefilter", "SparseDeltas",
+            "DeviceProfiling",
             "ClusterFederation", "PolicyVerdictNotification",
             "FleetTelemetry", "LifecycleJournal",
         }
@@ -933,6 +935,12 @@ class Daemon:
             # publishes on the next rebuild; off publishes None and the
             # shed kernels never trace
             self.pipeline.set_prefilter_shed(value)
+        elif name == "SparseDeltas":
+            # policyd-sparse: O(k) placed sel_match patching + in-place
+            # LPM trie prefix patches; toggling either way drops the
+            # caches so the next rebuild establishes the chosen layout
+            # (off = exact pre-option dense re-place / classic tries)
+            self.pipeline.set_sparse_deltas(value)
         elif name == "DeviceProfiling":
             # policyd-prof: the sampling device profiler; off clears
             # the instance and both dispatch paths return to one

@@ -61,15 +61,18 @@ from ..ops.lookup import (
 from ..ops.lpm import (
     DENY_BIT,
     MERGED_VALUE_MASK,
+    PatchableElidedTrie,
     build_trie_elided,
     build_wide_trie,
     ipv4_to_bytes,
     lpm_lookup,
     lpm_lookup_wide,
+    make_patchable_wide,
     merge_flat_tries,
     merge_trie_entries,
     place_table,
 )
+from ..compiler.selectors import selector_word_window
 from ..ops.materialize import (
     EndpointPolicySnapshot,
     MaterializedState,
@@ -79,6 +82,8 @@ from ..ops.materialize import (
     materialize_endpoints_state,
     patch_endpoints_state,
     patch_identity_rows,
+    patch_selector_cols,
+    patch_selector_rows,
 )
 from ..lb.device import flow_hash32, lb_translate
 from ..utils.backoff import Backoff
@@ -799,6 +804,7 @@ class DatapathPipeline:
         mesh_2d: bool = False,
         admission: bool = False,
         prefilter_shed: bool = False,
+        sparse_deltas: bool = False,
         deadline_ms: float = 0.0,
         stall_ms: float = 0.0,
         profiling: bool = False,
@@ -1067,6 +1073,21 @@ class DatapathPipeline:
         # (plan generation, placed device table) — recompiled when the
         # policymap mirror or the placement moved
         self._shed_cache: Optional[Tuple[int, object]] = None
+        # -- policyd-sparse: O(k) sparse device-table deltas ----------
+        # SparseDeltas runtime option: when on, (a) the ident-placed
+        # sel_match copy is PATCHED from the engine's delta log (row +
+        # column scatters, O(delta) per device) instead of re-placed
+        # whole, and (b) ipcache prefix churn patches the device LPM
+        # trie tensors in place (ops/lpm.py Patchable* — O(delta) node
+        # writes) instead of re-merging whole tries. Off keeps the
+        # exact pre-option paths: full device_put on sel_match source
+        # change, full trie rebuild on any ipcache version move (the
+        # patchable builders are never constructed).
+        self._sparse_deltas = bool(sparse_deltas)
+        # family → patchable trie builder (4: PatchableFlatTrie or
+        # None, 6: PatchableElidedTrie or None), rebuilt alongside
+        # self._tries; None until a sparse-enabled full rebuild runs
+        self._trie_patch: Optional[Dict[int, object]] = None
         # stuck-dispatch watchdog (dispatch_stall_ms > 0): monitors the
         # actively-completing batch + registered external waits and
         # drives the quarantine/breaker path instead of hanging
@@ -1982,10 +2003,16 @@ class DatapathPipeline:
             trie_versions = (self.ipcache.version, self.prefilter.revision)
             delta_target = self.engine.delta_seq
             compiled, device = self.engine.snapshot()
+            # one delta fetch per rebuild, shared by the placed-copy
+            # patcher and the materialized-state router — both replay
+            # FINAL-state values, so re-application across rebuilds
+            # (e.g. a non-advancing cursor under a pending epoch swap)
+            # is idempotent
+            pending_deltas = self.engine.deltas_since(self._last_delta_seq)
             # 2D plan: the materializer sweeps/patches read an ident-
             # sharded sel_match (generation-cached; the engine's own
             # copy is untouched)
-            device = self._ident_placed_device(device)
+            device = self._ident_placed_device(device, pending_deltas)
             delta_target = max(delta_target, self.engine.delta_seq)
             ep_sig = tuple(self._endpoints)
             # captured before the trie block updates _trie_versions;
@@ -2000,9 +2027,7 @@ class DatapathPipeline:
                 self._materialize_both(compiled, device)
                 mat_fresh = True
             else:
-                routed = self._route_deltas(
-                    compiled, device, self.engine.deltas_since(self._last_delta_seq)
-                )
+                routed = self._route_deltas(compiled, device, pending_deltas)
                 if routed is None:
                     # full rebuild needed (log truncation, a "full"
                     # recompile event, or a rule delta the column patch
@@ -2023,6 +2048,29 @@ class DatapathPipeline:
             if not swap_pending:
                 self._mat_sig = ep_sig
                 self._last_delta_seq = delta_target
+
+            # policyd-sparse: when the ONLY trie trigger is ipcache
+            # churn (prefilter untouched, row basis stable), patch the
+            # placed trie tensors in place from the ipcache delta ring
+            # instead of rebuilding — O(delta) node rows / dense spans
+            # uploaded. Success commits _trie_versions, so the full
+            # rebuild below sees a clean basis and skips; any failure
+            # (ring truncation, pool exhaustion, live deny trie,
+            # elision violation) leaves the versions stale and falls
+            # through to the classic rebuild.
+            if (
+                self._sparse_deltas
+                and self._trie_patch is not None
+                and not force
+                and not mat_fresh
+                and not saw_row_event
+                and self._tries is not None
+                and self._tables
+                and len(self._trie_versions) == 2
+                and trie_versions != self._trie_versions
+                and trie_versions[1] == self._trie_versions[1]
+            ):
+                self._patch_tries_locked(compiled, trie_versions)
 
             # Tries: rebuilt when their sources move, when the row basis
             # was re-established, or when any row event could have
@@ -2053,7 +2101,21 @@ class DatapathPipeline:
                     and (row := compiled.id_to_row.get(e.identity))
                     is not None
                 ]
-                ip6 = build_trie_elided(ip6_list, ipv6=True)
+                # policyd-sparse: with no live v6 deny trie, build the
+                # identity trie through a patchable host mirror (pow2
+                # node-pool headroom) so ipcache churn can patch it in
+                # place. The OFF path — and any fused build — compiles
+                # the exact classic layout.
+                p6_patch = (
+                    PatchableElidedTrie(ip6_list, ipv6=True)
+                    if self._sparse_deltas and self._pf_empty[1]
+                    else None
+                )
+                ip6 = (
+                    p6_patch.arrays()
+                    if p6_patch is not None
+                    else build_trie_elided(ip6_list, ipv6=True)
+                )
                 # fused deny+identity v6 walk (one elided pass, both
                 # answers) — built only while the deny stage is live
                 merged6_list = (
@@ -2081,11 +2143,23 @@ class DatapathPipeline:
                 pf_wide = build_wide_trie(
                     (c, 0) for c in pf_cidrs if ":" not in c
                 )
-                ip_wide = build_wide_trie(
+                ip4_list = [
                     (cidr, row)
                     for cidr, e in self.ipcache.items()
                     if ":" not in cidr
                     and (row := compiled.id_to_row.get(e.identity)) is not None
+                ]
+                # v4 mirror only when the flat 16+16 layout holds (the
+                # 16-8-8 pointer layout is not patched → None)
+                p4_patch = (
+                    make_patchable_wide(ip4_list)
+                    if self._sparse_deltas and self._pf_empty[0]
+                    else None
+                )
+                ip_wide = (
+                    p4_patch.arrays()
+                    if p4_patch is not None
+                    else build_wide_trie(ip4_list)
                 )
                 # fused deny+identity walk: only worth building when
                 # the deny stage is live and both layouts are flat
@@ -2133,6 +2207,9 @@ class DatapathPipeline:
                     place_table(np.int32(world_row), tsh),  # policyd-lint: disable=LOCK002
                 )
                 self._trie_versions = trie_versions
+                self._trie_patch = (
+                    {4: p4_patch, 6: p6_patch} if self._sparse_deltas else None
+                )
 
             # Conntrack invalidation: established-flow bypass is only
             # sound while the verdict basis that admitted the flow still
@@ -2408,6 +2485,14 @@ class DatapathPipeline:
                 # resolving a previously-unmapped entry — so the tries
                 # must follow every row move.
                 saw_row_event |= bool(payload)
+            elif kind == "cols":
+                # (sel_lo, sel_hi, touched rows): sel_match column
+                # scatter already applied by the engine (and replayed
+                # onto the ident-placed copy by _ident_placed_device).
+                # The materialized policymap consumes the selector
+                # change through the PAIRED "rules" event's column
+                # re-sweep, so there is nothing to route here.
+                pass
             else:  # "rules": ("add"|"del", (subject_sid, ...))
                 touched_sids.update(payload[1])
         if row_events:
@@ -2491,23 +2576,183 @@ class DatapathPipeline:
         self._placed_rt[direction] = (plan.generation, rt, placed)
         return placed
 
-    def _ident_placed_device(self, device):
+    def _ident_placed_device(self, device, deltas=None):
         """DevicePolicy view with sel_match re-placed under the 2D
         plan's ident sharding (generation-cached on the source array).
         Non-2D plans return the snapshot untouched. The engine's own
         device object is never mutated — the pipeline's sweeps just
         read through a sharded copy so the [N, S/32] selector-match
-        matrix also stops replicating at scale."""
+        matrix also stops replicating at scale.
+
+        With SparseDeltas on, a source change whose gap is covered by
+        the engine delta log (``deltas``) PATCHES the cached placed
+        copy — O(delta) row/column scatters that preserve the ident
+        sharding (GSPMD propagates the operand's sharding through
+        ``.at[].set``) — instead of re-placing the full matrix; the
+        placed jit caches survive because the placement never moves."""
         plan = self._plan
         if not plan.is_2d:
             return device
         gen, src, placed = self._placed_sel
         if src is not device.sel_match or gen != plan.generation:
-            placed = jax.device_put(  # policyd-lint: disable=LOCK002
-                device.sel_match, plan.ident_sharding
+            patched = (
+                self._patch_placed_sel(device, deltas)
+                if self._sparse_deltas
+                else None
             )
+            if patched is None:
+                placed = jax.device_put(  # policyd-lint: disable=LOCK002
+                    device.sel_match, plan.ident_sharding
+                )
+            else:
+                placed = patched
             self._placed_sel = (plan.generation, device.sel_match, placed)
         return device.replace(sel_match=placed)
+
+    def _patch_placed_sel(self, device, deltas):
+        """Replay the delta window onto the cached ident-placed
+        sel_match copy (policyd-sparse). Returns the patched placed
+        array, or None when the gap is not patchable — no cached copy,
+        plan generation moved, truncated/absent log, a "full" recompile
+        in the window, a shape move (row bucket or selector word
+        growth), or a mirror-bounds miss — and the caller re-places
+        wholesale. Values are FINAL-state reads from the engine's host
+        mirror (sel_match_rows), so replay is idempotent and ordering
+        against concurrent engine mutation self-heals on the next
+        rebuild, exactly like the in-place compiled snapshot."""
+        plan = self._plan
+        gen, _src, placed = self._placed_sel
+        if placed is None or gen != plan.generation:
+            return None
+        if not deltas:  # None (truncated) or an un-logged source move
+            return None
+        if getattr(placed, "shape", None) != device.sel_match.shape:
+            return None
+        row_set: set = set()
+        col_events: list = []
+        for _seq, kind, payload in deltas:
+            if kind == "rows":
+                row_set.update(int(r) for r, _ident, _live in payload)
+            elif kind == "cols":
+                col_events.append(payload)
+            elif kind != "rules":  # "full" (or unknown): re-place
+                return None
+        if not row_set and not col_events:
+            # source object moved with no sel_match event in the
+            # window — the gap is not explained by the log; re-place
+            return None
+        nbytes = 0
+        nscat = 0
+        if row_set:
+            rows = sorted(row_set)
+            vals = self.engine.sel_match_rows(rows)
+            if vals is None or vals.shape[1] != placed.shape[1]:
+                return None
+            placed = patch_selector_rows(placed, rows, vals)
+            nbytes += len(rows) * 4 + int(vals.nbytes)
+            nscat += 1
+        for sel_lo, sel_hi, touched in col_events:
+            # rows already rewritten whole by the row patch above carry
+            # their final column bits — skip them here
+            rows = [int(r) for r in touched if int(r) not in row_set]
+            if not rows:
+                continue
+            words = selector_word_window(int(sel_lo), int(sel_hi))
+            if words.size == 0 or int(words.max()) >= placed.shape[1]:
+                return None
+            vals = self.engine.sel_match_rows(rows, words)
+            if vals is None:
+                return None
+            placed = patch_selector_cols(placed, rows, words, vals)
+            nbytes += len(rows) * 4 + int(vals.nbytes) + int(words.nbytes)
+            nscat += 1
+        if nscat:
+            # transfer-ledger attribution for the column/row patches:
+            # O(k) logical bytes where the dense re-place moved the
+            # full [N, S/32] matrix (control-plane cadence, counted
+            # unconditionally — rebuilds are rare and the delta is the
+            # number the stretch bench diffs)
+            _metrics.device_transfer_bytes_total.inc(
+                {"direction": "h2d"}, float(nbytes)
+            )
+            _metrics.device_transfers_total.inc(
+                {"direction": "h2d"}, float(nscat)
+            )
+        return placed
+
+    def _patch_tries_locked(self, compiled, trie_versions) -> bool:
+        """Apply the ipcache delta window to the placed identity-trie
+        tensors in place (policyd-sparse). On success commits
+        ``_trie_versions`` (the full-rebuild trigger then sees a clean
+        basis) and returns True; any non-patchable condition — ring
+        truncation, a live deny trie for a touched family, an
+        unsupported layout, pool exhaustion, an elision violation, a
+        device/mirror shape mismatch — returns False with the versions
+        left stale, and the classic full rebuild runs. Host mirrors
+        mutated before a mid-window failure are discarded by that
+        rebuild, so partial application never leaks."""
+        deltas = self.ipcache.deltas_since(self._trie_versions[0])
+        if not deltas:  # None (truncated) or un-logged version move
+            return False
+        patch = self._trie_patch or {}
+        ops = []  # staged (mirror, family, cidr, row|None)
+        for _ver, cidr, _old_ident, new_ident in deltas:
+            fam = 6 if ":" in cidr else 4
+            if not self._pf_empty[0 if fam == 4 else 1]:
+                # the fused deny+identity trie is live for this family;
+                # it is never patched — rebuild keeps it coherent
+                return False
+            mirror = patch.get(fam)
+            if mirror is None:
+                return False  # unsupported layout (16-8-8 wide v4)
+            row = (
+                compiled.id_to_row.get(new_ident)
+                if new_ident is not None
+                else None
+            )
+            # identity without a device row == absent from the trie
+            ops.append((mirror, cidr, row))
+        napplied = {4: 0, 6: 0}
+        for mirror, cidr, row in ops:
+            ok = (
+                mirror.insert(cidr, row)
+                if row is not None
+                else mirror.delete(cidr)
+            )
+            if not ok:
+                return False
+            napplied[6 if ":" in cidr else 4] += 1
+        v4, v6, world = self._tries
+        nbytes = 0
+        p4 = patch.get(4)
+        if p4 is not None and p4.dirty:
+            out = p4.flush(v4[4], v4[5], v4[6], v4[7])
+            if out is None:
+                return False
+            (ri, rc, sc, si), nb = out
+            v4 = (*v4[:4], ri, rc, sc, si, *v4[8:])
+            nbytes += nb
+        p6 = patch.get(6)
+        if p6 is not None and p6.dirty:
+            out = p6.flush(v6[3], v6[4])
+            if out is None:
+                return False
+            (child, info), nb = out
+            v6 = (*v6[:3], child, info, v6[5], *v6[6:])
+            nbytes += nb
+        self._tries = (v4, v6, world)
+        self._trie_versions = trie_versions
+        for fam in (4, 6):
+            if napplied[fam]:
+                _metrics.lpm_trie_patches_total.inc(
+                    {"family": str(fam)}, float(napplied[fam])
+                )
+        if nbytes:
+            _metrics.device_transfer_bytes_total.inc(
+                {"direction": "h2d"}, float(nbytes)
+            )
+            _metrics.device_transfers_total.inc({"direction": "h2d"}, 1.0)
+        return True
 
     def _placed_holder(self, direction: int, mat) -> Optional[PlacedTables]:
         """PlacedTables view of the direction's CURRENT placed-table
@@ -2612,6 +2857,29 @@ class DatapathPipeline:
             self._epoch_swap = on
             if not on:
                 self._swap_gen += 1
+
+    def set_sparse_deltas(self, on: bool) -> None:
+        """Toggle O(k) sparse device-table deltas (the SparseDeltas
+        runtime option). ON takes effect on the next rebuild: the
+        patchable trie builders are constructed alongside the full trie
+        compile, and subsequent ipcache / selector deltas patch device
+        tensors in place. OFF drops the patch state and the placed
+        sel_match cache so the next rebuild re-places and re-merges
+        from scratch — the exact pre-option arrays and programs (the
+        patch kernels are never traced)."""
+        with self._lock:
+            on = bool(on)
+            if on == self._sparse_deltas:
+                return
+            self._sparse_deltas = on
+            self._trie_patch = None
+            self._placed_sel = (0, None, None)
+            # drop the trie tensors on BOTH transitions: ON must
+            # construct the patchable mirrors alongside a fresh full
+            # compile (they mirror the device arrays row for row), OFF
+            # must shed the ON path's pow2 node-pool headroom and
+            # rebuild exact-sized pre-option tries
+            self._tries = None
 
     def wait_epoch_swap(self, timeout: float = 60.0) -> bool:
         """Block until no shadow build is in flight (tests/bench

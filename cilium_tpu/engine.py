@@ -486,6 +486,18 @@ class PolicyEngine:
             cols = np.arange(w0, w1 + 1, dtype=np.int32)
             touched = np.nonzero(m.any(axis=1))[0]
             new_match = self._scatter_sel_window(sm, touched, cols)
+            if touched.size:
+                # payload: (sel_lo, sel_hi, touched identity rows) — the
+                # CSR column-delta consumers (pipeline placed-copy
+                # patching) replay against the host mirror's FINAL
+                # state, so re-application is idempotent and ordering
+                # against "rows" events is irrelevant
+                self._log_delta(
+                    "cols", (old_s, new_s, tuple(int(r) for r in touched))
+                )
+                # host counter: ``touched`` is the np row index set
+                # from the host mirror diff, never a device array
+                _metrics.engine_delta_cols_total.inc(value=int(touched.size))  # policyd-lint: disable=TPU005
         device = self._device
         assert device is not None
         self._device = DevicePolicy(
@@ -759,6 +771,35 @@ class PolicyEngine:
         with self._lock:
             assert self._compiled is not None and self._device is not None
             return self._compiled, self._device
+
+    def sel_match_rows(
+        self,
+        rows: Sequence[int],
+        words: Optional[Sequence[int]] = None,
+    ) -> Optional[np.ndarray]:
+        """Bounded FINAL-STATE copy of the host sel_match mirror: the
+        requested identity rows (× the requested packed words, all words
+        when None) as a fresh array — the delta-replay source for the
+        pipeline's placed-copy patching (ops/materialize
+        patch_selector_rows / patch_selector_cols). Final-state reads
+        make replay idempotent regardless of event ordering. None when
+        the engine has no compile yet or an index is out of the mirror's
+        bounds (layout moved — caller must full re-place)."""
+        ridx = np.asarray(rows, np.int64)
+        widx = None if words is None else np.asarray(words, np.int64)
+        with self._lock:
+            sm = self._sel_match_host
+            if sm is None:
+                return None
+            if ridx.size and (ridx.min() < 0 or ridx.max() >= sm.shape[0]):
+                return None
+            if widx is not None and widx.size and (
+                widx.min() < 0 or widx.max() >= sm.shape[1]
+            ):
+                return None
+            if widx is None:
+                return sm[ridx].copy()
+            return sm[np.ix_(ridx, widx)].copy()
 
     def _rows_snapshot(
         self, low: np.ndarray, high: dict, identity_ids: Sequence[int]

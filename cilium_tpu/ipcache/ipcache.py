@@ -41,12 +41,41 @@ Listener = Callable[[str, Optional[Entry], Optional[Entry]], None]
 
 
 class IPCache:
+    # Bounded outward delta ring (the engine DELTA_LOG_CAP pattern):
+    # consumed by the datapath pipeline's O(delta) trie patching.
+    DELTA_LOG_CAP = 512
+
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._by_prefix: Dict[str, Entry] = {}
         self._by_identity: Dict[int, set] = {}
         self._listeners: List[Listener] = []
         self.version = 0
+        # (version, cidr, old_identity|None, new_identity|None) —
+        # appended under the lock by upsert/delete, oldest dropped past
+        # the cap
+        self._delta_log: List[Tuple[int, str, Optional[int], Optional[int]]] = []
+
+    def _log_delta(
+        self, key: str, old: Optional[int], new: Optional[int]
+    ) -> None:
+        self._delta_log.append((self.version, key, old, new))
+        if len(self._delta_log) > self.DELTA_LOG_CAP:
+            del self._delta_log[: len(self._delta_log) - self.DELTA_LOG_CAP]
+
+    def deltas_since(self, version: int):
+        """Map updates with version > ``version`` (oldest first), or
+        None when the ring has been truncated past that point — the
+        consumer must rebuild its derived state from ``items()``
+        (engine.deltas_since semantics)."""
+        with self._lock:
+            if version >= self.version:
+                return []
+            if self._delta_log and self._delta_log[0][0] > version + 1:
+                return None
+            if not self._delta_log and self.version > version:
+                return None
+            return [e for e in self._delta_log if e[0] > version]
 
     # ------------------------------------------------------------------
     def _norm(self, cidr: str) -> str:
@@ -98,6 +127,7 @@ class IPCache:
                     s.discard(key)
             self._by_identity.setdefault(identity, set()).add(key)
             self.version += 1
+            self._log_delta(key, old.identity if old else None, identity)
             for fn in self._listeners:
                 fn(key, old, new)
         return True
@@ -113,6 +143,7 @@ class IPCache:
             if s:
                 s.discard(key)
             self.version += 1
+            self._log_delta(key, old.identity, None)
             for fn in self._listeners:
                 fn(key, old, None)
         return True

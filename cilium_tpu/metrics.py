@@ -273,6 +273,19 @@ engine_delta_rows_total = registry.counter(
     "Identity rows updated through the coalesced delta path (one per "
     "(row, identity, live) event scattered to the device tables)",
 )
+engine_delta_cols_total = registry.counter(
+    "cilium_tpu_engine_delta_cols_total",
+    "Identity rows carried by selector column-patch events (policyd-"
+    "sparse): a new-selector append touching k identities logs one "
+    "\"cols\" delta and scatters O(k·window) words instead of the full "
+    "[N, S/32] sel_match matrix",
+)
+lpm_trie_patches_total = registry.counter(
+    "cilium_tpu_lpm_trie_patches_total",
+    "ipcache prefix upserts/deletes applied to the device LPM tries as "
+    "O(delta) node patches (policyd-sparse; label family: 4|6) instead "
+    "of whole-trie rebuilds",
+)
 engine_epoch_swaps_total = registry.counter(
     "cilium_tpu_engine_epoch_swaps_total",
     "Shadow-built device-table generations atomically swapped in at a "

@@ -528,6 +528,483 @@ def merge_flat_tries(ip_arrays, deny_arrays):
     return root_info, root_child, sub_child, sub_info
 
 
+# -- O(delta) trie patching (policyd-sparse) --------------------------------
+#
+# ToFQDN-style small-CIDR storms churn the ipcache a few /32s//128s at a
+# time; rebuilding + re-uploading whole tries per change is the
+# reference's per-key LPM map write turned into a table rebuild. These
+# builders keep HOST mirrors of the device trie tensors plus enough
+# writer bookkeeping to insert/delete individual prefixes in place, and
+# flush only the touched node rows / dense spans to the device copies —
+# O(delta) words per churn instead of the whole trie. Node pools carry
+# power-of-two headroom; exhaustion (or a layout/elision violation)
+# returns False and the caller falls back to the classic full rebuild.
+#
+# Correctness bar: for any applied prefix set, the host mirrors are
+# value-identical to what build_wide_trie / build_trie_elided would
+# produce for that set (modulo zero-padded pool rows, which the walks
+# never reach) — (prefix, plen) keys must be unique per trie, which the
+# ipcache guarantees (normalized CIDR keys).
+
+
+@jax.jit
+def _patch_trie_rows(
+    child: jnp.ndarray,
+    info: jnp.ndarray,
+    idx: jnp.ndarray,  # [k] int32 node rows (pow2-padded, dup = last)
+    cvals: jnp.ndarray,  # [k, 256]
+    ivals: jnp.ndarray,  # [k, 256]
+):
+    """Scatter dirty stride-8 node rows into both trie tensors in ONE
+    dispatch (duplicate indices carry identical values). No donation:
+    concurrent LPM walks may hold the old buffers."""
+    return child.at[idx].set(cvals), info.at[idx].set(ivals)
+
+
+@jax.jit
+def _patch_span1(a: jnp.ndarray, start: jnp.ndarray, vals: jnp.ndarray):
+    """Dense-root span update (flat v4 layout): spans are naturally
+    power-of-two (1 << (16 - plen)), so widths bound the program count;
+    the traced start keeps one program per width."""
+    return jax.lax.dynamic_update_slice(a, vals, (start,))
+
+
+@jax.jit
+def _patch_span_row(
+    a: jnp.ndarray, row: jnp.ndarray, start: jnp.ndarray, vals: jnp.ndarray
+):
+    return jax.lax.dynamic_update_slice(a, vals[None, :], (row, start))
+
+
+@jax.jit
+def _patch_elems(a: jnp.ndarray, idx: jnp.ndarray, vals: jnp.ndarray):
+    return a.at[idx].set(vals)
+
+
+def _pow2_pad_rows(rows: np.ndarray) -> np.ndarray:
+    """Pad a row-index list to a power-of-two bucket (min 8) by
+    repeating the last row — the engine _pow2_rows discipline."""
+    k = rows.shape[0]
+    bucket = 8
+    while bucket < k:
+        bucket <<= 1
+    if bucket == k:
+        return rows
+    return np.concatenate([rows, np.repeat(rows[-1:], bucket - k)])
+
+
+class PatchableElidedTrie:
+    """Patchable host mirror of one build_trie_elided trie (v6 ip
+    tries; also correct for v4 stride-8, unused there). Per-(node,
+    slot) writers keyed by plen make deletes exact: at one slot of the
+    final level, distinct covering prefixes necessarily carry distinct
+    plens (same plen + same covered slot ⇒ same masked prefix ⇒ same
+    ipcache key), so the remaining longest plen is the new winner."""
+
+    def __init__(self, prefixes: Iterable[Tuple[str, int]], *, ipv6: bool = True):
+        size = 16 if ipv6 else 4
+        self._ipv6 = ipv6
+        entries = []
+        for cidr, value in prefixes:
+            net = ipaddress.ip_network(cidr, strict=False)
+            if (net.version == 6) != ipv6:
+                continue
+            entries.append((net.network_address.packed, net.prefixlen, value))
+        k = 0
+        if entries:
+            first = entries[0][0]
+            k = min(min(p for _, p, _ in entries) // 8, size - 1)
+            for packed, _p, _v in entries:
+                while k and packed[:k] != first[:k]:
+                    k -= 1
+        self._k = k
+        self._levels = size - k
+        self._common = entries[0][0][:k] if k else b""
+        # node storage: byte→child dicts + per-slot {plen: value} writers
+        self._children: List[Dict[int, int]] = [{}]
+        self._writers: List[Dict[int, Dict[int, int]]] = [{}]
+        self._live = False  # arrays not materialized yet
+        self.child_h = np.zeros((0, 256), np.int32)
+        self.info_h = np.zeros((0, 256), np.int32)
+        self._dirty: set = set()
+        for packed, plen, value in entries:
+            self._ins(packed[k:], plen - 8 * k, value)
+        m = len(self._children)
+        cap = 8
+        while cap < m + 1:  # ≥1 spare row for live inserts
+            cap <<= 1
+        self.child_h = np.zeros((cap, 256), np.int32)
+        self.info_h = np.zeros((cap, 256), np.int32)
+        for n in range(m):
+            for b, c in self._children[n].items():
+                self.child_h[n, b] = c
+            for slot, w in self._writers[n].items():
+                if w:
+                    self.info_h[n, slot] = w[max(w)] + 1
+        self._live = True
+
+    # -- host structure ------------------------------------------------
+    def _new_node(self) -> Optional[int]:
+        nid = len(self._children)
+        if self._live and nid >= self.child_h.shape[0]:
+            return None  # pool exhausted → caller full-rebuilds
+        self._children.append({})
+        self._writers.append({})
+        return nid
+
+    def _write(self, node: int, slot: int, value: int, plen: int) -> None:
+        w = self._writers[node].setdefault(slot, {})
+        w[plen] = value
+        if self._live:
+            self.info_h[node, slot] = w[max(w)] + 1
+            self._dirty.add(node)
+
+    def _unwrite(self, node: int, slot: int, plen: int) -> None:
+        w = self._writers[node].get(slot)
+        if not w or plen not in w:
+            return
+        del w[plen]
+        self.info_h[node, slot] = (w[max(w)] + 1) if w else 0
+        self._dirty.add(node)
+
+    def _ins(self, pb: bytes, plen: int, value: int) -> bool:
+        node = 0
+        full, rem = divmod(plen, 8)
+        for i in range(full):
+            b = pb[i]
+            if rem == 0 and i == full - 1:
+                self._write(node, b, value, plen)
+                return True
+            nxt = self._children[node].get(b)
+            if nxt is None:
+                nxt = self._new_node()
+                if nxt is None:
+                    return False
+                self._children[node][b] = nxt
+                if self._live:
+                    self.child_h[node, b] = nxt
+                    self._dirty.add(node)
+            node = nxt
+        b = pb[full] if full < len(pb) else 0
+        lo = b & (0xFF << (8 - rem)) & 0xFF
+        for slot in range(lo, lo + (1 << (8 - rem))):
+            self._write(node, slot, value, plen)
+        return True
+
+    # -- public ops ----------------------------------------------------
+    def _parse(self, cidr: str):
+        net = ipaddress.ip_network(cidr, strict=False)
+        if (net.version == 6) != self._ipv6:
+            return None
+        return net.network_address.packed, net.prefixlen
+
+    def insert(self, cidr: str, value: int) -> bool:
+        """Upsert one prefix. False → not expressible in place (family
+        mismatch, elision violation, node-pool exhaustion): rebuild."""
+        p = self._parse(cidr)
+        if p is None:
+            return False
+        packed, plen = p
+        if self._k and (plen < 8 * self._k or packed[: self._k] != self._common):
+            return False  # would break the elided shared prefix
+        return self._ins(packed[self._k:], plen - 8 * self._k, value)
+
+    def delete(self, cidr: str) -> bool:
+        """Remove one prefix (no-op when absent — e.g. its identity
+        never had a device row). Deletes cannot violate elision or grow
+        the pool, so this never demands a rebuild."""
+        p = self._parse(cidr)
+        if p is None:
+            return True
+        packed, plen = p
+        if self._k and (plen < 8 * self._k or packed[: self._k] != self._common):
+            return True  # was never inserted
+        pb = packed[self._k:]
+        plen -= 8 * self._k
+        node = 0
+        full, rem = divmod(plen, 8)
+        for i in range(full):
+            b = pb[i]
+            if rem == 0 and i == full - 1:
+                self._unwrite(node, b, plen)
+                return True
+            nxt = self._children[node].get(b)
+            if nxt is None:
+                return True  # path absent → prefix absent
+            node = nxt
+        b = pb[full] if full < len(pb) else 0
+        lo = b & (0xFF << (8 - rem)) & 0xFF
+        for slot in range(lo, lo + (1 << (8 - rem))):
+            self._unwrite(node, slot, plen)
+        return True
+
+    @property
+    def dirty(self) -> bool:
+        return bool(self._dirty)
+
+    def arrays(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(child, info, common_bytes) — build_trie_elided layout with
+        the pow2-padded node pool (zero rows the walk never reaches)."""
+        common = (
+            np.frombuffer(self._common, np.uint8).astype(np.int32)
+            if self._k
+            else np.zeros(0, np.int32)
+        )
+        return self.child_h.copy(), self.info_h.copy(), common
+
+    def flush(self, child_dev, info_dev):
+        """Scatter the dirty node rows into the device copies →
+        ((child, info), logical h2d bytes), or None when the device
+        shape does not match the mirror (caller re-places wholesale)."""
+        if not self._dirty:
+            return (child_dev, info_dev), 0
+        if tuple(getattr(child_dev, "shape", ())) != self.child_h.shape:
+            return None
+        rows = _pow2_pad_rows(np.asarray(sorted(self._dirty), np.int32))
+        cvals = self.child_h[rows]
+        ivals = self.info_h[rows]
+        child_dev, info_dev = _patch_trie_rows(
+            child_dev, info_dev, jnp.asarray(rows), jnp.asarray(cvals),
+            jnp.asarray(ivals),
+        )
+        self._dirty.clear()
+        nbytes = int(rows.nbytes) + int(cvals.nbytes) + int(ivals.nbytes)
+        return (child_dev, info_dev), nbytes
+
+
+class _FlatNode:
+    """One level-2 dense node of the patchable flat v4 trie: resolved
+    info/plen arrays + the raw entry dict the delete path recomputes
+    spans from."""
+
+    __slots__ = ("info", "plen", "entries")
+
+    def __init__(self) -> None:
+        self.info = np.zeros(65536, np.int32)
+        self.plen = np.full(65536, -1, np.int16)
+        self.entries: Dict[Tuple[int, int], int] = {}
+
+
+class PatchableFlatTrie:
+    """Patchable host mirror of one flat 16+16 v4 trie
+    (FlatTrieBuilder layout). Root precedence keeps a per-plen [17,
+    65536] value table (≤16 plens ⇒ winner recompute is 17 vectorized
+    selects over the touched span); deep nodes recompute deleted spans
+    from their entry dicts. Dirty state flushes as power-of-two dense
+    spans (dynamic_update_slice — one program per span width)."""
+
+    def __init__(self, prefixes: Iterable[Tuple[int, int, int]]):
+        # prefixes: parsed (addr_u32, plen, value) v4 entries
+        self._root_by_plen = np.zeros((17, 65536), np.int32)  # value+1
+        self.root_info = np.zeros(65536, np.int32)
+        self.root_child = np.zeros(65536, np.int32)
+        self._nodes: List[_FlatNode] = []
+        entries = list(prefixes)
+        n_deep = len({a >> 16 for a, p, _v in entries if p > 16})
+        cap = 4
+        while cap < n_deep + 2:  # ≥1 spare node row (row 0 = none)
+            cap <<= 1
+        self._cap_rows = min(cap, FLAT_TRIE_MAX_NODES * 2)
+        # (start, pow2 width) dense-root spans / node ids / (nid, base,
+        # pow2 width) node spans touched since the last flush
+        self._dirty_root: Dict[Tuple[int, int], None] = {}
+        self._dirty_child: Dict[int, None] = {}
+        self._dirty_sub: Dict[Tuple[int, int, int], None] = {}
+        for addr, plen, value in entries:
+            ok = self._ins(addr, plen, value)
+            assert ok  # cap covers the build set by construction
+        self._clear_dirty()
+
+    def _clear_dirty(self) -> None:
+        self._dirty_root.clear()
+        self._dirty_child.clear()
+        self._dirty_sub.clear()
+
+    @staticmethod
+    def _mask(addr_u32: int, plen: int) -> int:
+        return (
+            addr_u32 & ((0xFFFFFFFF << (32 - plen)) & 0xFFFFFFFF)
+            if plen else 0
+        )
+
+    def _root_recompute(self, sl: slice) -> None:
+        out = np.zeros(sl.stop - sl.start, np.int32)
+        for p in range(17):  # ascending: longer plen overwrites
+            v = self._root_by_plen[p, sl]
+            out = np.where(v > 0, v, out)
+        self.root_info[sl] = out
+
+    def _ins(self, addr: int, plen: int, value: int) -> bool:
+        addr = self._mask(addr, plen)
+        hi = addr >> 16
+        if plen <= 16:
+            span = 1 << (16 - plen)
+            sl = slice(hi, hi + span)
+            self._root_by_plen[plen, sl] = value + 1
+            self._root_recompute(sl)
+            self._dirty_root[(hi, span)] = None
+            return True
+        nid = int(self.root_child[hi])
+        if nid == 0:
+            if (
+                len(self._nodes) + 2 > self._cap_rows
+                or len(self._nodes) >= FLAT_TRIE_MAX_NODES
+            ):
+                return False  # pool exhausted / past the flat budget
+            self._nodes.append(_FlatNode())
+            nid = len(self._nodes)
+            self.root_child[hi] = nid
+            self._dirty_child[hi] = None
+        node = self._nodes[nid - 1]
+        node.entries[(addr, plen)] = value
+        base = addr & 0xFFFF
+        span = 1 << (32 - plen)
+        sl = slice(base, base + span)
+        m = node.plen[sl] <= plen
+        node.info[sl] = np.where(m, value + 1, node.info[sl])
+        node.plen[sl] = np.where(m, np.int16(plen), node.plen[sl])
+        self._dirty_sub[(nid, base, span)] = None
+        return True
+
+    # -- public ops ----------------------------------------------------
+    @staticmethod
+    def _parse(cidr: str):
+        net = ipaddress.ip_network(cidr, strict=False)
+        if net.version != 4:
+            return None
+        return int(net.network_address), net.prefixlen
+
+    def insert(self, cidr: str, value: int) -> bool:
+        p = self._parse(cidr)
+        if p is None:
+            return False
+        return self._ins(p[0], p[1], value)
+
+    def delete(self, cidr: str) -> bool:
+        """Remove one prefix (no-op when absent). Never demands a
+        rebuild: spans recompute from the surviving writers."""
+        p = self._parse(cidr)
+        if p is None:
+            return True
+        addr, plen = self._mask(p[0], p[1]), p[1]
+        hi = addr >> 16
+        if plen <= 16:
+            span = 1 << (16 - plen)
+            sl = slice(hi, hi + span)
+            if not self._root_by_plen[plen, sl].any():
+                return True  # absent
+            self._root_by_plen[plen, sl] = 0
+            self._root_recompute(sl)
+            self._dirty_root[(hi, span)] = None
+            return True
+        nid = int(self.root_child[hi])
+        if nid == 0:
+            return True
+        node = self._nodes[nid - 1]
+        if node.entries.pop((addr, plen), None) is None:
+            return True
+        base = addr & 0xFFFF
+        span = 1 << (32 - plen)
+        sl = slice(base, base + span)
+        node.info[sl] = 0
+        node.plen[sl] = -1
+        for (a2, p2), v2 in node.entries.items():
+            b2 = a2 & 0xFFFF
+            s2 = 1 << (32 - p2)
+            lo, hi2 = max(base, b2), min(base + span, b2 + s2)
+            if lo < hi2:
+                ssl = slice(lo, hi2)
+                m = node.plen[ssl] <= p2
+                node.info[ssl] = np.where(m, v2 + 1, node.info[ssl])
+                node.plen[ssl] = np.where(m, np.int16(p2), node.plen[ssl])
+        self._dirty_sub[(nid, base, span)] = None
+        return True
+
+    @property
+    def dirty(self) -> bool:
+        return bool(self._dirty_root or self._dirty_child or self._dirty_sub)
+
+    def arrays(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """build_wide_trie flat-layout arrays with the pow2-padded node
+        pool (zero rows resolve to the root fallback, exactly like an
+        unallocated node)."""
+        sub_info = np.zeros((self._cap_rows, 65536), np.int32)
+        for i, node in enumerate(self._nodes):
+            sub_info[i + 1] = node.info
+        sub_child = np.zeros((1, 65536), np.int32)  # flat-layout marker
+        return (
+            self.root_info.copy(), self.root_child.copy(), sub_child,
+            sub_info,
+        )
+
+    def flush(self, root_info_dev, root_child_dev, sub_child_dev, sub_info_dev):
+        """Upload the dirty spans → ((root_info, root_child, sub_child,
+        sub_info), logical h2d bytes), or None on a device/mirror shape
+        mismatch (caller re-places wholesale)."""
+        if not self.dirty:
+            return (root_info_dev, root_child_dev, sub_child_dev, sub_info_dev), 0
+        if tuple(getattr(sub_info_dev, "shape", ())) != (self._cap_rows, 65536):
+            return None
+        nbytes = 0
+        for start, span in self._dirty_root:
+            vals = np.ascontiguousarray(self.root_info[start:start + span])
+            root_info_dev = _patch_span1(
+                # bounded control-plane unroll: one dispatch per dirty
+                # root span (spans coalesce adjacent edits), at rebuild
+                # cadence — never per flow
+                root_info_dev, jnp.int32(start), jnp.asarray(vals)  # policyd-lint: disable=TPU002
+            )
+            nbytes += int(vals.nbytes) + 4
+        if self._dirty_child:
+            idx = _pow2_pad_rows(
+                np.asarray(sorted(self._dirty_child), np.int32)
+            )
+            vals = self.root_child[idx]
+            root_child_dev = _patch_elems(
+                root_child_dev, jnp.asarray(idx), jnp.asarray(vals)
+            )
+            nbytes += int(idx.nbytes) + int(vals.nbytes)
+        for nid, base, span in self._dirty_sub:
+            vals = np.ascontiguousarray(
+                self._nodes[nid - 1].info[base:base + span]
+            )
+            sub_info_dev = _patch_span_row(
+                # bounded control-plane unroll: one dispatch per dirty
+                # sub-node span, bounded by the patch budget before the
+                # mirror falls back to a full rebuild
+                sub_info_dev, jnp.int32(nid), jnp.int32(base),  # policyd-lint: disable=TPU002
+                jnp.asarray(vals),
+            )
+            nbytes += int(vals.nbytes) + 8
+        self._clear_dirty()
+        return (
+            (root_info_dev, root_child_dev, sub_child_dev, sub_info_dev),
+            nbytes,
+        )
+
+
+def make_patchable_wide(
+    prefixes: Iterable[Tuple[str, int]]
+) -> Optional[PatchableFlatTrie]:
+    """PatchableFlatTrie over the v4 entries, or None when
+    build_wide_trie would pick the 16-8-8 pointer layout (too many
+    deep /16 buckets) — that layout is not patched; callers fall back
+    to full rebuilds."""
+    parsed = []
+    deep_hi16 = set()
+    for cidr, value in prefixes:
+        net = ipaddress.ip_network(cidr, strict=False)
+        if net.version != 4:
+            continue
+        addr, plen = int(net.network_address), net.prefixlen
+        parsed.append((addr, plen, value))
+        if plen > 16:
+            deep_hi16.add(addr >> 16)
+    if len(deep_hi16) > FLAT_TRIE_MAX_NODES:
+        return None
+    return PatchableFlatTrie(parsed)
+
+
 def place_table(a, sharding=None):
     """Upload one trie array to device. With a ``NamedSharding`` the
     array is committed REPLICATED across the verdict mesh (every LPM

@@ -379,6 +379,7 @@ def _sweep_segments(
     return allow_sn, l3_sn, red_sn, rule_sn
 
 
+# policyd: refresh-path
 def materialize_endpoints_state(
     compiled: CompiledPolicy,
     device: DevicePolicy,
@@ -398,13 +399,18 @@ def materialize_endpoints_state(
     untouched."""
     n = compiled.id_bits.shape[0]
     ep_rows = compiled.rows_for(endpoint_identity_ids)
-    sel_match_host = np.asarray(device.sel_match)
+    # Bounded [E, S/32] pull of just the endpoint subject rows — never
+    # the full [N, S/32] matrix (at the 100k stretch that pull alone
+    # moved ~1.2GB per `policy explain`).
+    ep_sel = np.asarray(  # policyd-lint: disable=TPU001,TPU005
+        jnp.take(device.sel_match, jnp.asarray(ep_rows, np.int32), axis=0)
+    )
     live = compiled.row_live
     direction = TRAFFIC_INGRESS if ingress else TRAFFIC_EGRESS
 
     # Flatten (endpoint L3 sweep) + (endpoint, slot) sweeps into one batch.
     ep_slots: List[List[Tuple[int, int]]] = [
-        _endpoint_slots(compiled, sel_match_host[row], ingress) for row in ep_rows
+        _endpoint_slots(compiled, ep_sel[i], ingress) for i in range(len(ep_rows))
     ]
     seg_row: List[int] = []
     seg_port: List[int] = []
@@ -476,7 +482,9 @@ def materialize_endpoints_state(
             # identities still need one when the filter redirects.
             for r_idx in np.nonzero(allow & (~l3_allow | redirect))[0]:
                 key = PolicyKey(int(compiled.row_ids[r_idx]), port, proto_n, direction)
-                entries[key] = int(redirect[r_idx])
+                # ``redirect`` is the host np column computed above —
+                # no device RTT, just a scalar off the sweep result
+                entries[key] = int(redirect[r_idx])  # policyd-lint: disable=TPU005
         snapshots.append(EndpointPolicySnapshot(entries=entries, slots=ep_slots[e]))
 
     c = len(col_ep)
@@ -662,6 +670,14 @@ def patch_identity_rows(
                 col += 1
         n_seg = len(seg_subj)
         k = len(live_rows)
+        # Fit the verdict-batch block to the sweep: a single-identity
+        # patch is n_seg·k ≈ E·(1+slots) flows, and padding that to the
+        # dispatch-sized 8192 block makes the [block, S] matmuls ~100×
+        # larger than the work (policyd-sparse: the O(k) update budget
+        # is dominated by exactly this pad waste). Pow2 buckets (min
+        # 64) keep the jit program count bounded by the ladder between
+        # 64 and ``block``.
+        block = min(block, max(64, _seg_bucket(n_seg * k)))
         peer = np.tile(np.asarray(live_rows, np.int32), n_seg)
         sweep_args = (
             device,
@@ -977,3 +993,92 @@ def patch_endpoints_state(
                 placed.rule_tab, jnp.asarray(ridx), jnp.asarray(rvals)
             )
     return True
+
+
+# -- sparse sel_match patching (policyd-sparse) -----------------------------
+#
+# The engine keeps the authoritative device sel_match; the pipeline keeps
+# PLACED copies (replicated or P("ident")-sharded under MeshSharding2D).
+# These helpers re-apply the engine's delta-log events to a placed copy
+# as O(k) scatters instead of re-placing the full [N, S/32] matrix: a
+# jit ``.at[].set`` on a sharded operand keeps the operand's sharding
+# (GSPMD propagates it through the scatter), so the patch is O(delta)
+# per device and the placed jit caches survive.
+
+
+@jax.jit
+def _scatter_sel_rows(
+    sel_match: jnp.ndarray,
+    idx: jnp.ndarray,  # [k] int32
+    rows: jnp.ndarray,  # [k, S/32] uint32
+) -> jnp.ndarray:
+    # No donation: concurrent verdict readers may hold the old buffer.
+    return sel_match.at[idx].set(rows)
+
+
+@jax.jit
+def _scatter_sel_cols(
+    sel_match: jnp.ndarray,
+    rows: jnp.ndarray,  # [k] int32
+    cols: jnp.ndarray,  # [w] int32
+    vals: jnp.ndarray,  # [k, w] uint32
+) -> jnp.ndarray:
+    return sel_match.at[rows[:, None], cols[None, :]].set(vals)
+
+
+def _pow2_rows_vals(
+    rows: np.ndarray, vals: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad a (row indices, per-row values) scatter to a power-of-two
+    bucket (min 8) by repeating the LAST entry — duplicate indices with
+    identical values keep the scatter deterministic, and the bucket
+    bounds jit recompiles to O(log k) programs per value width."""
+    k = rows.shape[0]
+    bucket = 8
+    while bucket < k:
+        bucket <<= 1
+    if bucket == k:
+        return rows, vals
+    return (
+        np.concatenate([rows, np.repeat(rows[-1:], bucket - k)]),
+        np.concatenate([vals, np.repeat(vals[-1:], bucket - k, axis=0)]),
+    )
+
+
+def patch_selector_rows(
+    sel_match: jnp.ndarray,
+    ident_rows: Sequence[int],
+    row_words: np.ndarray,  # [k, S/32] uint32 final-state packed rows
+) -> jnp.ndarray:
+    """Scatter whole packed sel_match rows (identity-churn deltas:
+    engine ``"rows"`` events) into a device/placed copy. O(k · S/32)
+    payload; returns the patched array (same placement as the input)."""
+    rows = np.asarray(ident_rows, np.int32)
+    if rows.size == 0:
+        return sel_match
+    vals = np.ascontiguousarray(row_words, dtype=np.uint32)
+    rows, vals = _pow2_rows_vals(rows, vals)
+    return _scatter_sel_rows(sel_match, jnp.asarray(rows), jnp.asarray(vals))
+
+
+def patch_selector_cols(
+    sel_match: jnp.ndarray,
+    ident_rows: Sequence[int],
+    word_cols: Sequence[int],
+    vals: np.ndarray,  # [k, w] uint32 final-state packed words
+) -> jnp.ndarray:
+    """Scatter a CSR column-delta (selector-append deltas: engine
+    ``"cols"`` events, built by compiler.selectors.selector_col_delta)
+    into a device/placed sel_match copy: k touched identity rows × the
+    appended selectors' word window. O(k · w) payload — for a selector
+    matching k identities at N=1M this moves kilobytes where the dense
+    re-place moved the full [N, S/32] matrix."""
+    rows = np.asarray(ident_rows, np.int32)
+    cols = np.asarray(word_cols, np.int32)
+    if rows.size == 0 or cols.size == 0:
+        return sel_match
+    v = np.ascontiguousarray(vals, dtype=np.uint32)
+    rows, v = _pow2_rows_vals(rows, v)
+    return _scatter_sel_cols(
+        sel_match, jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(v)
+    )

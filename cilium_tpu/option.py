@@ -106,6 +106,7 @@ class DaemonConfig:
     fail_open: bool = False
     admission_control: bool = False
     prefilter_shed: bool = False
+    sparse_deltas: bool = False
     device_profiling: bool = False
     fault_injection: bool = False
     # Boot-time value of the FleetTelemetry runtime option (policyd-
@@ -345,6 +346,21 @@ OPTION_SPECS: Dict[str, OptionSpec] = {
             "starts no thread and never imports the journal module — "
             "hot paths stay at one attribute read and the verdict "
             "path is bit-identical",
+        ),
+        OptionSpec(
+            "SparseDeltas",
+            "O(k) sparse device deltas (policyd-sparse): selector "
+            "column patches from the engine delta log scatter into the "
+            "ident-placed sel_match copies (placement preserved, jit "
+            "caches survive) instead of re-placing the full [N, S/32] "
+            "matrix, and ipcache churn patches individual prefixes "
+            "into the placed LPM trie tensors through pow2-headroom "
+            "host mirrors instead of rebuilding + re-uploading whole "
+            "tries; any non-patchable gap (log truncation, pool "
+            "exhaustion, live deny trie, layout/elision violation) "
+            "falls back to the classic full rebuild. Off compiles the "
+            "exact pre-option programs — dense re-placement, classic "
+            "unpadded trie builds",
         ),
         OptionSpec(
             "Prefilter",
